@@ -1,0 +1,17 @@
+"""filodb_tpu — a TPU-native, Prometheus-compatible, distributed time-series database.
+
+A ground-up rebuild of the capabilities of FiloDB (reference: tuplejump/FiloDB,
+Scala/JVM/Akka/Cassandra) designed TPU-first:
+
+- Host-side ingest runtime (C++ codecs + Python orchestration) writing compressed
+  columnar chunks (delta-delta timestamps, XOR doubles, NibblePack bit-packing,
+  2D-delta histograms — technique parity with reference ``doc/compression.md``).
+- Query hot path (chunk windowing, range functions such as ``rate``/``sum_over_time``,
+  histogram quantiles, label-grouped aggregation) as jitted JAX kernels over dense
+  padded tensors, scaling over a ``jax.sharding.Mesh`` with XLA collectives.
+- PromQL front end, scatter-gather exec-plan tree, shard assignment, durable chunk
+  store with checkpointed replay recovery, downsampling — capability parity with the
+  reference layer map (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+"""Memory/format layer: columnar codecs and chunk page format.
+
+Counterpart of the reference's ``memory/`` module (off-heap BinaryVectors,
+NibblePack, delta-delta, XOR-double and 2D-delta histogram compression —
+``memory/src/main/scala/filodb.memory/format/``). Here the codecs are
+implemented twice with byte-identical output:
+
+- ``nibblepack.py`` / ``codecs.py`` — numpy reference implementation,
+  always available, used for correctness tests.
+- ``native/codecs.cpp`` via ``native.py`` (ctypes) — the fast host path used
+  by the ingest runtime, mirroring the reference's off-heap Scala+Unsafe tier.
+"""
+
+from filodb_tpu.memory.nibblepack import nibble_pack, nibble_unpack  # noqa: F401

@@ -1,0 +1,127 @@
+"""NibblePack: nibble-granularity bit packing of u64 streams.
+
+Technique parity with the reference's NibblePack
+(``memory/src/main/scala/filodb.memory/format/NibblePack.scala:12``, spec in
+``doc/compression.md:35-128``): values are packed in groups of 8; each group
+stores a 1-byte nonzero bitmap, and — if any value is nonzero — a 1-byte
+nibble descriptor (number of nibbles kept, number of trailing zero nibbles,
+shared across the group) followed by the kept nibbles of each nonzero value,
+packed little-endian.
+
+This is our own wire format (we never exchange bytes with the JVM reference);
+layout chosen to be simple to decode both in C++ and vectorized numpy.
+
+Group layout:
+  byte 0: bitmap, bit i set => value i != 0
+  if bitmap != 0:
+    byte 1: ((num_nibbles - 1) << 4) | trailing_zero_nibbles
+    then ceil(popcount(bitmap) * num_nibbles / 2) bytes of nibble data:
+      for each nonzero value (in index order), its ``num_nibbles`` nibbles
+      (after right-shifting away ``trailing_zero_nibbles`` nibbles), written
+      low-nibble-first into a little-endian byte stream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_U64 = np.uint64
+
+
+def zigzag_encode(values: np.ndarray) -> np.ndarray:
+    """Map signed int64 -> uint64 with small magnitudes near zero."""
+    v = values.astype(np.int64)
+    return ((v << np.int64(1)) ^ (v >> np.int64(63))).astype(np.uint64)
+
+
+def zigzag_decode(values: np.ndarray) -> np.ndarray:
+    u = values.astype(np.uint64)
+    return ((u >> _U64(1)).astype(np.int64)) ^ (-(u & _U64(1)).astype(np.int64))
+
+
+def _nibble_width(x: int) -> int:
+    """Number of nibbles needed to represent x (>=1 even for 0)."""
+    if x == 0:
+        return 1
+    return (x.bit_length() + 3) // 4
+
+
+def _trailing_zero_nibbles(x: int) -> int:
+    if x == 0:
+        return 16
+    tz = 0
+    while x & 0xF == 0:
+        tz += 1
+        x >>= 4
+    return tz
+
+
+def nibble_pack(values: np.ndarray) -> bytes:
+    """Pack an array of uint64 into NibblePack bytes."""
+    vals = np.ascontiguousarray(values, dtype=np.uint64)
+    out = bytearray()
+    n = len(vals)
+    for g in range(0, n, 8):
+        group = vals[g : g + 8]
+        ints = [int(x) for x in group]
+        # pad group to 8 with zeros (decoder trims via total count)
+        while len(ints) < 8:
+            ints.append(0)
+        bitmap = 0
+        for i, x in enumerate(ints):
+            if x != 0:
+                bitmap |= 1 << i
+        out.append(bitmap)
+        if bitmap == 0:
+            continue
+        nz = [x for x in ints if x != 0]
+        tz = min(_trailing_zero_nibbles(x) for x in nz)
+        lead_width = max(_nibble_width(x) for x in nz)
+        num_nibbles = lead_width - tz
+        out.append(((num_nibbles - 1) << 4) | tz)
+        # pack nibbles of nonzero values consecutively, low-nibble first
+        acc = 0
+        acc_bits = 0
+        for x in nz:
+            x >>= 4 * tz
+            acc |= (x & ((1 << (4 * num_nibbles)) - 1)) << acc_bits
+            acc_bits += 4 * num_nibbles
+            while acc_bits >= 8:
+                out.append(acc & 0xFF)
+                acc >>= 8
+                acc_bits -= 8
+        if acc_bits > 0:
+            out.append(acc & 0xFF)
+    return bytes(out)
+
+
+def nibble_unpack(data: bytes, count: int) -> np.ndarray:
+    """Unpack ``count`` uint64 values from NibblePack bytes."""
+    out = np.zeros(count, dtype=np.uint64)
+    pos = 0
+    idx = 0
+    mv = data
+    while idx < count:
+        bitmap = mv[pos]
+        pos += 1
+        if bitmap == 0:
+            idx += 8
+            continue
+        desc = mv[pos]
+        pos += 1
+        num_nibbles = (desc >> 4) + 1
+        tz = desc & 0xF
+        nnz = bin(bitmap).count("1")
+        nbytes = (nnz * num_nibbles + 1) // 2
+        chunk = int.from_bytes(mv[pos : pos + nbytes], "little")
+        pos += nbytes
+        mask = (1 << (4 * num_nibbles)) - 1
+        shift = 0
+        for i in range(8):
+            if bitmap & (1 << i):
+                val = ((chunk >> shift) & mask) << (4 * tz)
+                if idx + i < count:
+                    out[idx + i] = val
+                shift += 4 * num_nibbles
+        idx += 8
+    return out
