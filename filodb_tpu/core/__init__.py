@@ -1,0 +1,5 @@
+"""Storage engine: schemas, partition keys, memstore, store APIs, downsampling.
+
+Counterpart of the reference's ``core/`` module
+(``core/src/main/scala/filodb.core/``).
+"""

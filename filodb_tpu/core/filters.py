@@ -1,0 +1,80 @@
+"""Column filters for partition-key lookup.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/query/KeyFilter.scala``
+(``ColumnFilter`` / ``Filter`` with Equals/In/EqualsRegex/NotEqualsRegex...).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+
+class Filter:
+    def matches(self, value: str) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class Equals(Filter):
+    value: str
+
+    def matches(self, value: str) -> bool:
+        return value == self.value
+
+
+@dataclass(frozen=True)
+class NotEquals(Filter):
+    value: str
+
+    def matches(self, value: str) -> bool:
+        return value != self.value
+
+
+@dataclass(frozen=True)
+class In(Filter):
+    values: frozenset[str]
+
+    def matches(self, value: str) -> bool:
+        return value in self.values
+
+
+def _compile_anchored(pattern: str) -> re.Pattern:
+    # PromQL regexes are fully anchored (RE2 ^(?:pattern)$ semantics)
+    return re.compile(f"^(?:{pattern})$")
+
+
+@dataclass(frozen=True)
+class EqualsRegex(Filter):
+    pattern: str
+
+    def matches(self, value: str) -> bool:
+        return _compile_anchored(self.pattern).match(value) is not None
+
+
+@dataclass(frozen=True)
+class NotEqualsRegex(Filter):
+    pattern: str
+
+    def matches(self, value: str) -> bool:
+        return _compile_anchored(self.pattern).match(value) is None
+
+
+@dataclass(frozen=True)
+class ColumnFilter:
+    column: str
+    filter: Filter
+
+    def __str__(self) -> str:
+        f = self.filter
+        if isinstance(f, Equals):
+            return f'{self.column}="{f.value}"'
+        if isinstance(f, NotEquals):
+            return f'{self.column}!="{f.value}"'
+        if isinstance(f, EqualsRegex):
+            return f'{self.column}=~"{f.pattern}"'
+        if isinstance(f, NotEqualsRegex):
+            return f'{self.column}!~"{f.pattern}"'
+        if isinstance(f, In):
+            return f'{self.column} in {sorted(f.values)}'
+        return f"{self.column}?{f}"

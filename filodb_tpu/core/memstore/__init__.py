@@ -1,0 +1,4 @@
+"""In-memory columnar memstore: shards, partitions, index, flush lifecycle.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/memstore/``.
+"""

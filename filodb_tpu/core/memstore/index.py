@@ -1,0 +1,161 @@
+"""Part-key tag inverted index.
+
+Counterpart of the reference's ``PartKeyLuceneIndex``
+(``core/src/main/scala/filodb.core/memstore/PartKeyLuceneIndex.scala:38-42,71``):
+per shard, maps label=value postings to partition ids, tracks per-partition
+[startTime, endTime] for time-bounded lookups, supports Equals / NotEquals /
+regex / In filters (``leafFilter:455``, ``partIdsFromFilters:494``) and label
+introspection (labelValues / indexNames).
+
+Rebuilt TPU-first as a pure in-process structure: postings are Python sets
+over int part-ids (dense, starting at 0), time bounds are parallel numpy
+arrays — no Lucene, no mmap. Regex filters scan the per-label value
+dictionary, which is tiny relative to the postings.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from filodb_tpu.core.filters import ColumnFilter, Equals, In
+from filodb_tpu.core.partkey import PartKey
+
+_INIT_CAP = 1024
+# endTime for a still-ingesting partition (reference Long.MaxValue semantics)
+INGESTING = np.iinfo(np.int64).max
+
+
+class PartKeyIndex:
+    """Tag index for one shard."""
+
+    def __init__(self):
+        # label -> value -> set of partIds
+        self._postings: dict[str, dict[str, set[int]]] = defaultdict(
+            lambda: defaultdict(set)
+        )
+        self._part_keys: list[PartKey | None] = []
+        self._start: np.ndarray = np.full(_INIT_CAP, np.iinfo(np.int64).max, np.int64)
+        self._end: np.ndarray = np.full(_INIT_CAP, np.iinfo(np.int64).max, np.int64)
+        self._count = 0
+
+    def __len__(self) -> int:
+        return self._count
+
+    def _ensure(self, part_id: int) -> None:
+        while part_id >= len(self._start):
+            self._start = np.concatenate([self._start,
+                                          np.full(len(self._start), INGESTING)])
+            self._end = np.concatenate([self._end,
+                                        np.full(len(self._end), INGESTING)])
+        while part_id >= len(self._part_keys):
+            self._part_keys.append(None)
+
+    def add_part_key(self, part_id: int, key: PartKey, start_time: int,
+                     end_time: int = INGESTING) -> None:
+        self._ensure(part_id)
+        if self._part_keys[part_id] is None:
+            self._count += 1
+        self._part_keys[part_id] = key
+        self._start[part_id] = start_time
+        self._end[part_id] = end_time
+        for name, value in key.labels:
+            self._postings[name][value].add(part_id)
+
+    def remove_part_key(self, part_id: int) -> None:
+        key = self._part_keys[part_id]
+        if key is None:
+            return
+        for name, value in key.labels:
+            s = self._postings[name].get(value)
+            if s is not None:
+                s.discard(part_id)
+                if not s:
+                    del self._postings[name][value]
+        self._part_keys[part_id] = None
+        self._start[part_id] = INGESTING
+        self._end[part_id] = INGESTING
+        self._count -= 1
+
+    def update_end_time(self, part_id: int, end_time: int) -> None:
+        self._end[part_id] = end_time
+
+    def start_time(self, part_id: int) -> int:
+        return int(self._start[part_id])
+
+    def end_time(self, part_id: int) -> int:
+        return int(self._end[part_id])
+
+    def part_key(self, part_id: int) -> PartKey | None:
+        return self._part_keys[part_id] if part_id < len(self._part_keys) else None
+
+    def _ids_for_filter(self, f: ColumnFilter) -> set[int] | None:
+        """Postings for one filter; None means 'all' (negative filters)."""
+        by_value = self._postings.get(f.column)
+        flt = f.filter
+        if isinstance(flt, Equals):
+            if by_value is None:
+                return set()
+            return set(by_value.get(flt.value, ()))
+        if isinstance(flt, In):
+            if by_value is None:
+                return set()
+            out: set[int] = set()
+            for v in flt.values:
+                out |= by_value.get(v, set())
+            return out
+        # regex / not-equals: scan the value dictionary for this label
+        if by_value is None:
+            return None  # label absent everywhere: negative filters pass all
+        out = set()
+        for value, ids in by_value.items():
+            if flt.matches(value):
+                out |= ids
+        return out
+
+    def part_ids_from_filters(
+        self, filters: list[ColumnFilter], start_time: int, end_time: int
+    ) -> list[int]:
+        """Intersect filter postings, then apply the time overlap predicate
+        (reference ``partIdsFromFilters:494``)."""
+        result: set[int] | None = None
+        negatives: list[ColumnFilter] = []
+        for f in filters:
+            flt = f.filter
+            if isinstance(flt, (Equals, In)):
+                ids = self._ids_for_filter(f)
+                result = ids if result is None else result & ids
+                if not result:
+                    return []
+            else:
+                negatives.append(f)
+        if result is None:
+            result = {i for i, k in enumerate(self._part_keys) if k is not None}
+        for f in negatives:
+            # match semantics: absent label == "" for negative/regex filters
+            keep = set()
+            for pid in result:
+                key = self._part_keys[pid]
+                if key is not None and f.filter.matches(key.label_map.get(f.column, "")):
+                    keep.add(pid)
+            result = keep
+        if not result:
+            return []
+        ids = np.fromiter(result, dtype=np.int64)
+        ok = (self._start[ids] <= end_time) & (self._end[ids] >= start_time)
+        return sorted(int(i) for i in ids[ok])
+
+    def label_names(self) -> list[str]:
+        return sorted(k for k, v in self._postings.items() if v)
+
+    def label_values(self, label: str,
+                     filters: list[ColumnFilter] | None = None,
+                     start_time: int = 0, end_time: int = INGESTING) -> list[str]:
+        by_value = self._postings.get(label)
+        if not by_value:
+            return []
+        if not filters:
+            return sorted(by_value.keys())
+        ids = set(self.part_ids_from_filters(filters, start_time, end_time))
+        return sorted(v for v, pids in by_value.items() if pids & ids)

@@ -1,0 +1,220 @@
+"""Per-series partition state: write buffers and the encoded chunk list.
+
+Counterpart of the reference's ``TimeSeriesPartition``
+(``core/src/main/scala/filodb.core/memstore/TimeSeriesPartition.scala:64,137,
+233,252,303``): appending write buffers receive samples; when full (or at
+flush), ``switch_buffers`` encodes them into an immutable compressed chunk
+(``encodeOneChunkset``); ``make_flush_chunks`` hands not-yet-persisted chunks
+to the column store. Out-of-order/duplicate timestamps within a partition are
+dropped, as in the reference ingest path.
+
+TPU-first redesign notes: buffers are preallocated numpy arrays (the analog of
+the reference's off-heap ``WriteBufferPool`` appenders); the query path reads
+whole chunks as dense arrays — there is no per-row reader abstraction because
+the query engine consumes columns, not rows.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.schemas import ColumnType, Schema
+from filodb_tpu.memory.chunk import Chunk, encode_chunk
+from filodb_tpu.memory.codecs import HistogramColumn
+
+
+@dataclass
+class _Buffers:
+    ts: np.ndarray
+    cols: list  # ndarray per non-ts column; hist cols start as None (lazy nb)
+    n: int = 0
+
+
+class TimeSeriesPartition:
+    """One time series: label key + chunks + active write buffer."""
+
+    __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "chunks",
+                 "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard")
+
+    def __init__(self, part_id: int, part_key: PartKey, schema: Schema,
+                 max_chunk_size: int = 400, shard: int = 0):
+        self.part_id = part_id
+        self.part_key = part_key
+        self.schema = schema
+        self.shard = shard
+        self.max_chunk_size = max_chunk_size
+        self.chunks: list[Chunk] = []  # sorted by start time
+        self._buf = self._new_buffers()
+        self._chunk_seq = 0
+        self._flushed_id = -1  # highest chunk id already persisted
+        self.bucket_les: np.ndarray | None = None
+
+    def _new_buffers(self) -> _Buffers:
+        cols = []
+        for c in self.schema.data.columns[1:]:
+            if c.ctype == ColumnType.DOUBLE:
+                cols.append(np.empty(self.max_chunk_size, np.float64))
+            elif c.ctype in (ColumnType.LONG, ColumnType.INT, ColumnType.TIMESTAMP):
+                cols.append(np.empty(self.max_chunk_size, np.int64))
+            elif c.ctype == ColumnType.HISTOGRAM:
+                cols.append(None)  # allocated on first sample (bucket count)
+            elif c.ctype == ColumnType.STRING:
+                cols.append([None] * self.max_chunk_size)
+            else:
+                raise ValueError(f"unsupported {c.ctype}")
+        return _Buffers(np.empty(self.max_chunk_size, np.int64), cols)
+
+    # ---- ingest ----------------------------------------------------------
+
+    @property
+    def latest_ts(self) -> int:
+        if self._buf.n:
+            return int(self._buf.ts[self._buf.n - 1])
+        if self.chunks:
+            return self.chunks[-1].end_time
+        return -1
+
+    @property
+    def earliest_ts(self) -> int:
+        if self.chunks:
+            return self.chunks[0].start_time
+        if self._buf.n:
+            return int(self._buf.ts[0])
+        return -1
+
+    @property
+    def num_samples(self) -> int:
+        return sum(c.num_rows for c in self.chunks) + self._buf.n
+
+    def ingest(self, ts: int, values: tuple) -> bool:
+        """Add one sample. Returns False for dropped (out-of-order) samples."""
+        if ts <= self.latest_ts:
+            return False  # drop out-of-order / duplicate (reference semantics)
+        b = self._buf
+        i = b.n
+        b.ts[i] = ts
+        for ci, (col, v) in enumerate(zip(self.schema.data.columns[1:], values)):
+            if col.ctype == ColumnType.HISTOGRAM:
+                les, buckets = v  # (les float64[nb], cumulative counts int64[nb])
+                buckets = np.asarray(buckets, np.int64)
+                if b.cols[ci] is None or (
+                        self.bucket_les is not None
+                        and len(buckets) != b.cols[ci].shape[1]):
+                    # bucket-scheme change forces a chunk switch
+                    if b.cols[ci] is not None and b.n > 0:
+                        self.switch_buffers()
+                        b = self._buf
+                        i = 0
+                        b.ts[i] = ts
+                    b.cols[ci] = np.zeros(
+                        (self.max_chunk_size, len(buckets)), np.int64)
+                self.bucket_les = np.asarray(les, np.float64)
+                b.cols[ci][i] = buckets
+            elif col.ctype == ColumnType.STRING:
+                b.cols[ci][i] = v
+            else:
+                b.cols[ci][i] = v
+        b.n = i + 1
+        if b.n >= self.max_chunk_size:
+            self.switch_buffers()
+        return True
+
+    def switch_buffers(self) -> Chunk | None:
+        """Encode the active buffer into an immutable chunk
+        (reference ``switchBuffers`` → ``encodeOneChunkset``)."""
+        b = self._buf
+        if b.n == 0:
+            return None
+        cols = []
+        for col, data in zip(self.schema.data.columns[1:], b.cols):
+            if col.ctype == ColumnType.HISTOGRAM:
+                rows = data[: b.n] if data is not None else np.zeros((b.n, 0), np.int64)
+                cols.append(HistogramColumn(
+                    self.bucket_les if self.bucket_les is not None
+                    else np.zeros(rows.shape[1]), rows))
+            elif col.ctype == ColumnType.STRING:
+                cols.append(data[: b.n])
+            else:
+                cols.append(data[: b.n])
+        chunk = encode_chunk(self.schema, b.ts[: b.n], cols, self._chunk_seq)
+        self._chunk_seq = (self._chunk_seq + 1) & 0xFFF
+        self.chunks.append(chunk)
+        self._buf = self._new_buffers()
+        return chunk
+
+    # ---- flush -----------------------------------------------------------
+
+    def make_flush_chunks(self, flush_buffer: bool = True) -> list[Chunk]:
+        """Chunks not yet persisted; optionally seals the active buffer first
+        (reference ``makeFlushChunks``)."""
+        if flush_buffer:
+            self.switch_buffers()
+        return [c for c in self.chunks if c.id > self._flushed_id]
+
+    def mark_flushed(self, up_to_id: int) -> None:
+        self._flushed_id = max(self._flushed_id, up_to_id)
+
+    @property
+    def unflushed_count(self) -> int:
+        return sum(1 for c in self.chunks if c.id > self._flushed_id) + (
+            1 if self._buf.n else 0)
+
+    # ---- read ------------------------------------------------------------
+
+    def chunks_in_range(self, start: int, end: int,
+                        include_buffer: bool = True) -> list[Chunk]:
+        out = [c for c in self.chunks if c.end_time >= start and c.start_time <= end]
+        if include_buffer and self._buf.n:
+            b = self._buf
+            bstart, bend = int(b.ts[0]), int(b.ts[b.n - 1])
+            if bend >= start and bstart <= end:
+                # materialize a transient chunk view of the write buffer
+                out.append(self._buffer_chunk())
+        return out
+
+    def _buffer_chunk(self) -> Chunk:
+        b = self._buf
+        cols = []
+        for col, data in zip(self.schema.data.columns[1:], b.cols):
+            if col.ctype == ColumnType.HISTOGRAM:
+                rows = data[: b.n] if data is not None else np.zeros((b.n, 0), np.int64)
+                cols.append(HistogramColumn(
+                    self.bucket_les if self.bucket_les is not None
+                    else np.zeros(rows.shape[1]), rows))
+            else:
+                cols.append(data[: b.n])
+        return encode_chunk(self.schema, b.ts[: b.n], cols, 0xFFF)
+
+    def read_samples(self, start: int, end: int, col: int = None):
+        """Decode all samples with start <= ts <= end for one value column.
+
+        Returns (ts int64[n], values) where values is float64[n] or
+        HistogramColumn. Host-side convenience for tests/flush; the query
+        engine batches decode across partitions instead.
+        """
+        if col is None:
+            col = self.schema.data.value_column
+        ts_parts, val_parts = [], []
+        les = None
+        for c in self.chunks_in_range(start, end):
+            ts = c.decode_column(0)
+            vals = c.decode_column(col)
+            mask = (ts >= start) & (ts <= end)
+            ts_parts.append(ts[mask])
+            if isinstance(vals, HistogramColumn):
+                les = vals.les
+                val_parts.append(vals.rows[mask])
+            else:
+                val_parts.append(np.asarray(vals)[mask])
+        if not ts_parts:
+            empty = np.array([], np.int64)
+            return empty, (HistogramColumn(np.array([]), np.zeros((0, 0), np.int64))
+                           if les is not None else np.array([], np.float64))
+        ts = np.concatenate(ts_parts)
+        order = np.argsort(ts, kind="stable")
+        if les is not None:
+            return ts[order], HistogramColumn(les, np.concatenate(val_parts)[order])
+        return ts[order], np.concatenate(val_parts)[order]

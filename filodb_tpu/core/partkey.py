@@ -1,0 +1,127 @@
+"""Partition keys, hashing and shard routing.
+
+Counterpart of the reference's BinaryRecord v2 partition keys and ShardMapper
+routing (``core/src/main/scala/filodb.core/binaryrecord2/RecordSchema.scala:112``,
+``coordinator/src/main/scala/filodb.coordinator/ShardMapper.scala:26-49``,
+``doc/sharding.md:23-56``).
+
+Semantics preserved:
+- A partition key is (schema, sorted label map). The metric name is the label
+  ``_metric_``; shard-key labels (default ``_ws_``, ``_ns_``, ``_metric_``)
+  determine the *shard-key hash*.
+- shard = upper bits from shardKeyHash | lower ``spread`` bits from the full
+  partition hash — so all series of one (workspace, namespace, metric) land in
+  a bounded group of 2^spread shards, enabling bounded query fan-out.
+
+Hash is murmur3-32 over the canonical serialized key, stable across processes
+(used by gateways to route without coordination, like the reference's gateway).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+METRIC_LABEL = "_metric_"
+
+
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """Stable 32-bit murmur3 (x86 variant)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed
+    n = len(data)
+    rounded = n - (n & 3)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= n
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+@dataclass(frozen=True)
+class PartKey:
+    """An immutable partition key: schema name + label map (incl. _metric_)."""
+
+    schema: str
+    labels: tuple[tuple[str, str], ...]  # sorted (name, value) pairs
+
+    @staticmethod
+    def create(schema: str, labels: dict[str, str]) -> "PartKey":
+        return PartKey(schema, tuple(sorted(labels.items())))
+
+    @cached_property
+    def label_map(self) -> dict[str, str]:
+        return dict(self.labels)
+
+    @property
+    def metric(self) -> str:
+        return self.label_map.get(METRIC_LABEL, "")
+
+    @cached_property
+    def serialized(self) -> bytes:
+        parts = [self.schema.encode()]
+        for k, v in self.labels:
+            parts.append(k.encode() + b"\x01" + v.encode())
+        return b"\x00".join(parts)
+
+    @cached_property
+    def part_hash(self) -> int:
+        return murmur3_32(self.serialized)
+
+    def shard_key_hash(self, shard_key_labels: tuple[str, ...]) -> int:
+        return shard_key_hash(
+            {k: self.label_map.get(k, "") for k in shard_key_labels}
+        )
+
+    def __str__(self) -> str:
+        inner = ",".join(f"{k}={v}" for k, v in self.labels if k != METRIC_LABEL)
+        return f"{self.metric}{{{inner}}}"
+
+
+def shard_key_hash(shard_key_values: dict[str, str]) -> int:
+    """Hash of the shard-key labels only (reference ``RecordBuilder.shardKeyHash``)."""
+    data = b"\x00".join(
+        k.encode() + b"\x01" + v.encode() for k, v in sorted(shard_key_values.items())
+    )
+    return murmur3_32(data, seed=0x5EED)
+
+
+def ingestion_shard(shard_key_h: int, part_h: int, num_shards: int, spread: int) -> int:
+    """Compute the owning shard (reference ``ShardMapper.ingestionShard:37-49``).
+
+    Upper bits of the shard come from the shard-key hash; the low ``spread``
+    bits come from the whole-key hash, so one shard key spans 2^spread shards.
+    """
+    assert num_shards & (num_shards - 1) == 0, "num_shards must be a power of 2"
+    mask = (1 << spread) - 1
+    return (shard_key_h & ~mask | part_h & mask) & (num_shards - 1)
+
+
+def shards_for_shard_key(shard_key_h: int, num_shards: int, spread: int) -> list[int]:
+    """All shards a shard key maps to at a given spread — the query fan-out set
+    (reference ``ShardMapper.queryShards``)."""
+    mask = (1 << spread) - 1
+    base = shard_key_h & ~mask & (num_shards - 1)
+    return [(base | i) & (num_shards - 1) for i in range(1 << spread)]

@@ -1,0 +1,82 @@
+"""Ingestion records and containers.
+
+Counterpart of the reference's BinaryRecord v2 ingestion records and
+RecordContainers (``core/src/main/scala/filodb.core/binaryrecord2/
+RecordBuilder.scala:34``, ``RecordContainer.scala:13-27``): the unit shipped
+from gateways over the log into shards is a container of schema-tagged records,
+each holding (partition key, timestamp, data values). Containers serialize to
+bytes so they can ride a Kafka-compatible log and be replayed on recovery.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.schemas import Schemas
+
+
+@dataclass(frozen=True)
+class IngestRecord:
+    """One sample for one series. ``values`` follows the schema's non-timestamp
+    data columns in order; histogram values are (nb,) int64 cumulative buckets
+    (with bucket bounds carried in the partition key label scheme or schema)."""
+
+    part_key: PartKey
+    timestamp: int  # epoch millis
+    values: tuple
+
+    def __post_init__(self):
+        # normalize numpy arrays for hashability at container level
+        pass
+
+
+@dataclass
+class RecordContainer:
+    """A batch of records plus the log offset it came from."""
+
+    records: list[IngestRecord] = field(default_factory=list)
+
+    def add(self, rec: IngestRecord) -> None:
+        self.records.append(rec)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+    def serialize(self) -> bytes:
+        # versioned, length-prefixed pickle: containers are internal transport,
+        # produced and consumed only by our own gateway/shard runtimes.
+        payload = pickle.dumps(
+            [(r.part_key.schema, r.part_key.labels, r.timestamp,
+              tuple(v.tolist() if isinstance(v, np.ndarray) else v for v in r.values))
+             for r in self.records],
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        return struct.pack("<BI", 1, len(payload)) + payload
+
+    @staticmethod
+    def deserialize(data: bytes, schemas: Schemas | None = None) -> "RecordContainer":
+        ver, ln = struct.unpack_from("<BI", data, 0)
+        assert ver == 1
+        raw = pickle.loads(data[5 : 5 + ln])
+        c = RecordContainer()
+        for schema, labels, ts, values in raw:
+            vals = tuple(np.asarray(v, np.int64) if isinstance(v, list) else v
+                         for v in values)
+            c.add(IngestRecord(PartKey(schema, labels), ts, vals))
+        return c
+
+
+@dataclass(frozen=True)
+class SomeData:
+    """A container together with its log offset (reference ``SomeData``)."""
+
+    container: RecordContainer
+    offset: int

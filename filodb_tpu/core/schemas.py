@@ -1,0 +1,167 @@
+"""Dataset schemas and column metadata.
+
+Counterpart of the reference's schema system
+(``core/src/main/scala/filodb.core/metadata/Schemas.scala:29,58,170,258``,
+``Column.scala:94-103``) and its default schema config
+(``core/src/main/resources/filodb-defaults.conf:23-110``): ``gauge``,
+``untyped``, ``prom-counter``, ``prom-histogram`` and the downsample schemas.
+
+Schemas carry a stable 16-bit schema id (hash of name + column types) used to
+tag ingest records and chunks, mirroring ``RecordSchema.schemaID``.
+"""
+
+from __future__ import annotations
+
+import enum
+import zlib
+from dataclasses import dataclass, field
+
+
+class ColumnType(enum.Enum):
+    TIMESTAMP = "ts"
+    DOUBLE = "double"
+    LONG = "long"
+    INT = "int"
+    HISTOGRAM = "hist"
+    STRING = "string"
+    MAP = "map"
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    ctype: ColumnType
+    # detectDrops: counter columns get reset-correction in rate/increase
+    is_counter: bool = False
+
+
+@dataclass(frozen=True)
+class DataSchema:
+    """Column layout of a time series row. Column 0 is always the timestamp."""
+
+    name: str
+    columns: tuple[Column, ...]
+    value_column: int  # index of the default value column for queries
+    downsamplers: tuple[str, ...] = ()  # e.g. ("tTime(0)", "dMin(1)", ...)
+    downsample_schema: str | None = None
+
+    def __post_init__(self):
+        assert self.columns[0].ctype == ColumnType.TIMESTAMP, "col 0 must be timestamp"
+
+    @property
+    def value_col_name(self) -> str:
+        return self.columns[self.value_column].name
+
+
+@dataclass(frozen=True)
+class PartitionSchema:
+    """Partition-key layout: which labels form the shard key.
+
+    Reference: ``PartitionSchema`` with predefined keys and shard-key columns
+    (``filodb-defaults.conf`` ``partition-schema`` + ``shard-key-columns``).
+    """
+
+    shard_key_labels: tuple[str, ...] = ("_ws_", "_ns_", "_metric_")
+    predefined_labels: tuple[str, ...] = (
+        "_ws_", "_ns_", "_metric_", "app", "instance", "host", "le", "job",
+    )
+
+
+@dataclass(frozen=True)
+class Schema:
+    data: DataSchema
+    part: PartitionSchema = field(default_factory=PartitionSchema)
+
+    @property
+    def name(self) -> str:
+        return self.data.name
+
+    @property
+    def schema_id(self) -> int:
+        sig = self.data.name + "|" + ",".join(
+            f"{c.name}:{c.ctype.value}" for c in self.data.columns
+        )
+        return zlib.crc32(sig.encode()) & 0xFFFF
+
+
+def _mk(name, cols, value_column, downsamplers=(), ds_schema=None) -> Schema:
+    return Schema(DataSchema(name, tuple(cols), value_column, tuple(downsamplers),
+                             ds_schema))
+
+
+GAUGE = _mk(
+    "gauge",
+    [Column("timestamp", ColumnType.TIMESTAMP), Column("value", ColumnType.DOUBLE)],
+    value_column=1,
+    downsamplers=["tTime(0)", "dMin(1)", "dMax(1)", "dSum(1)", "dCount(1)", "dAvg(1)"],
+    ds_schema="ds-gauge",
+)
+
+UNTYPED = _mk(
+    "untyped",
+    [Column("timestamp", ColumnType.TIMESTAMP), Column("value", ColumnType.DOUBLE)],
+    value_column=1,
+)
+
+PROM_COUNTER = _mk(
+    "prom-counter",
+    [Column("timestamp", ColumnType.TIMESTAMP),
+     Column("value", ColumnType.DOUBLE, is_counter=True)],
+    value_column=1,
+    downsamplers=["tTime(0)", "dLast(1)"],
+    ds_schema="prom-counter",
+)
+
+PROM_HISTOGRAM = _mk(
+    "prom-histogram",
+    [Column("timestamp", ColumnType.TIMESTAMP),
+     Column("sum", ColumnType.DOUBLE, is_counter=True),
+     Column("count", ColumnType.DOUBLE, is_counter=True),
+     Column("h", ColumnType.HISTOGRAM, is_counter=True)],
+    value_column=3,
+    downsamplers=["tTime(0)", "dLast(1)", "dLast(2)", "hLast(3)"],
+    ds_schema="prom-histogram",
+)
+
+DS_GAUGE = _mk(
+    "ds-gauge",
+    [Column("timestamp", ColumnType.TIMESTAMP),
+     Column("min", ColumnType.DOUBLE),
+     Column("max", ColumnType.DOUBLE),
+     Column("sum", ColumnType.DOUBLE),
+     Column("count", ColumnType.DOUBLE),
+     Column("avg", ColumnType.DOUBLE)],
+    value_column=5,
+)
+
+
+class Schemas:
+    """Registry of schemas, lookup by name or id (reference ``Schemas.scala:258``)."""
+
+    def __init__(self, schemas: list[Schema] | None = None):
+        self._by_name: dict[str, Schema] = {}
+        self._by_id: dict[int, Schema] = {}
+        for s in schemas or [GAUGE, UNTYPED, PROM_COUNTER, PROM_HISTOGRAM, DS_GAUGE]:
+            self.register(s)
+
+    def register(self, s: Schema) -> None:
+        if s.schema_id in self._by_id and self._by_id[s.schema_id].name != s.name:
+            raise ValueError(f"schema id clash: {s.name} vs {self._by_id[s.schema_id].name}")
+        self._by_name[s.name] = s
+        self._by_id[s.schema_id] = s
+
+    def __getitem__(self, name: str) -> Schema:
+        return self._by_name[name]
+
+    def by_id(self, sid: int) -> Schema:
+        return self._by_id[sid]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._by_name
+
+    @property
+    def all(self) -> list[Schema]:
+        return list(self._by_name.values())
+
+
+DEFAULT_SCHEMAS = Schemas()

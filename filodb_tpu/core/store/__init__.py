@@ -1,0 +1,4 @@
+"""Store APIs: chunk sources/sinks, column store, meta store, configs.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/store/``.
+"""

@@ -1,0 +1,156 @@
+"""Chunk source/sink, column-store and meta-store interfaces plus in-memory
+implementations.
+
+Counterparts:
+- ``ChunkSource``/``ChunkSink``/``ColumnStore`` —
+  ``core/src/main/scala/filodb.core/store/ChunkSource.scala:66``,
+  ``ChunkSink.scala:21``, ``ColumnStore.scala:59``
+- ``MetaStore`` (checkpoints) — ``core/.../store/MetaStore.scala:14,48,67``
+- ``NullColumnStore`` test fake — ``ChunkSink.scala:116``
+- ``InMemoryMetaStore`` — ``core/.../store/InMemoryMetaStore.scala``
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.memory.chunk import Chunk
+
+
+@dataclass(frozen=True)
+class PartKeyRecord:
+    part_key: PartKey
+    start_time: int
+    end_time: int
+
+
+class ColumnStore:
+    """Durable store of encoded chunks + part keys, per (dataset, shard)."""
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        raise NotImplementedError
+
+    def write_chunks(self, dataset: str, shard: int, part_key: PartKey,
+                     chunks: list[Chunk], ingestion_time: int) -> None:
+        raise NotImplementedError
+
+    def read_chunks(self, dataset: str, shard: int, part_key: PartKey,
+                    start_time: int, end_time: int) -> list[Chunk]:
+        raise NotImplementedError
+
+    def write_part_keys(self, dataset: str, shard: int,
+                        records: list[PartKeyRecord]) -> None:
+        raise NotImplementedError
+
+    def scan_part_keys(self, dataset: str, shard: int) -> list[PartKeyRecord]:
+        raise NotImplementedError
+
+    def scan_chunks_by_ingestion_time(self, dataset: str, shard: int,
+                                      start: int, end: int):
+        """Yield (part_key, chunks) whose ingestion time falls in [start, end)
+        — the downsampler's scan (reference ``IngestionTimeIndexTable``)."""
+        raise NotImplementedError
+
+    def truncate(self, dataset: str) -> None:
+        raise NotImplementedError
+
+
+class MetaStore:
+    """Cluster metadata + ingestion checkpoints."""
+
+    def write_checkpoint(self, dataset: str, shard: int, group: int,
+                         offset: int) -> None:
+        raise NotImplementedError
+
+    def read_checkpoints(self, dataset: str, shard: int) -> dict[int, int]:
+        raise NotImplementedError
+
+    def read_earliest_checkpoint(self, dataset: str, shard: int) -> int:
+        cps = self.read_checkpoints(dataset, shard)
+        return min(cps.values()) if cps else -1
+
+
+class NullColumnStore(ColumnStore):
+    """Discards chunks; for tests/benchmarks (reference ``NullColumnStore``)."""
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        pass
+
+    def write_chunks(self, dataset, shard, part_key, chunks, ingestion_time):
+        pass
+
+    def read_chunks(self, dataset, shard, part_key, start_time, end_time):
+        return []
+
+    def write_part_keys(self, dataset, shard, records):
+        pass
+
+    def scan_part_keys(self, dataset, shard):
+        return []
+
+    def scan_chunks_by_ingestion_time(self, dataset, shard, start, end):
+        return iter(())
+
+    def truncate(self, dataset):
+        pass
+
+
+class InMemoryColumnStore(ColumnStore):
+    """Keeps everything in process memory; the recovery/ODP test double."""
+
+    def __init__(self):
+        # (dataset, shard) -> part_key -> list[(ingestion_time, Chunk)]
+        self._chunks = defaultdict(lambda: defaultdict(list))
+        self._part_keys: dict[tuple, dict[PartKey, PartKeyRecord]] = defaultdict(dict)
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        pass
+
+    def write_chunks(self, dataset, shard, part_key, chunks, ingestion_time):
+        store = self._chunks[(dataset, shard)][part_key]
+        existing = {c.id for _, c in store}
+        for c in chunks:
+            if c.id not in existing:
+                store.append((ingestion_time, c))
+
+    def read_chunks(self, dataset, shard, part_key, start_time, end_time):
+        out = [c for _, c in self._chunks[(dataset, shard)].get(part_key, [])
+               if c.end_time >= start_time and c.start_time <= end_time]
+        return sorted(out, key=lambda c: c.id)
+
+    def write_part_keys(self, dataset, shard, records):
+        d = self._part_keys[(dataset, shard)]
+        for r in records:
+            prev = d.get(r.part_key)
+            if prev is not None:
+                r = PartKeyRecord(r.part_key, min(prev.start_time, r.start_time),
+                                  r.end_time)
+            d[r.part_key] = r
+
+    def scan_part_keys(self, dataset, shard):
+        return list(self._part_keys[(dataset, shard)].values())
+
+    def scan_chunks_by_ingestion_time(self, dataset, shard, start, end):
+        for pk, entries in self._chunks[(dataset, shard)].items():
+            sel = [c for t, c in entries if start <= t < end]
+            if sel:
+                yield pk, sorted(sel, key=lambda c: c.id)
+
+    def truncate(self, dataset):
+        for key in [k for k in self._chunks if k[0] == dataset]:
+            del self._chunks[key]
+        for key in [k for k in self._part_keys if k[0] == dataset]:
+            del self._part_keys[key]
+
+
+class InMemoryMetaStore(MetaStore):
+    def __init__(self):
+        self._checkpoints: dict[tuple, dict[int, int]] = defaultdict(dict)
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        self._checkpoints[(dataset, shard)][group] = offset
+
+    def read_checkpoints(self, dataset, shard):
+        return dict(self._checkpoints[(dataset, shard)])

@@ -1,0 +1,35 @@
+"""Store and ingestion configuration.
+
+Counterpart of reference ``StoreConfig``/``IngestionConfig``
+(``core/src/main/scala/filodb.core/store/IngestionConfig.scala:1-211``) and the
+per-dataset source config (``conf/timeseries-dev-source.conf:1-111``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class StoreConfig:
+    flush_interval_ms: int = 3_600_000          # flush-interval = 1h
+    max_chunk_size: int = 400                   # max-chunks-size: samples/chunk
+    groups_per_shard: int = 20                  # flush groups (reference: 20 dev)
+    shard_mem_mb: int = 256                     # shard-mem-size
+    disk_ttl_ms: int = 3 * 24 * 3_600_000       # disk-time-to-live
+    retention_ms: int = 3 * 24 * 3_600_000      # in-memory retention before purge
+    flush_task_parallelism: int = 2
+    demand_paging_enabled: bool = True
+    max_query_matches: int = 250_000
+    # evicted part-key bloom/tracking capacity
+    evicted_pk_bloom_filter_capacity: int = 50_000
+
+
+@dataclass(frozen=True)
+class IngestionConfig:
+    dataset: str
+    num_shards: int = 4
+    min_num_nodes: int = 1
+    source_factory: str = "in-proc"             # reference sourcefactory class
+    source_config: dict = field(default_factory=dict)
+    store: StoreConfig = field(default_factory=StoreConfig)

@@ -1,0 +1,92 @@
+"""Chunk page format: an immutable, compressed set of rows for one partition.
+
+Counterpart of the reference's ChunkSet/ChunkSetInfo
+(``core/src/main/scala/filodb.core/store/ChunkSetInfo.scala:31,60``): a chunk
+is one encoded vector per data column plus metadata (id, numRows, startTime,
+endTime). Chunk ids are derived from the first timestamp so they sort by time
+(reference ``ChunkSetInfo.chunkID``).
+
+Serialization is a simple length-prefixed layout used by the column store and
+the wire protocol.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from filodb_tpu.core.schemas import ColumnType, Schema
+from filodb_tpu.memory import codecs
+
+
+def chunk_id(start_time: int, ingestion_seq: int = 0) -> int:
+    """Time-sortable chunk id: millis in high bits, sequence in low 12 bits."""
+    return (start_time << 12) | (ingestion_seq & 0xFFF)
+
+
+@dataclass(frozen=True)
+class Chunk:
+    """One encoded chunkset for a partition."""
+
+    id: int
+    num_rows: int
+    start_time: int
+    end_time: int
+    vectors: tuple[bytes, ...]  # one encoded vector per data column
+
+    @property
+    def nbytes(self) -> int:
+        return sum(len(v) for v in self.vectors)
+
+    def decode_column(self, i: int):
+        return codecs.decode_any(self.vectors[i])
+
+    def serialize(self) -> bytes:
+        head = struct.pack("<qIqqI", self.id, self.num_rows, self.start_time,
+                           self.end_time, len(self.vectors))
+        parts = [head]
+        for v in self.vectors:
+            parts.append(struct.pack("<I", len(v)))
+            parts.append(v)
+        return b"".join(parts)
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Chunk":
+        cid, rows, st, et, nvec = struct.unpack_from("<qIqqI", data, 0)
+        off = struct.calcsize("<qIqqI")
+        vectors = []
+        for _ in range(nvec):
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            vectors.append(data[off : off + ln])
+            off += ln
+        return Chunk(cid, rows, st, et, tuple(vectors))
+
+
+def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0) -> Chunk:
+    """Encode one chunkset from appender contents.
+
+    ``columns`` holds one array per non-timestamp data column, in schema order:
+    float64 arrays for DOUBLE, int64 for LONG/INT, (n, nb) int64 for HISTOGRAM,
+    list[str] for STRING.
+    """
+    assert len(ts) > 0
+    vectors: list[bytes] = [codecs.encode_delta_delta(ts)]
+    for col, data in zip(schema.data.columns[1:], columns):
+        if col.ctype == ColumnType.DOUBLE:
+            vectors.append(codecs.encode_xor_double(np.asarray(data, np.float64)))
+        elif col.ctype in (ColumnType.LONG, ColumnType.INT, ColumnType.TIMESTAMP):
+            vectors.append(codecs.encode_delta_delta(np.asarray(data, np.int64)))
+        elif col.ctype == ColumnType.HISTOGRAM:
+            if isinstance(data, codecs.HistogramColumn):
+                vectors.append(codecs.encode_hist_2d_delta(data.rows, data.les))
+            else:
+                vectors.append(codecs.encode_hist_2d_delta(np.asarray(data, np.int64)))
+        elif col.ctype == ColumnType.STRING:
+            vectors.append(codecs.encode_dict_string(list(data)))
+        else:
+            raise ValueError(f"unsupported column type {col.ctype}")
+    return Chunk(chunk_id(int(ts[0]), seq), len(ts), int(ts[0]), int(ts[-1]),
+                 tuple(vectors))

@@ -87,8 +87,17 @@ def decode_xor_double(data: bytes) -> np.ndarray:
     return bits.view(np.float64)
 
 
-def encode_hist_2d_delta(rows: np.ndarray) -> bytes:
-    """Encode histogram rows [n, num_buckets] (cumulative bucket counts, int64).
+@dataclass(frozen=True)
+class HistogramColumn:
+    """Decoded histogram vector: bucket upper bounds + cumulative count rows."""
+
+    les: np.ndarray  # (nb,) float64 bucket upper bounds ("le" values)
+    rows: np.ndarray  # (n, nb) int64 cumulative counts per row
+
+
+def encode_hist_2d_delta(rows: np.ndarray, les: np.ndarray | None = None) -> bytes:
+    """Encode histogram rows [n, num_buckets] (cumulative bucket counts, int64)
+    plus the shared bucket-bound scheme.
 
     2D delta: within a row take deltas across buckets (cumulative -> per-bucket),
     then across time subtract the previous row's bucket deltas. Residuals can be
@@ -96,23 +105,29 @@ def encode_hist_2d_delta(rows: np.ndarray) -> bytes:
     """
     r = np.ascontiguousarray(rows, dtype=np.int64)
     n, nb = r.shape if r.ndim == 2 else (0, 0)
+    if les is None:
+        les = np.zeros(nb, dtype=np.float64)
+    les = np.ascontiguousarray(les, dtype=np.float64)
+    head = struct.pack("<BII", CODEC_HIST_2D_DELTA, n, nb) + les.tobytes()
     if n == 0:
-        return struct.pack("<BII", CODEC_HIST_2D_DELTA, 0, 0)
+        return head
     bucket_deltas = np.diff(r, axis=1, prepend=0)
     time_deltas = np.diff(bucket_deltas, axis=0, prepend=np.zeros((1, nb), np.int64))
-    packed = nibble_pack(zigzag_encode(time_deltas.ravel()))
-    return struct.pack("<BII", CODEC_HIST_2D_DELTA, n, nb) + packed
+    return head + nibble_pack(zigzag_encode(time_deltas.ravel()))
 
 
-def decode_hist_2d_delta(data: bytes) -> np.ndarray:
+def decode_hist_2d_delta(data: bytes) -> HistogramColumn:
     codec, n, nb = struct.unpack_from("<BII", data, 0)
     assert codec == CODEC_HIST_2D_DELTA, f"bad codec {codec}"
+    off = struct.calcsize("<BII")
+    les = np.frombuffer(data, dtype=np.float64, count=nb, offset=off).copy()
+    off += nb * 8
     if n == 0:
-        return np.zeros((0, 0), dtype=np.int64)
-    flat = zigzag_decode(nibble_unpack(data[struct.calcsize("<BII") :], n * nb))
+        return HistogramColumn(les, np.zeros((0, nb), dtype=np.int64))
+    flat = zigzag_decode(nibble_unpack(data[off:], n * nb))
     time_deltas = flat.reshape(n, nb)
     bucket_deltas = np.cumsum(time_deltas, axis=0)
-    return np.cumsum(bucket_deltas, axis=1)
+    return HistogramColumn(les, np.cumsum(bucket_deltas, axis=1))
 
 
 def encode_dict_string(values: list[str]) -> bytes:

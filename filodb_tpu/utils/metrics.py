@@ -1,0 +1,103 @@
+"""Minimal in-process metrics registry.
+
+Counterpart of the reference's Kamon counters/gauges/histograms
+(``TimeSeriesShardStats``, ``KamonLogger.scala``): a process-wide registry that
+the HTTP server exposes in Prometheus text exposition format (the reference's
+"metrics sink" concept, ``README.md:860-876``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_registry: dict[str, "Metric"] = {}
+_lock = threading.Lock()
+
+
+class Metric:
+    def __init__(self, name: str, tags: dict[str, str] | None = None):
+        self.name = name
+        self.tags = tags or {}
+        key = self._key()
+        with _lock:
+            _registry[key] = self
+
+    def _key(self) -> str:
+        t = ",".join(f"{k}={v}" for k, v in sorted(self.tags.items()))
+        return f"{self.name}{{{t}}}"
+
+
+class Counter(Metric):
+    def __init__(self, name: str, tags: dict[str, str] | None = None):
+        super().__init__(name, tags)
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge(Metric):
+    def __init__(self, name: str, tags: dict[str, str] | None = None):
+        super().__init__(name, tags)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Histogram(Metric):
+    """Fixed-boundary latency histogram (seconds)."""
+
+    BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+              1.0, 2.5, 5.0, 10.0)
+
+    def __init__(self, name: str, tags: dict[str, str] | None = None):
+        super().__init__(name, tags)
+        self.buckets = defaultdict(int)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        for b in self.BOUNDS:
+            if v <= b:
+                self.buckets[b] += 1
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0)
+
+
+def render_prometheus() -> str:
+    """Expose all metrics in Prometheus text format."""
+    lines = []
+    with _lock:
+        metrics = list(_registry.values())
+    for m in metrics:
+        tagstr = ",".join(f'{k}="{v}"' for k, v in sorted(m.tags.items()))
+        tagstr = f"{{{tagstr}}}" if tagstr else ""
+        if isinstance(m, Counter):
+            lines.append(f"{m.name}_total{tagstr} {m.value}")
+        elif isinstance(m, Gauge):
+            lines.append(f"{m.name}{tagstr} {m.value}")
+        elif isinstance(m, Histogram):
+            for b in Histogram.BOUNDS:
+                t = tagstr[:-1] + f',le="{b}"}}' if tagstr else f'{{le="{b}"}}'
+                lines.append(f"{m.name}_bucket{t} {m.buckets.get(b, 0)}")
+            lines.append(f"{m.name}_count{tagstr} {m.count}")
+            lines.append(f"{m.name}_sum{tagstr} {m.sum}")
+    return "\n".join(lines) + "\n"

@@ -146,18 +146,21 @@ class TestHist2DDelta:
         rng = np.random.default_rng(5)
         incr = rng.integers(0, 10, size=(50, 8))
         rows = np.cumsum(np.cumsum(incr, axis=0), axis=1).astype(np.int64)
-        enc = encode_hist_2d_delta(rows)
-        np.testing.assert_array_equal(decode_hist_2d_delta(enc), rows)
+        les = np.arange(8, dtype=np.float64)
+        enc = encode_hist_2d_delta(rows, les)
+        out = decode_hist_2d_delta(enc)
+        np.testing.assert_array_equal(out.rows, rows)
+        np.testing.assert_array_equal(out.les, les)
         assert len(enc) < rows.nbytes / 4
 
     def test_counter_reset(self):
         rows = np.array([[5, 10, 15], [7, 12, 20], [1, 2, 3]], dtype=np.int64)
         np.testing.assert_array_equal(
-            decode_hist_2d_delta(encode_hist_2d_delta(rows)), rows)
+            decode_hist_2d_delta(encode_hist_2d_delta(rows)).rows, rows)
 
     def test_empty(self):
         rows = np.zeros((0, 0), dtype=np.int64)
-        assert decode_hist_2d_delta(encode_hist_2d_delta(rows)).size == 0
+        assert decode_hist_2d_delta(encode_hist_2d_delta(rows)).rows.size == 0
 
 
 class TestDictString:
