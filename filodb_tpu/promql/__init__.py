@@ -1,0 +1,8 @@
+"""PromQL front end: lexer, parser, AST → LogicalPlan.
+
+Counterpart of reference ``prometheus/`` module (ANTLR grammar
+``prometheus/src/main/java/filodb/prometheus/antlr/PromQL.g4``, legacy parser
+``parse/LegacyParser.scala``, AST package ``ast/``).
+"""
+
+from filodb_tpu.promql.parser import parse_query  # noqa: F401

@@ -1,0 +1,7 @@
+"""Query engine: logical plans, exec plans, and the TPU compute kernels.
+
+Counterpart of reference ``query/`` (LogicalPlan/ExecPlan/range functions) —
+redesigned so the hot path (windowed range functions + label aggregation) runs
+as jitted JAX kernels over dense batched tensors instead of per-sample
+iterators.
+"""

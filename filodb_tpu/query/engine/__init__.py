@@ -1,0 +1,22 @@
+"""The TPU execution engine.
+
+Replaces the reference's per-sample sliding-window machinery
+(``query/src/main/scala/filodb/query/exec/PeriodicSamplesMapper.scala``,
+``rangefn/RangeFunction.scala``, ``rangefn/AggrOverTimeFunctions.scala``) with
+a dense, batched formulation designed for XLA/TPU:
+
+1. Selected partitions' chunks are decoded into a padded ``SeriesBatch``:
+   ``ts[P, S]`` (int32 millis relative to a base), ``vals[P, S]`` and
+   per-series counts. Padding sits at +INT32_MAX so binary search never
+   selects it.
+2. A one-time ``precompute`` pass builds exclusive prefix sums (values,
+   squares, counter-reset corrections, change/reset indicators) and sparse
+   min/max tables — O(P·S).
+3. Each output step's window reduces to O(1) gathers: window boundaries come
+   from a vectorized binary search, windowed sums from prefix-sum differences,
+   min/max from the sparse tables, rate/increase from first/last gathers with
+   Prometheus counter-reset correction + extrapolation.
+
+Total work is O(P·(S + K·log S)) with perfect batching across series — no
+data-dependent control flow, fully jittable, shardable over a device mesh.
+"""

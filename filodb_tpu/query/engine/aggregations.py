@@ -1,0 +1,142 @@
+"""Cross-series (label-grouped) aggregation kernels.
+
+Counterpart of the reference's RowAggregators
+(``query/src/main/scala/filodb/query/exec/aggregator/RowAggregator.scala`` and
+its sum/min/max/count/avg/stddev/topk/quantile/count_values impls) — lowered
+to ``jax.ops.segment_*`` over a host-computed group-id vector, as scoped by the
+north star (AggregateMapReduce → ``segment_sum``).
+
+Inputs are [P, K] step matrices with NaN = absent; NaN entries are excluded
+from every aggregate, matching Prometheus semantics where a series without a
+sample at a step simply doesn't participate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from filodb_tpu.query.engine.kernels import fdtype
+
+
+@partial(jax.jit, static_argnames=("op", "num_groups"))
+def aggregate(op: str, values, group_ids, num_groups: int, param=0.0):
+    """Aggregate [P, K] -> [G, K] by group id.
+
+    op: sum|min|max|count|avg|group|stddev|stdvar|count_values is host-side.
+    """
+    dt = fdtype()
+    values = values.astype(dt)
+    present = ~jnp.isnan(values)
+    zeroed = jnp.where(present, values, 0.0)
+    cnt = jax.ops.segment_sum(present.astype(dt), group_ids, num_groups)
+    nan = jnp.array(jnp.nan, dt)
+
+    if op == "count":
+        return jnp.where(cnt > 0, cnt, nan)
+    if op == "group":
+        return jnp.where(cnt > 0, 1.0, nan).astype(dt)
+    if op in ("sum", "avg", "stddev", "stdvar"):
+        s = jax.ops.segment_sum(zeroed, group_ids, num_groups)
+        if op == "sum":
+            return jnp.where(cnt > 0, s, nan)
+        mean = s / jnp.maximum(cnt, 1.0)
+        if op == "avg":
+            return jnp.where(cnt > 0, mean, nan)
+        s2 = jax.ops.segment_sum(zeroed * zeroed, group_ids, num_groups)
+        var = jnp.maximum(s2 / jnp.maximum(cnt, 1.0) - mean * mean, 0.0)
+        if op == "stdvar":
+            return jnp.where(cnt > 0, var, nan)
+        return jnp.where(cnt > 0, jnp.sqrt(var), nan)
+    if op == "min":
+        m = jax.ops.segment_min(jnp.where(present, values, jnp.inf),
+                                group_ids, num_groups)
+        return jnp.where(cnt > 0, m, nan)
+    if op == "max":
+        m = jax.ops.segment_max(jnp.where(present, values, -jnp.inf),
+                                group_ids, num_groups)
+        return jnp.where(cnt > 0, m, nan)
+    raise ValueError(f"unknown aggregation {op}")
+
+
+@partial(jax.jit, static_argnames=("k", "num_groups", "bottom"))
+def topk_mask(values, group_ids, num_groups: int, k: int, bottom: bool = False):
+    """Boolean [P, K] mask selecting each group's top/bottom-k series per step.
+
+    Counterpart of the reference's TopBottomK RowAggregator (priority queues);
+    here a vmapped ``lax.top_k`` per group over the series axis.
+    """
+    dt = fdtype()
+    v = values.astype(dt)
+    sign = -1.0 if bottom else 1.0
+    masked_all = jnp.where(jnp.isnan(v), -jnp.inf, sign * v)  # [P, K]
+
+    def per_group(g):
+        vg = jnp.where(group_ids[:, None] == g, masked_all, -jnp.inf)  # [P, K]
+        kk = min(k, vg.shape[0])
+        top = jax.lax.top_k(vg.T, kk)[0]  # [K, kk] descending
+        thr = top[:, kk - 1]  # k-th largest per step
+        sel = (vg >= thr[None, :]) & jnp.isfinite(vg)
+        return sel
+
+    sels = jax.vmap(per_group)(jnp.arange(num_groups))  # [G, P, K]
+    return jnp.any(sels, axis=0)
+
+
+@partial(jax.jit, static_argnames=("num_groups",))
+def quantile_across(q, values, group_ids, num_groups: int):
+    """phi-quantile across the series of each group, per step."""
+    dt = fdtype()
+    v = values.astype(dt)
+    P = v.shape[0]
+
+    def per_group(g):
+        in_g = (group_ids == g)[:, None] & ~jnp.isnan(v)
+        masked = jnp.where(in_g, v, jnp.inf)
+        srt = jnp.sort(masked, axis=0)  # [P, K]
+        n = jnp.sum(in_g, axis=0).astype(dt)  # [K]
+        pos = q * jnp.maximum(n - 1.0, 0.0)
+        i0 = jnp.floor(pos).astype(jnp.int32)
+        frac = (pos - i0)[None, :]
+        a = jnp.take_along_axis(srt, i0[None, :], axis=0)
+        b = jnp.take_along_axis(srt, jnp.minimum(i0 + 1, P - 1)[None, :], axis=0)
+        out = (a + (b - a) * frac)[0]
+        return jnp.where(n > 0, out, jnp.nan)
+
+    return jax.vmap(per_group)(jnp.arange(num_groups))  # [G, K]
+
+
+@jax.jit
+def histogram_quantile(q, bucket_rates, les):
+    """Prometheus histogram_quantile over first-class histogram step values.
+
+    bucket_rates: [..., B] cumulative-bucket values per step (e.g. the output
+    of rate() applied per bucket); les: [B] upper bounds, last = +Inf.
+    Linear interpolation within the located bucket, reference
+    ``HistogramQuantileMapper.scala`` / promql ``bucketQuantile``.
+    """
+    dt = fdtype()
+    h = bucket_rates.astype(dt)
+    les = les.astype(dt)
+    B = h.shape[-1]
+    total = h[..., B - 1]
+    rank = q * total
+    # first bucket with cumulative count >= rank
+    ge = h >= rank[..., None]
+    idx = jnp.argmax(ge, axis=-1)
+    cum_hi = jnp.take_along_axis(h, idx[..., None], -1)[..., 0]
+    cum_lo = jnp.where(idx > 0,
+                       jnp.take_along_axis(h, jnp.maximum(idx - 1, 0)[..., None],
+                                           -1)[..., 0], 0.0)
+    le_hi = les[idx]
+    le_lo = jnp.where(idx > 0, les[jnp.maximum(idx - 1, 0)], 0.0)
+    frac = (rank - cum_lo) / jnp.maximum(cum_hi - cum_lo, 1e-30)
+    val = le_lo + (le_hi - le_lo) * frac
+    # highest bucket: return le of the second-highest bound
+    val = jnp.where(idx >= B - 1, les[jnp.maximum(B - 2, 0)], val)
+    val = jnp.where(total > 0, val, jnp.nan)
+    val = jnp.where(jnp.isnan(total), jnp.nan, val)
+    return jnp.where((q < 0) | (q > 1),
+                     jnp.where(q < 0, -jnp.inf, jnp.inf), val)

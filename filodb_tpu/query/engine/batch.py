@@ -1,0 +1,108 @@
+"""SeriesBatch: the dense tensor form of a set of time series.
+
+The bridge between the host-side chunk store and the TPU kernels. Decoding
+(host, C++/numpy codecs) happens once per query per partition; the result is
+packed into padded arrays whose shapes are bucketed (next power of two) so XLA
+compilation caches are reused across queries.
+
+Timestamps are rebased to ``base_ts`` and stored as int32 milliseconds —
+queries spanning more than ~24 days are split by the planner (reference analog:
+time-split planning, ``SingleClusterPlanner.materializeTimeSplitPlan``).
+NaN samples (staleness markers) are filtered host-side so kernels may assume
+every in-count sample is valid.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+from filodb_tpu.memory.codecs import HistogramColumn
+
+TS_PAD = np.iinfo(np.int32).max
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
+@dataclass
+class SeriesBatch:
+    """Padded batch of P series with up to S samples each.
+
+    ``ts``/``vals`` are numpy here; kernels convert to device arrays. For
+    histogram batches ``vals`` has shape [P, S, B] and ``les`` [B].
+    """
+
+    base_ts: int                      # epoch ms subtracted from all timestamps
+    ts: np.ndarray                    # int32 [P, S], padded with TS_PAD
+    vals: np.ndarray                  # float [P, S] or [P, S, B]
+    counts: np.ndarray                # int32 [P]
+    part_ids: list[int]               # originating partition ids (host metadata)
+    les: np.ndarray | None = None     # [B] bucket bounds for histogram batches
+
+    @property
+    def num_series(self) -> int:
+        return len(self.part_ids)
+
+    @property
+    def is_histogram(self) -> bool:
+        return self.vals.ndim == 3
+
+
+def build_batch(partitions: list[TimeSeriesPartition], start: int, end: int,
+                value_col: int | None = None, pad_series: bool = True,
+                pad_samples: bool = True) -> SeriesBatch:
+    """Decode chunks overlapping [start, end] into a SeriesBatch.
+
+    ``start`` already includes the lookback/window extension; ``base_ts`` is
+    set to ``start`` so all in-range offsets are non-negative.
+    """
+    per_ts: list[np.ndarray] = []
+    per_vals: list = []
+    les = None
+    for p in partitions:
+        ts, vals = p.read_samples(start, end, value_col)
+        if isinstance(vals, HistogramColumn):
+            les = vals.les if les is None or len(vals.les) > len(les) else les
+            rows = vals.rows.astype(np.float64)
+            per_ts.append(ts)
+            per_vals.append(rows)
+        else:
+            valid = ~np.isnan(vals)
+            per_ts.append(ts[valid])
+            per_vals.append(vals[valid])
+
+    P = len(partitions)
+    maxS = max((len(t) for t in per_ts), default=0)
+    S = _next_pow2(maxS) if pad_samples else max(maxS, 1)
+    Pp = _next_pow2(P) if pad_series else max(P, 1)
+    ts_arr = np.full((Pp, S), TS_PAD, np.int32)
+    if les is not None:
+        B = len(les)
+        vals_arr = np.zeros((Pp, S, B), np.float64)
+    else:
+        vals_arr = np.full((Pp, S), np.nan, np.float64)
+    counts = np.zeros(Pp, np.int32)
+    for i, (t, v) in enumerate(zip(per_ts, per_vals)):
+        n = len(t)
+        counts[i] = n
+        if n:
+            ts_arr[i, :n] = (t - start).astype(np.int32)
+            if les is not None and v.shape[-1] != vals_arr.shape[-1]:
+                vals_arr[i, :n, : v.shape[-1]] = v  # smaller historic scheme
+            else:
+                vals_arr[i, :n] = v
+    return SeriesBatch(start, ts_arr, vals_arr, counts,
+                       [p.part_id for p in partitions], les)
+
+
+def empty_batch() -> SeriesBatch:
+    return SeriesBatch(0, np.full((1, 1), TS_PAD, np.int32),
+                       np.full((1, 1), np.nan, np.float64),
+                       np.zeros(1, np.int32), [])

@@ -1,0 +1,151 @@
+"""Instant (elementwise) functions and scalar/vector binary operators.
+
+Counterpart of reference ``rangefn/InstantFunction.scala:1-383`` (~30 functions,
+``PlanEnums.InstantFunctionId``) and ``BinaryOperator`` evaluation inside
+``ScalarOperationMapper``/``BinaryJoinExec``. Everything is elementwise on the
+[P, K] step matrices, so these are plain jnp ops fused by XLA into the
+surrounding kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _days_in_month(y, m):
+    # y, m float arrays; gregorian rules
+    thirty_one = jnp.isin(m, jnp.array([1, 3, 5, 7, 8, 10, 12]))
+    thirty = jnp.isin(m, jnp.array([4, 6, 9, 11]))
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    feb = jnp.where(leap, 29.0, 28.0)
+    return jnp.where(thirty_one, 31.0, jnp.where(thirty, 30.0, feb))
+
+
+def _civil_from_epoch_days(z):
+    """Epoch days -> (year, month, day) via Howard Hinnant's algorithm,
+    vectorized."""
+    z = z + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - jnp.floor_divide(doe, 1460)
+                           + jnp.floor_divide(doe, 36524)
+                           - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def apply_instant_fn(fn: str, values, epoch_ts_s=None, params=()):
+    """values: [P, K]; epoch_ts_s: [K] step times (seconds) for time fns."""
+    v = values
+    if fn == "abs":
+        return jnp.abs(v)
+    if fn == "ceil":
+        return jnp.ceil(v)
+    if fn == "floor":
+        return jnp.floor(v)
+    if fn == "exp":
+        return jnp.exp(v)
+    if fn == "ln":
+        return jnp.log(v)
+    if fn == "log2":
+        return jnp.log2(v)
+    if fn == "log10":
+        return jnp.log10(v)
+    if fn == "sqrt":
+        return jnp.sqrt(v)
+    if fn == "round":
+        nearest = params[0] if params else 1.0
+        return jnp.round(v / nearest) * nearest
+    if fn == "clamp_min":
+        return jnp.maximum(v, params[0])
+    if fn == "clamp_max":
+        return jnp.minimum(v, params[0])
+    if fn == "clamp":
+        return jnp.clip(v, params[0], params[1])
+    if fn == "sgn":
+        return jnp.sign(v)
+    if fn in ("deg", "degrees"):
+        return jnp.degrees(v)
+    if fn in ("rad", "radians"):
+        return jnp.radians(v)
+    for trig in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+                 "tanh", "asinh", "acosh", "atanh"):
+        if fn == trig:
+            return getattr(jnp, trig)(v)
+    # time component functions operate on the sample timestamps (or the value
+    # when applied to vector(time()) results)
+    if fn in ("hour", "minute", "month", "year", "day_of_month", "day_of_week",
+              "day_of_year", "days_in_month"):
+        t = v  # per promql: argument is a vector of epoch seconds
+        days = jnp.floor_divide(t, 86400.0)
+        secs_of_day = t - days * 86400.0
+        if fn == "hour":
+            return jnp.floor_divide(secs_of_day, 3600.0)
+        if fn == "minute":
+            return jnp.floor_divide(secs_of_day % 3600.0, 60.0)
+        if fn == "day_of_week":
+            return (days + 4) % 7  # epoch day 0 = Thursday
+        y, m, d = _civil_from_epoch_days(days.astype(jnp.int64)
+                                         if days.dtype != jnp.int32
+                                         else days.astype(jnp.int32))
+        if fn == "year":
+            return y.astype(v.dtype)
+        if fn == "month":
+            return m.astype(v.dtype)
+        if fn == "day_of_month":
+            return d.astype(v.dtype)
+        if fn == "days_in_month":
+            return _days_in_month(y, m).astype(v.dtype)
+        if fn == "day_of_year":
+            jan1 = _days_from_civil(y, 1, 1)
+            return (days - jan1 + 1).astype(v.dtype)
+    raise ValueError(f"unknown instant function {fn}")
+
+
+def _days_from_civil(y, m, d):
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+_COMPARISONS = {"==": jnp.equal, "!=": jnp.not_equal, ">": jnp.greater,
+                "<": jnp.less, ">=": jnp.greater_equal, "<=": jnp.less_equal}
+
+
+def apply_binary_op(op: str, lhs, rhs, bool_mode: bool = False):
+    """Arithmetic/comparison binary operator on aligned [..] arrays.
+
+    Comparison without ``bool``: keep lhs value where true, NaN where false
+    (vector filtering). With ``bool``: 1.0/0.0.
+    """
+    if op == "+":
+        return lhs + rhs
+    if op == "-":
+        return lhs - rhs
+    if op == "*":
+        return lhs * rhs
+    if op == "/":
+        return lhs / rhs
+    if op == "%":
+        return jnp.fmod(lhs, rhs)
+    if op == "^":
+        return jnp.power(lhs, rhs)
+    if op == "atan2":
+        return jnp.arctan2(lhs, rhs)
+    if op in _COMPARISONS:
+        c = _COMPARISONS[op](lhs, rhs)
+        both = ~jnp.isnan(lhs) & ~jnp.isnan(rhs)
+        if bool_mode:
+            return jnp.where(both, jnp.where(c, 1.0, 0.0), jnp.nan)
+        return jnp.where(c & both, lhs, jnp.nan)
+    raise ValueError(f"unknown binary operator {op}")

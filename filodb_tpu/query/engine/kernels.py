@@ -1,0 +1,370 @@
+"""Jitted range-function kernels.
+
+Counterpart of the reference's range-function library
+(``query/src/main/scala/filodb/query/exec/rangefn/RangeFunction.scala:1-568``,
+``AggrOverTimeFunctions.scala:1-970``, ``RateFunctions.scala:1-303``) — but
+formulated as dense batched tensor programs instead of per-sample iterators:
+
+- window boundaries: vectorized binary search over padded ts arrays
+- windowed sums/averages/stddev/changes/resets: exclusive prefix sums, O(1)
+  per step
+- min/max over time: sparse-table range-min/max query, O(1) per step
+- rate/increase/delta: first/last gathers + a prefix sum of counter-reset
+  corrections, with Prometheus extrapolation semantics (reference
+  ``RateFunctions.scala`` mirrors promql ``extrapolatedRate``)
+- quantile_over_time / holt_winters: masked per-window evaluation, blocked
+  over output steps to bound memory
+
+All kernels take ``ts`` as int32 millis relative to the batch base (padding
+= INT32_MAX) and are shape-polymorphic only through jit's compile cache —
+batch builders bucket shapes to powers of two to keep cache hits high.
+
+Output convention: [P, K] float matrix; NaN = "no result at this step" (maps
+to a gap in the Prom JSON output).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# helpers
+
+def fdtype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _valid_mask(ts, counts):
+    S = ts.shape[1]
+    return jnp.arange(S)[None, :] < counts[:, None]
+
+
+def _eprefix(x):
+    """Exclusive prefix sum along the last axis: [..., S] -> [..., S+1]."""
+    return jnp.concatenate(
+        [jnp.zeros(x.shape[:-1] + (1,), x.dtype), jnp.cumsum(x, -1)], -1)
+
+
+def window_bounds(ts, steps, window):
+    """[lo, hi) sample index bounds of window (t-w, t] per series per step.
+
+    ts: int32 [P, S] sorted, padded with INT32_MAX; steps: int32 [K];
+    window: int32 scalar. Returns lo, hi int32 [P, K].
+    """
+    def one(tsp):
+        hi = jnp.searchsorted(tsp, steps, side="right")
+        lo = jnp.searchsorted(tsp, steps - window, side="right")
+        return lo, hi
+
+    lo, hi = jax.vmap(one)(ts)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
+def _gather(x, idx):
+    """x [P, S(+1)], idx [P, K] -> [P, K]."""
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+def _counter_corrected(v, valid):
+    """Values plus cumulative reset correction (Prometheus counter semantics:
+    on a drop, the previous value is added to all subsequent samples)."""
+    prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+    dropped = (v < prev) & valid & jnp.concatenate(
+        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    correction = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
+    return v + correction
+
+
+# ---------------------------------------------------------------------------
+# sparse table (range min/max query)
+
+def _build_sparse(v, op, identity, levels):
+    P, S = v.shape
+    tabs = [v]
+    cur = v
+    for j in range(1, levels):
+        half = 1 << (j - 1)
+        shifted = jnp.concatenate(
+            [cur[:, half:], jnp.full((P, half), identity, v.dtype)], axis=1)
+        cur = op(cur, shifted)
+        tabs.append(cur)
+    return jnp.stack(tabs)  # [L, P, S]
+
+
+def _rmq(table, lo, hi, op, identity):
+    """Range query over [lo, hi) using the sparse table. lo/hi [P, K]."""
+    P = table.shape[1]
+    w = hi - lo
+    j = jnp.maximum(31 - lax.clz(jnp.maximum(w, 1)), 0)
+    pw = jnp.left_shift(1, j)
+    p_idx = jnp.arange(P)[:, None]
+    a = table[j, p_idx, jnp.minimum(lo, table.shape[2] - 1)]
+    b = table[j, p_idx, jnp.clip(hi - pw, 0, table.shape[2] - 1)]
+    out = op(a, b)
+    return jnp.where(w > 0, out, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# the main range-function kernel family
+
+SIMPLE_FNS = (
+    "sum_over_time", "avg_over_time", "count_over_time", "min_over_time",
+    "max_over_time", "stddev_over_time", "stdvar_over_time", "last_over_time",
+    "present_over_time", "changes", "resets", "deriv", "irate", "idelta",
+    "rate", "increase", "delta", "last_sample", "timestamp", "zscore",
+    "absent_over_time",
+)
+
+
+@partial(jax.jit, static_argnames=("fn", "counter"))
+def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
+               counter: bool = False):
+    """Evaluate one range function at each step for each series.
+
+    ts: int32 [P,S] relative ms; vals: float [P,S]; counts: int32 [P];
+    steps: int32 [K]; window: int32 scalar ms; extra: scalar parameter
+    (predict_linear horizon etc.). Returns float [P,K].
+    """
+    dt = fdtype()
+    vals = vals.astype(dt)
+    valid = _valid_mask(ts, counts)
+    v = jnp.where(valid, vals, 0.0)
+    lo, hi = window_bounds(ts, steps, window)
+    n = (hi - lo).astype(dt)
+    has1 = hi > lo
+    has2 = hi > lo + 1
+    nan = jnp.array(jnp.nan, dt)
+
+    if fn == "count_over_time":
+        return jnp.where(has1, n, nan)
+    if fn == "present_over_time":
+        return jnp.where(has1, 1.0, nan).astype(dt)
+    if fn == "absent_over_time":
+        # per-series presence; the absent transformer combines across series
+        return jnp.where(has1, nan, 1.0).astype(dt)
+
+    if fn in ("sum_over_time", "avg_over_time"):
+        csum = _eprefix(v)
+        s = _gather(csum, hi) - _gather(csum, lo)
+        if fn == "avg_over_time":
+            return jnp.where(has1, s / jnp.maximum(n, 1.0), nan)
+        return jnp.where(has1, s, nan)
+
+    if fn in ("stddev_over_time", "stdvar_over_time", "zscore"):
+        csum = _eprefix(v)
+        csum2 = _eprefix(v * v)
+        s = _gather(csum, hi) - _gather(csum, lo)
+        s2 = _gather(csum2, hi) - _gather(csum2, lo)
+        mean = s / jnp.maximum(n, 1.0)
+        var = jnp.maximum(s2 / jnp.maximum(n, 1.0) - mean * mean, 0.0)
+        if fn == "stdvar_over_time":
+            return jnp.where(has1, var, nan)
+        sd = jnp.sqrt(var)
+        if fn == "stddev_over_time":
+            return jnp.where(has1, sd, nan)
+        last = _gather(v, jnp.maximum(hi - 1, 0))
+        return jnp.where(has1, (last - mean) / sd, nan)
+
+    if fn in ("min_over_time", "max_over_time"):
+        S = ts.shape[1]
+        levels = max(S.bit_length(), 1)
+        if fn == "min_over_time":
+            masked = jnp.where(valid, vals, jnp.inf)
+            table = _build_sparse(masked, jnp.minimum, jnp.inf, levels)
+            return _rmq(table, lo, hi, jnp.minimum, jnp.inf)
+        masked = jnp.where(valid, vals, -jnp.inf)
+        table = _build_sparse(masked, jnp.maximum, -jnp.inf, levels)
+        return _rmq(table, lo, hi, jnp.maximum, -jnp.inf)
+
+    if fn in ("last_over_time", "last_sample", "timestamp"):
+        idx = jnp.maximum(hi - 1, 0)
+        if fn == "timestamp":
+            t_last = _gather(ts, idx).astype(dt)
+            return jnp.where(has1, t_last / 1000.0, nan)
+        return jnp.where(has1, _gather(v, idx), nan)
+
+    if fn in ("changes", "resets"):
+        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+        both = valid & jnp.concatenate(
+            [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+        if fn == "changes":
+            ind = (v != prev) & both
+        else:
+            ind = (v < prev) & both
+        cind = _eprefix(ind.astype(dt))
+        cnt = _gather(cind, hi) - _gather(cind, jnp.minimum(lo + 1, hi))
+        return jnp.where(has1, cnt, nan)
+
+    if fn in ("irate", "idelta"):
+        i1 = jnp.maximum(hi - 1, 0)
+        i0 = jnp.maximum(hi - 2, 0)
+        v1, v0 = _gather(v, i1), _gather(v, i0)
+        t1, t0 = _gather(ts, i1).astype(dt), _gather(ts, i0).astype(dt)
+        dv = v1 - v0
+        if fn == "irate":
+            dv = jnp.where(v1 < v0, v1, dv)  # counter reset: instant rate from 0
+            out = dv / jnp.maximum((t1 - t0) / 1000.0, 1e-10)
+        else:
+            out = dv
+        return jnp.where(has2, out, nan)
+
+    if fn == "deriv":
+        return _linreg(ts, v, valid, lo, hi, steps, slope_only=True)
+
+    if fn == "predict_linear":
+        return _linreg(ts, v, valid, lo, hi, steps, slope_only=False,
+                       horizon_s=extra)
+
+    if fn in ("rate", "increase", "delta"):
+        if counter or fn in ("rate", "increase"):
+            cv = _counter_corrected(jnp.where(valid, vals, 0.0), valid)
+            cv = jnp.where(valid, cv, 0.0)
+        else:
+            cv = v
+        i_first = jnp.minimum(lo, ts.shape[1] - 1)
+        i_last = jnp.maximum(hi - 1, 0)
+        v_first = _gather(cv, i_first)
+        v_last = _gather(cv, i_last)
+        raw_first = _gather(v, i_first)
+        t_first = _gather(ts, i_first).astype(dt) / 1000.0
+        t_last = _gather(ts, i_last).astype(dt) / 1000.0
+        result = v_last - v_first
+        # Prometheus extrapolatedRate semantics
+        range_start = (steps[None, :] - window).astype(dt) / 1000.0
+        range_end = steps[None, :].astype(dt) / 1000.0
+        sampled = t_last - t_first
+        avg_dur = sampled / jnp.maximum(n - 1.0, 1.0)
+        dur_start = t_first - range_start
+        dur_end = range_end - t_last
+        if fn in ("rate", "increase"):
+            dur_to_zero = jnp.where(result > 0,
+                                    sampled * raw_first / jnp.maximum(result, 1e-30),
+                                    jnp.inf)
+            dur_start = jnp.minimum(dur_start, dur_to_zero)
+        threshold = avg_dur * 1.1
+        extend = sampled
+        extend = extend + jnp.where(dur_start < threshold, dur_start, avg_dur / 2.0)
+        extend = extend + jnp.where(dur_end < threshold, dur_end, avg_dur / 2.0)
+        factor = extend / jnp.maximum(sampled, 1e-10)
+        result = result * factor
+        if fn == "rate":
+            result = result / (window.astype(dt) / 1000.0)
+        return jnp.where(has2, result, nan)
+
+    raise ValueError(f"unknown range function {fn}")
+
+
+def _linreg(ts, v, valid, lo, hi, steps, slope_only: bool, horizon_s=0.0):
+    """Least-squares slope/prediction over each window (deriv/predict_linear).
+
+    Time is centered at the step timestamp to keep the normal equations
+    well-conditioned in float32.
+    """
+    dt = fdtype()
+    t_s = jnp.where(valid, ts, 0).astype(dt) / 1000.0
+    c_n = _eprefix(valid.astype(dt))
+    c_t = _eprefix(jnp.where(valid, t_s, 0.0))
+    c_v = _eprefix(v)
+    c_tt = _eprefix(jnp.where(valid, t_s * t_s, 0.0))
+    c_tv = _eprefix(jnp.where(valid, t_s * v, 0.0))
+    n = _gather(c_n, hi) - _gather(c_n, lo)
+    St = _gather(c_t, hi) - _gather(c_t, lo)
+    Sv = _gather(c_v, hi) - _gather(c_v, lo)
+    Stt = _gather(c_tt, hi) - _gather(c_tt, lo)
+    Stv = _gather(c_tv, hi) - _gather(c_tv, lo)
+    c = steps[None, :].astype(dt) / 1000.0  # center at step time
+    St_c = St - n * c
+    Stt_c = Stt - 2.0 * c * St + n * c * c
+    Stv_c = Stv - c * Sv
+    denom = n * Stt_c - St_c * St_c
+    slope = (n * Stv_c - St_c * Sv) / jnp.where(denom == 0, 1.0, denom)
+    has2 = (hi - lo) >= 2
+    if slope_only:
+        return jnp.where(has2 & (denom != 0), slope, jnp.nan)
+    intercept = (Sv - slope * St_c) / jnp.maximum(n, 1.0)
+    return jnp.where(has2 & (denom != 0),
+                     intercept + slope * horizon_s, jnp.nan)
+
+
+# ---------------------------------------------------------------------------
+# blocked masked kernels (quantile_over_time, holt_winters / double exp)
+
+@partial(jax.jit, static_argnames=("block",))
+def quantile_over_time(q, ts, vals, counts, steps, window, block: int = 16):
+    """phi-quantile over each window. Masked sort per window, blocked over
+    steps to bound the [P, block, S] working set."""
+    dt = fdtype()
+    vals = vals.astype(dt)
+    valid = _valid_mask(ts, counts)
+    lo, hi = window_bounds(ts, steps, window)
+    K = steps.shape[0]
+    S = ts.shape[1]
+    pad_k = (-K) % block
+    lo_p = jnp.pad(lo, ((0, 0), (0, pad_k)))
+    hi_p = jnp.pad(hi, ((0, 0), (0, pad_k)))
+    nblocks = (K + pad_k) // block
+    s_idx = jnp.arange(S)[None, None, :]
+
+    def do_block(b):
+        lo_b = lax.dynamic_slice_in_dim(lo_p, b * block, block, axis=1)
+        hi_b = lax.dynamic_slice_in_dim(hi_p, b * block, block, axis=1)
+        in_win = (s_idx >= lo_b[:, :, None]) & (s_idx < hi_b[:, :, None])
+        masked = jnp.where(in_win & valid[:, None, :], vals[:, None, :], jnp.inf)
+        srt = jnp.sort(masked, axis=-1)
+        n = (hi_b - lo_b).astype(dt)
+        pos = q * jnp.maximum(n - 1.0, 0.0)
+        i0 = jnp.floor(pos).astype(jnp.int32)
+        frac = pos - i0
+        a = jnp.take_along_axis(srt, i0[:, :, None], axis=-1)[:, :, 0]
+        bv = jnp.take_along_axis(
+            srt, jnp.minimum(i0 + 1, S - 1)[:, :, None], axis=-1)[:, :, 0]
+        out = a + (bv - a) * frac
+        return jnp.where(n > 0, out, jnp.nan)
+
+    blocks = lax.map(do_block, jnp.arange(nblocks))  # [nb, P, block]
+    out = jnp.moveaxis(blocks, 0, 1).reshape(ts.shape[0], -1)
+    return out[:, :K]
+
+
+@jax.jit
+def holt_winters(sf, tf, ts, vals, counts, steps, window):
+    """Holt's double exponential smoothing per window (promql holt_winters).
+
+    Sequential by nature: a scan over samples carrying (level, trend) per
+    (series, step) window. O(S) scan with [P, K] state.
+    """
+    dt = fdtype()
+    vals = vals.astype(dt)
+    valid = _valid_mask(ts, counts)
+    lo, hi = window_bounds(ts, steps, window)
+    S = ts.shape[1]
+    P, K = lo.shape
+
+    def step_fn(carry, i):
+        level, trend, cnt = carry
+        in_win = (i >= lo) & (i < hi) & valid[:, i][:, None]
+        x = vals[:, i][:, None]
+        new_level1 = x  # first sample initializes level
+        new_trend1 = jnp.zeros_like(x)
+        new_trend2 = x - level  # second sample initializes trend
+        new_level2 = x
+        sm_level = sf * x + (1 - sf) * (level + trend)
+        sm_trend = tf * (sm_level - level) + (1 - tf) * trend
+        nl = jnp.where(cnt == 0, new_level1,
+                       jnp.where(cnt == 1, new_level2, sm_level))
+        nt = jnp.where(cnt == 0, new_trend1,
+                       jnp.where(cnt == 1, new_trend2, sm_trend))
+        level = jnp.where(in_win, nl, level)
+        trend = jnp.where(in_win, nt, trend)
+        cnt = jnp.where(in_win, cnt + 1, cnt)
+        return (level, trend, cnt), None
+
+    init = (jnp.zeros((P, K), dt), jnp.zeros((P, K), dt),
+            jnp.zeros((P, K), jnp.int32))
+    (level, trend, cnt), _ = lax.scan(step_fn, init, jnp.arange(S))
+    return jnp.where(cnt >= 2, level, jnp.nan)

@@ -3,15 +3,25 @@
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-run-compiles the
 multi-chip path via ``__graft_entry__.dryrun_multichip``).
+
+The session environment boots every interpreter with an ``axon`` TPU backend
+registration that overrides ``jax_platforms`` to "axon,cpu" (sitecustomize).
+Unit tests must never dial the TPU tunnel, so we force the config back to CPU
+before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 # Prom semantics are defined on float64; tests verify parity at full precision.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "1"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

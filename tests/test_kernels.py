@@ -1,0 +1,336 @@
+"""Range-function kernel semantics tests.
+
+Validates the jitted kernels against a naive per-window numpy implementation
+of Prometheus semantics (the reference pins the same behaviors in
+``query/src/test/scala/filodb/query/exec/rangefn/RateFunctionsSpec.scala`` and
+``AggrOverTimeFunctionsSpec.scala``: counter correction, extrapolation,
+NaN/no-sample handling).
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.query.engine import kernels
+from filodb_tpu.query.engine.aggregations import (
+    aggregate,
+    histogram_quantile,
+    quantile_across,
+    topk_mask,
+)
+from filodb_tpu.query.engine.batch import TS_PAD
+
+
+def make_batch(series: list[tuple[np.ndarray, np.ndarray]]):
+    """series: list of (ts_ms int64 ascending, values float64)."""
+    P = len(series)
+    S = max(len(t) for t, _ in series)
+    S = max(8, 1 << (S - 1).bit_length())
+    ts = np.full((P, S), TS_PAD, np.int32)
+    vals = np.full((P, S), np.nan, np.float64)
+    counts = np.zeros(P, np.int32)
+    for i, (t, v) in enumerate(series):
+        n = len(t)
+        counts[i] = n
+        ts[i, :n] = t
+        vals[i, :n] = v
+    return ts, vals, counts
+
+
+# ---- naive reference implementations (straight from promql definitions) ----
+
+def naive_window(t, v, t_end, window):
+    m = (t > t_end - window) & (t <= t_end)
+    return t[m], v[m]
+
+
+def naive_rate(t, v, t_end, window, is_rate=True, is_counter=True):
+    wt, wv = naive_window(t, v, t_end, window)
+    if len(wt) < 2:
+        return np.nan
+    corrected = wv.copy().astype(float)
+    if is_counter:
+        corr = 0.0
+        for i in range(1, len(wv)):
+            if wv[i] < wv[i - 1]:
+                corr += wv[i - 1]
+            corrected[i] = wv[i] + corr
+    result = corrected[-1] - corrected[0]
+    t_first, t_last = wt[0] / 1000.0, wt[-1] / 1000.0
+    range_start, range_end = (t_end - window) / 1000.0, t_end / 1000.0
+    sampled = t_last - t_first
+    avg_dur = sampled / (len(wt) - 1)
+    dur_start = t_first - range_start
+    dur_end = range_end - t_last
+    if is_counter and result > 0 and wv[0] >= 0:
+        dur_zero = sampled * wv[0] / result
+        dur_start = min(dur_start, dur_zero)
+    threshold = avg_dur * 1.1
+    extend = sampled
+    extend += dur_start if dur_start < threshold else avg_dur / 2
+    extend += dur_end if dur_end < threshold else avg_dur / 2
+    result *= extend / sampled
+    if is_rate:
+        result /= window / 1000.0
+    return result
+
+
+def run(fn, series, steps_ms, window_ms, **kw):
+    ts, vals, counts = make_batch(series)
+    import jax.numpy as jnp
+    out = kernels.range_eval(fn, jnp.asarray(ts), jnp.asarray(vals),
+                             jnp.asarray(counts),
+                             jnp.asarray(steps_ms, jnp.int32),
+                             jnp.asarray(window_ms, jnp.int32), **kw)
+    return np.asarray(out)
+
+
+def regular_series(n=100, interval=10_000, start=0, seed=0):
+    rng = np.random.default_rng(seed)
+    t = start + np.arange(n, dtype=np.int64) * interval
+    v = rng.normal(50, 10, n)
+    return t, v
+
+
+class TestOverTimeFns:
+    def setup_method(self):
+        self.t, self.v = regular_series()
+        self.steps = np.arange(300_000, 1_000_000, 60_000, dtype=np.int64)
+        self.window = 300_000
+
+    def _check(self, fn, naive):
+        out = run(fn, [(self.t, self.v)], self.steps, self.window)[0]
+        for k, te in enumerate(self.steps):
+            wt, wv = naive_window(self.t, self.v, te, self.window)
+            expect = naive(wt, wv) if len(wt) else np.nan
+            np.testing.assert_allclose(out[k], expect, rtol=1e-9,
+                                       err_msg=f"{fn} at step {k}")
+
+    def test_sum_over_time(self):
+        self._check("sum_over_time", lambda t, v: v.sum())
+
+    def test_avg_over_time(self):
+        self._check("avg_over_time", lambda t, v: v.mean())
+
+    def test_count_over_time(self):
+        self._check("count_over_time", lambda t, v: float(len(v)))
+
+    def test_min_over_time(self):
+        self._check("min_over_time", lambda t, v: v.min())
+
+    def test_max_over_time(self):
+        self._check("max_over_time", lambda t, v: v.max())
+
+    def test_stddev_over_time(self):
+        self._check("stddev_over_time", lambda t, v: v.std())
+
+    def test_stdvar_over_time(self):
+        self._check("stdvar_over_time", lambda t, v: v.var())
+
+    def test_last_over_time(self):
+        self._check("last_over_time", lambda t, v: v[-1])
+
+    def test_empty_window_is_nan(self):
+        steps = np.array([10_000_000], dtype=np.int64)  # far past data
+        out = run("sum_over_time", [(self.t, self.v)], steps, self.window)
+        assert np.isnan(out[0, 0])
+
+    def test_irregular_timestamps(self):
+        rng = np.random.default_rng(3)
+        t = np.cumsum(rng.integers(1000, 30_000, 80)).astype(np.int64)
+        v = rng.normal(size=80)
+        out = run("sum_over_time", [(t, v)], self.steps, self.window)[0]
+        for k, te in enumerate(self.steps):
+            _, wv = naive_window(t, v, te, self.window)
+            expect = wv.sum() if len(wv) else np.nan
+            np.testing.assert_allclose(out[k], expect, rtol=1e-9)
+
+    def test_multiple_series_batched(self):
+        series = [regular_series(seed=s, n=50 + s * 10) for s in range(7)]
+        out = run("max_over_time", series, self.steps, self.window)
+        for p, (t, v) in enumerate(series):
+            for k, te in enumerate(self.steps):
+                _, wv = naive_window(t, v, te, self.window)
+                expect = wv.max() if len(wv) else np.nan
+                np.testing.assert_allclose(out[p, k], expect, rtol=1e-9)
+
+
+class TestRateFamily:
+    def counter(self, n=100, resets=(40, 77)):
+        rng = np.random.default_rng(1)
+        t = np.arange(n, dtype=np.int64) * 10_000
+        incr = rng.integers(0, 20, n).astype(float)
+        v = np.cumsum(incr)
+        for r in resets:
+            v[r:] -= v[r]  # counter reset to 0 at index r
+        return t, np.maximum(v, 0.0)
+
+    def test_rate_no_reset(self):
+        t = np.arange(100, dtype=np.int64) * 10_000
+        v = np.arange(100, dtype=np.float64) * 5  # steady 0.5/sec
+        steps = np.array([500_000, 700_000], dtype=np.int64)
+        out = run("rate", [(t, v)], steps, 300_000)[0]
+        np.testing.assert_allclose(out, 0.5, rtol=1e-6)
+
+    def test_rate_matches_promql_with_resets(self):
+        t, v = self.counter()
+        steps = np.arange(300_000, 990_000, 55_000, dtype=np.int64)
+        out = run("rate", [(t, v)], steps, 300_000)[0]
+        for k, te in enumerate(steps):
+            expect = naive_rate(t, v, te, 300_000, is_rate=True)
+            np.testing.assert_allclose(out[k], expect, rtol=1e-9,
+                                       err_msg=f"step {te}")
+
+    def test_increase(self):
+        t, v = self.counter()
+        steps = np.array([400_000, 750_000], dtype=np.int64)
+        out = run("increase", [(t, v)], steps, 300_000)[0]
+        for k, te in enumerate(steps):
+            expect = naive_rate(t, v, te, 300_000, is_rate=False)
+            np.testing.assert_allclose(out[k], expect, rtol=1e-9)
+
+    def test_delta_gauge(self):
+        t, v = regular_series(seed=5)
+        steps = np.array([500_000], dtype=np.int64)
+        out = run("delta", [(t, v)], steps, 300_000)[0]
+        expect = naive_rate(t, v, 500_000, 300_000, is_rate=False,
+                            is_counter=False)
+        np.testing.assert_allclose(out[0], expect, rtol=1e-9)
+
+    def test_rate_single_sample_nan(self):
+        t = np.array([100_000], dtype=np.int64)
+        v = np.array([5.0])
+        out = run("rate", [(t, v)], np.array([150_000], np.int64), 300_000)
+        assert np.isnan(out[0, 0])
+
+    def test_irate(self):
+        t, v = self.counter(resets=())
+        steps = np.array([505_000], dtype=np.int64)
+        out = run("irate", [(t, v)], steps, 300_000)[0]
+        expect = (v[50] - v[49]) / 10.0
+        np.testing.assert_allclose(out[0], expect, rtol=1e-9)
+
+    def test_idelta(self):
+        t, v = regular_series()
+        steps = np.array([505_000], dtype=np.int64)
+        out = run("idelta", [(t, v)], steps, 300_000)[0]
+        np.testing.assert_allclose(out[0], v[50] - v[49], rtol=1e-9)
+
+    def test_resets_and_changes(self):
+        t, v = self.counter()
+        steps = np.array([990_000], dtype=np.int64)
+        window = 1_000_000  # covers every sample incl. t=0
+        out_r = run("resets", [(t, v)], steps, window)[0]
+        naive_resets = sum(1 for i in range(1, len(v)) if v[i] < v[i - 1])
+        np.testing.assert_allclose(out_r[0], naive_resets)
+        out_c = run("changes", [(t, v)], steps, window)[0]
+        naive_changes = sum(1 for i in range(1, len(v)) if v[i] != v[i - 1])
+        np.testing.assert_allclose(out_c[0], naive_changes)
+
+    def test_deriv(self):
+        # exact line: slope recovered exactly
+        t = np.arange(60, dtype=np.int64) * 10_000
+        v = 3.0 + 0.25 * (t / 1000.0)
+        steps = np.array([400_000, 590_000], dtype=np.int64)
+        out = run("deriv", [(t, v)], steps, 300_000)[0]
+        np.testing.assert_allclose(out, 0.25, rtol=1e-6)
+
+
+class TestQuantileHoltWinters:
+    def test_quantile_over_time(self):
+        t, v = regular_series()
+        steps = np.arange(300_000, 900_000, 60_000, dtype=np.int64)
+        import jax.numpy as jnp
+        ts, vals, counts = make_batch([(t, v)])
+        out = np.asarray(kernels.quantile_over_time(
+            0.9, jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(counts),
+            jnp.asarray(steps, jnp.int32), jnp.asarray(300_000, jnp.int32)))[0]
+        for k, te in enumerate(steps):
+            _, wv = naive_window(t, v, te, 300_000)
+            expect = np.quantile(wv, 0.9) if len(wv) else np.nan
+            np.testing.assert_allclose(out[k], expect, rtol=1e-9)
+
+    def test_holt_winters_smoke(self):
+        t = np.arange(100, dtype=np.int64) * 10_000
+        v = np.linspace(0, 100, 100)  # trending line: hw tracks it closely
+        steps = np.array([800_000], dtype=np.int64)
+        import jax.numpy as jnp
+        ts, vals, counts = make_batch([(t, v)])
+        out = np.asarray(kernels.holt_winters(
+            0.5, 0.3, jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(counts),
+            jnp.asarray(steps, jnp.int32), jnp.asarray(300_000, jnp.int32)))[0]
+        # smoothed value should be near the last window sample
+        assert abs(out[0] - 80.0) < 5.0
+
+
+class TestAggregations:
+    def test_sum_avg_count_by_group(self):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=(6, 4))
+        vals[2, 1] = np.nan
+        gid = np.array([0, 0, 0, 1, 1, 1], np.int32)
+        import jax.numpy as jnp
+        s = np.asarray(aggregate("sum", jnp.asarray(vals), jnp.asarray(gid), 2))
+        expect0 = np.nansum(vals[:3], axis=0)
+        np.testing.assert_allclose(s[0], expect0, rtol=1e-9)
+        a = np.asarray(aggregate("avg", jnp.asarray(vals), jnp.asarray(gid), 2))
+        np.testing.assert_allclose(a[1], vals[3:].mean(axis=0), rtol=1e-9)
+        c = np.asarray(aggregate("count", jnp.asarray(vals), jnp.asarray(gid), 2))
+        assert c[0, 1] == 2.0  # NaN excluded
+
+    def test_min_max_stddev(self):
+        vals = np.array([[1.0, 5.0], [3.0, np.nan], [2.0, 4.0]])
+        gid = np.zeros(3, np.int32)
+        import jax.numpy as jnp
+        assert np.asarray(aggregate("min", jnp.asarray(vals),
+                                    jnp.asarray(gid), 1))[0, 0] == 1.0
+        assert np.asarray(aggregate("max", jnp.asarray(vals),
+                                    jnp.asarray(gid), 1))[0, 1] == 5.0
+        sd = np.asarray(aggregate("stddev", jnp.asarray(vals),
+                                  jnp.asarray(gid), 1))
+        np.testing.assert_allclose(sd[0, 0], np.std([1, 3, 2]), rtol=1e-9)
+
+    def test_topk(self):
+        vals = np.array([[10.0], [30.0], [20.0], [5.0]])
+        gid = np.zeros(4, np.int32)
+        import jax.numpy as jnp
+        mask = np.asarray(topk_mask(jnp.asarray(vals), jnp.asarray(gid), 1, 2))
+        assert mask[:, 0].tolist() == [False, True, True, False]
+
+    def test_bottomk(self):
+        vals = np.array([[10.0], [30.0], [20.0], [5.0]])
+        gid = np.zeros(4, np.int32)
+        import jax.numpy as jnp
+        mask = np.asarray(topk_mask(jnp.asarray(vals), jnp.asarray(gid), 1, 2,
+                                    bottom=True))
+        assert mask[:, 0].tolist() == [True, False, False, True]
+
+    def test_quantile_across(self):
+        vals = np.array([[1.0], [2.0], [3.0], [4.0]])
+        gid = np.zeros(4, np.int32)
+        import jax.numpy as jnp
+        q = np.asarray(quantile_across(0.5, jnp.asarray(vals),
+                                       jnp.asarray(gid), 1))
+        np.testing.assert_allclose(q[0, 0], 2.5)
+
+
+class TestHistogramQuantile:
+    def test_simple(self):
+        import jax.numpy as jnp
+        les = jnp.asarray([1.0, 2.0, 4.0, np.inf])
+        h = jnp.asarray([[10.0, 20.0, 30.0, 30.0]])  # cumulative counts
+        out = np.asarray(histogram_quantile(0.5, h, les))
+        # rank = 15 → bucket (1,2]: 1 + (15-10)/(20-10) * 1 = 1.5
+        np.testing.assert_allclose(out[0], 1.5, rtol=1e-9)
+
+    def test_highest_bucket_clamps(self):
+        import jax.numpy as jnp
+        les = jnp.asarray([1.0, 2.0, np.inf])
+        h = jnp.asarray([[0.0, 0.0, 10.0]])
+        out = np.asarray(histogram_quantile(0.99, h, les))
+        np.testing.assert_allclose(out[0], 2.0)
+
+    def test_empty_is_nan(self):
+        import jax.numpy as jnp
+        les = jnp.asarray([1.0, np.inf])
+        h = jnp.asarray([[0.0, 0.0]])
+        assert np.isnan(np.asarray(histogram_quantile(0.5, h, les))[0])
