@@ -1,0 +1,267 @@
+"""PromQL parser conformance tests.
+
+Mirrors the reference's ParserSpec
+(``prometheus/src/test/scala/filodb/prometheus/parse/ParserSpec.scala``, 761
+lines asserting PromQL → LogicalPlan for hundreds of queries): asserts the
+logical-plan structure for a representative corpus.
+"""
+
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex, NotEquals
+from filodb_tpu.promql.parser import (
+    ParseError,
+    TimeStepParams,
+    parse_duration_ms,
+    parse_query,
+)
+from filodb_tpu.query import logical as lp
+
+P = TimeStepParams(start=1000, step=10, end=2000)
+
+
+def parse(q):
+    return parse_query(q, P)
+
+
+def filters_of(plan):
+    return {f.column: f.filter for f in plan.filters}
+
+
+class TestSelectors:
+    def test_bare_metric(self):
+        p = parse("http_requests_total")
+        assert isinstance(p, lp.PeriodicSeries)
+        assert p.start == 1_000_000 and p.end == 2_000_000 and p.step == 10_000
+        f = filters_of(p.raw)
+        assert f["_metric_"] == Equals("http_requests_total")
+        assert p.raw.lookback == 300_000
+
+    def test_label_matchers(self):
+        p = parse('hu{_ws_="demo",_ns_!="x",instance=~"i.*",job!~"j[0-9]"}')
+        f = filters_of(p.raw)
+        assert f["_ws_"] == Equals("demo")
+        assert isinstance(f["_ns_"], NotEquals)
+        assert isinstance(f["instance"], EqualsRegex)
+
+    def test_name_label(self):
+        p = parse('{__name__="up",job="api"}')
+        f = filters_of(p.raw)
+        assert f["_metric_"] == Equals("up")
+
+    def test_offset(self):
+        p = parse("metric offset 5m")
+        assert p.offset == 300_000
+
+    def test_range_requires_function(self):
+        with pytest.raises(ParseError):
+            parse("metric[5m]")
+
+    def test_empty_selector_error(self):
+        with pytest.raises(ParseError):
+            parse("{}")
+
+
+class TestDurations:
+    def test_units(self):
+        assert parse_duration_ms("5m") == 300_000
+        assert parse_duration_ms("1h30m") == 5_400_000
+        assert parse_duration_ms("90s") == 90_000
+        assert parse_duration_ms("1d") == 86_400_000
+        assert parse_duration_ms("2w") == 1_209_600_000
+        assert parse_duration_ms("500ms") == 500
+
+    def test_step_multiple(self):
+        # reference README.md:429-460: [Ni] = N × step
+        assert parse_duration_ms("5i", step_ms=10_000) == 50_000
+        with pytest.raises(ParseError):
+            parse_duration_ms("5i", step_ms=0)
+
+    def test_rate_with_step_multiple(self):
+        p = parse("rate(m[5i])")
+        assert p.window == 50_000
+
+
+class TestRangeFunctions:
+    def test_rate(self):
+        p = parse("rate(http_requests_total[5m])")
+        assert isinstance(p, lp.PeriodicSeriesWithWindowing)
+        assert p.function == "rate" and p.window == 300_000
+
+    def test_all_over_time(self):
+        for fn in ("sum_over_time", "avg_over_time", "min_over_time",
+                   "max_over_time", "count_over_time", "stddev_over_time",
+                   "last_over_time", "present_over_time"):
+            p = parse(f"{fn}(m[10m])")
+            assert p.function == fn and p.window == 600_000
+
+    def test_quantile_over_time_param(self):
+        p = parse("quantile_over_time(0.95, m[5m])")
+        assert p.function == "quantile_over_time" and p.params == (0.95,)
+
+    def test_holt_winters(self):
+        p = parse("holt_winters(m[10m], 0.5, 0.1)")
+        assert p.params == (0.5, 0.1)
+
+    def test_predict_linear(self):
+        p = parse("predict_linear(m[30m], 3600)")
+        assert p.params == (3600.0,)
+
+    def test_offset_range(self):
+        p = parse("rate(m[5m] offset 10m)")
+        assert p.offset == 600_000
+
+
+class TestAggregations:
+    def test_sum(self):
+        p = parse("sum(rate(m[5m]))")
+        assert isinstance(p, lp.Aggregate) and p.op == "sum"
+        assert isinstance(p.vector, lp.PeriodicSeriesWithWindowing)
+
+    def test_by_prefix_and_suffix(self):
+        p1 = parse("sum by (job, instance) (m)")
+        p2 = parse("sum(m) by (job, instance)")
+        assert p1.by == ("job", "instance") == p2.by
+
+    def test_without(self):
+        p = parse("avg without (instance) (m)")
+        assert p.without == ("instance",)
+
+    def test_topk(self):
+        p = parse("topk(5, sum by (app) (rate(cpu[1m])))")
+        assert p.op == "topk" and p.params == (5.0,)
+        inner = p.vector
+        assert inner.op == "sum" and inner.by == ("app",)
+
+    def test_quantile_agg(self):
+        p = parse("quantile(0.9, m)")
+        assert p.op == "quantile" and p.params == (0.9,)
+
+    def test_count_values(self):
+        p = parse('count_values("version", build_info)')
+        assert p.op == "count_values" and p.params == ("version",)
+
+
+class TestBinaryOps:
+    def test_vector_vector(self):
+        p = parse("a + b")
+        assert isinstance(p, lp.BinaryJoin) and p.op == "+"
+
+    def test_precedence(self):
+        p = parse("a + b * c")
+        assert p.op == "+" and p.rhs.op == "*"
+        p = parse("(a + b) * c")
+        assert p.op == "*"
+
+    def test_power_right_assoc(self):
+        p = parse("a ^ b ^ c")
+        assert p.op == "^" and p.rhs.op == "^"
+
+    def test_scalar_vector(self):
+        p = parse("2 * m")
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+        assert p.scalar_is_lhs and p.scalar.value == 2.0
+
+    def test_scalar_scalar_folds(self):
+        p = parse("1 + 2 * 3")
+        assert isinstance(p, lp.ScalarFixedDoublePlan) and p.value == 7.0
+
+    def test_comparison_bool(self):
+        p = parse("m > bool 5")
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+        assert p.bool_mode and not p.scalar_is_lhs
+
+    def test_set_ops(self):
+        for op in ("and", "or", "unless"):
+            p = parse(f"a {op} b")
+            assert isinstance(p, lp.BinaryJoin) and p.op == op
+            assert p.cardinality == "many-to-many"
+
+    def test_on_group_left(self):
+        p = parse("a * on (job) group_left (extra) b")
+        assert p.on == ("job",) and p.cardinality == "many-to-one"
+        assert p.include == ("extra",)
+
+    def test_ignoring(self):
+        p = parse("a / ignoring (instance) b")
+        assert p.ignoring == ("instance",)
+
+    def test_unary_minus(self):
+        p = parse("-m")
+        assert isinstance(p, lp.ScalarVectorBinaryOperation) and p.op == "*"
+
+
+class TestFunctions:
+    def test_instant_functions(self):
+        for fn in ("abs", "ceil", "floor", "exp", "ln", "sqrt", "sgn"):
+            p = parse(f"{fn}(m)")
+            assert isinstance(p, lp.ApplyInstantFunction) and p.function == fn
+
+    def test_histogram_quantile(self):
+        p = parse("histogram_quantile(0.99, sum(rate(lat_bucket[5m])) by (le))")
+        assert p.function == "histogram_quantile" and p.args == (0.99,)
+        assert isinstance(p.vector, lp.Aggregate)
+
+    def test_clamp(self):
+        p = parse("clamp(m, 0, 10)")
+        assert p.args == (0.0, 10.0)
+
+    def test_absent(self):
+        p = parse('absent(m{job="x"})')
+        assert isinstance(p, lp.ApplyAbsentFunction)
+
+    def test_sort(self):
+        assert parse("sort(m)").descending is False
+        assert parse("sort_desc(m)").descending is True
+
+    def test_label_replace(self):
+        p = parse('label_replace(m, "dst", "$1", "src", "(.*)")')
+        assert isinstance(p, lp.ApplyMiscellaneousFunction)
+        assert p.args == ("dst", "$1", "src", "(.*)")
+
+    def test_scalar_vector_fns(self):
+        p = parse("scalar(m)")
+        assert isinstance(p, lp.ScalarVaryingDoublePlan)
+        p = parse("vector(1)")
+        assert isinstance(p, lp.VectorPlan)
+        p = parse("time()")
+        assert isinstance(p, lp.ScalarTimeBasedPlan)
+
+    def test_timestamp(self):
+        p = parse("timestamp(m)")
+        assert p.function == "timestamp"
+
+    def test_subquery(self):
+        p = parse("max_over_time(rate(m[1m])[30m:1m])")
+        assert isinstance(p, lp.SubqueryWithWindowing)
+        assert p.function == "max_over_time"
+        assert p.subquery_window == 1_800_000 and p.subquery_step == 60_000
+        assert isinstance(p.inner, lp.PeriodicSeriesWithWindowing)
+
+
+class TestComplexQueries:
+    """Queries of the shape the reference benchmarks/specs exercise."""
+
+    def test_benchmark_query(self):
+        p = parse('sum(rate(heap_usage{_ws_="demo",_ns_="App-2"}[5m]))')
+        assert p.op == "sum"
+        assert p.vector.function == "rate"
+        f = filters_of(p.vector.raw)
+        assert f["_ws_"] == Equals("demo")
+
+    def test_histogram_p99(self):
+        parse('histogram_quantile(0.99, sum(rate(req_latency{_ws_="demo"'
+              '}[5m])) by (le))')
+
+    def test_nested_binary(self):
+        p = parse('sum(rate(a[1m])) / sum(rate(b[1m])) * 100')
+        assert p.op == "*"
+        assert isinstance(p, lp.ScalarVectorBinaryOperation)
+
+    def test_division_ratio(self):
+        p = parse('sum(rate(err[5m])) / sum(rate(total[5m]))')
+        assert isinstance(p, lp.BinaryJoin) and p.op == "/"
+
+    def test_trailing_garbage(self):
+        with pytest.raises(ParseError):
+            parse("m ,")
