@@ -1,0 +1,5 @@
+"""Control plane: shard mapping, planners, cluster coordination, ingestion
+orchestration.
+
+Counterpart of reference ``coordinator/`` module.
+"""

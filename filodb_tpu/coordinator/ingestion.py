@@ -1,0 +1,41 @@
+"""Ingestion routing helpers: record streams → owning shards.
+
+Counterpart of the reference's gateway shard routing + IngestionActor
+plumbing (``ShardMapper.ingestionShard``, ``IngestionActor.scala:43-57``):
+computes each record's shard from its partition key and feeds per-shard
+containers into the memstore.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.partkey import ingestion_shard
+from filodb_tpu.core.record import RecordContainer, SomeData
+
+
+def route_container(container: RecordContainer, num_shards: int, spread: int,
+                    shard_key_labels=("_ws_", "_ns_", "_metric_")
+                    ) -> dict[int, RecordContainer]:
+    """Split one container into per-shard containers by partition-key hash."""
+    out: dict[int, RecordContainer] = defaultdict(RecordContainer)
+    for rec in container:
+        skh = rec.part_key.shard_key_hash(shard_key_labels)
+        shard = ingestion_shard(skh, rec.part_key.part_hash, num_shards,
+                                spread)
+        out[shard].add(rec)
+    return out
+
+
+def ingest_routed(memstore: TimeSeriesMemStore, dataset: str, stream,
+                  num_shards: int, spread: int = 0) -> int:
+    """Ingest a SomeData stream, routing records to the owning shards
+    (gateway-equivalent path for in-process tests/benchmarks)."""
+    total = 0
+    for data in stream:
+        for shard, container in route_container(data.container, num_shards,
+                                                spread).items():
+            total += memstore.ingest(dataset, shard,
+                                     SomeData(container, data.offset))
+    return total

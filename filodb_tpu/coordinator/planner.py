@@ -1,0 +1,312 @@
+"""Query planners: LogicalPlan → ExecPlan materialization.
+
+Counterpart of reference ``coordinator/src/main/scala/filodb.coordinator/
+queryplanner/SingleClusterPlanner.scala:41,93,126`` — shard-aware
+materialization with shard-key pruning (spread), per-shard leaf plans under
+scatter-gather parents — plus the time-split planning axis
+(``materializeTimeSplitPlan``) via ``split_time_range``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.partkey import shard_key_hash, shards_for_shard_key
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec import transformers as tf
+from filodb_tpu.query.exec.binaryjoin import BinaryJoinExec, SetOperatorExec
+from filodb_tpu.query.exec.plan import (
+    DistConcatExec,
+    EmptyResultExec,
+    ExecPlan,
+    PlanDispatcher,
+    ReduceAggregateExec,
+    ScalarBinaryOperationExec,
+    ScalarFixedDoubleExec,
+    ScalarVaryingExec,
+    SelectRawPartitionsExec,
+    StitchRvsExec,
+    TimeScalarGeneratorExec,
+    VectorFromScalarExec,
+)
+from filodb_tpu.query.model import QueryContext
+
+
+class QueryPlanner:
+    """Reference ``QueryPlanner`` trait."""
+
+    def materialize(self, plan: lp.LogicalPlan,
+                    qcontext: QueryContext) -> ExecPlan:
+        raise NotImplementedError
+
+
+@dataclass
+class SingleClusterPlanner(QueryPlanner):
+    dataset: str
+    num_shards: int = 1
+    spread: int = 1
+    shard_key_labels: tuple[str, ...] = ("_ws_", "_ns_", "_metric_")
+    # optional: ms above which a range query is split into sequential
+    # sub-plans + stitch (reference materializeTimeSplitPlan)
+    time_split_ms: int = 0
+    dispatcher_for_shard: "callable | None" = None
+
+    # ---- shard selection ------------------------------------------------
+
+    def shards_for_filters(self, filters) -> list[int]:
+        """Prune fan-out using shard-key equality filters
+        (reference ``SingleClusterPlanner.shardsFromFilters``)."""
+        eq = {f.column: f.filter.value for f in filters
+              if isinstance(f.filter, Equals)}
+        if all(lbl in eq for lbl in self.shard_key_labels):
+            skh = shard_key_hash({k: eq[k] for k in self.shard_key_labels})
+            return shards_for_shard_key(skh, self.num_shards, self.spread)
+        return list(range(self.num_shards))
+
+    def _dispatcher(self, shard: int) -> PlanDispatcher | None:
+        if self.dispatcher_for_shard is not None:
+            return self.dispatcher_for_shard(shard)
+        return None
+
+    # ---- materialization ------------------------------------------------
+
+    def materialize(self, plan: lp.LogicalPlan,
+                    qcontext: QueryContext | None = None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        return self._walk(plan, qcontext)
+
+    def _walk(self, plan, q) -> ExecPlan:
+        m = getattr(self, "_mat_" + type(plan).__name__, None)
+        if m is None:
+            raise ValueError(f"cannot materialize {type(plan).__name__}")
+        return m(plan, q)
+
+    # -- leaves --
+
+    def _leaves(self, raw: lp.RawSeries, q, mapper) -> list[ExecPlan]:
+        chunk_start = raw.range_start - raw.lookback - raw.offset
+        chunk_end = raw.range_end - raw.offset
+        plans: list[ExecPlan] = []
+        for shard in self.shards_for_filters(raw.filters):
+            leaf = SelectRawPartitionsExec(
+                shard=shard, filters=raw.filters, chunk_start=chunk_start,
+                chunk_end=chunk_end, value_column=raw.column)
+            d = self._dispatcher(shard)
+            if d is not None:
+                leaf.dispatcher = d
+            leaf.add_transformer(mapper)
+            plans.append(leaf)
+        return plans
+
+    def _concat(self, plans: list[ExecPlan]) -> ExecPlan:
+        if len(plans) == 1:
+            return plans[0]
+        return DistConcatExec(children_plans=plans)
+
+    def _split_ranges(self, start, step, end):
+        """Split [start, end] into sequential sub-ranges on step boundaries
+        (reference time-split planning)."""
+        if (self.time_split_ms <= 0 or step <= 0
+                or end - start <= self.time_split_ms):
+            return [(start, end)]
+        out = []
+        cur = start
+        steps_per_split = max(self.time_split_ms // step, 1)
+        while cur <= end:
+            sub_end = min(cur + steps_per_split * step - step, end)
+            out.append((cur, sub_end))
+            cur = sub_end + step
+        return out
+
+    def _mat_PeriodicSeries(self, plan: lp.PeriodicSeries, q) -> ExecPlan:
+        parts = []
+        for s, e in self._split_ranges(plan.start, plan.step, plan.end):
+            mapper = tf.PeriodicSamplesMapper(
+                s, plan.step, e, window=0, function=None, offset=plan.offset)
+            raw = lp.RawSeries(plan.raw.filters, s, e, plan.raw.lookback,
+                               plan.raw.offset, plan.raw.column)
+            parts.append(self._concat(self._leaves(raw, q, mapper)))
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(children_plans=parts)
+
+    def _mat_PeriodicSeriesWithWindowing(
+            self, plan: lp.PeriodicSeriesWithWindowing, q) -> ExecPlan:
+        parts = []
+        for s, e in self._split_ranges(plan.start, plan.step, plan.end):
+            mapper = tf.PeriodicSamplesMapper(
+                s, plan.step, e, window=plan.window, function=plan.function,
+                params=plan.params, offset=plan.offset)
+            raw = lp.RawSeries(plan.raw.filters, s, e,
+                               max(plan.raw.lookback, plan.window),
+                               plan.raw.offset, plan.raw.column)
+            parts.append(self._concat(self._leaves(raw, q, mapper)))
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(children_plans=parts)
+
+    def _mat_RawSeries(self, plan: lp.RawSeries, q) -> ExecPlan:
+        # raw export: instant mapper with lookback at chunk granularity
+        mapper = tf.PeriodicSamplesMapper(plan.range_start, 0, plan.range_end,
+                                          window=0, function=None,
+                                          offset=plan.offset)
+        return self._concat(self._leaves(plan, q, mapper))
+
+    # -- aggregates / joins --
+
+    def _mat_Aggregate(self, plan: lp.Aggregate, q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        params = tuple(p for p in plan.params)
+        return ReduceAggregateExec(children_plans=[inner], op=plan.op,
+                                   params=params, by=plan.by,
+                                   without=plan.without)
+
+    def _mat_BinaryJoin(self, plan: lp.BinaryJoin, q) -> ExecPlan:
+        l = self._walk(plan.lhs, q)
+        r = self._walk(plan.rhs, q)
+        if plan.op in ("and", "or", "unless"):
+            return SetOperatorExec(lhs_plans=[l], rhs_plans=[r], op=plan.op,
+                                   on=plan.on, ignoring=plan.ignoring)
+        return BinaryJoinExec(lhs_plans=[l], rhs_plans=[r], op=plan.op,
+                              cardinality=plan.cardinality, on=plan.on,
+                              ignoring=plan.ignoring, include=plan.include,
+                              bool_mode=plan.bool_mode)
+
+    def _mat_ScalarVectorBinaryOperation(
+            self, plan: lp.ScalarVectorBinaryOperation, q) -> ExecPlan:
+        vec = self._walk(plan.vector, q)
+        scalar = self._walk(plan.scalar, q)
+        vec.add_transformer(_ScalarOpDeferred(plan.op, scalar,
+                                              plan.scalar_is_lhs,
+                                              plan.bool_mode))
+        return vec
+
+    # -- functions --
+
+    def _mat_ApplyInstantFunction(self, plan: lp.ApplyInstantFunction,
+                                  q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        inner.add_transformer(tf.InstantVectorFunctionMapper(plan.function,
+                                                             plan.args))
+        return inner
+
+    def _mat_ApplyMiscellaneousFunction(self, plan, q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        inner.add_transformer(tf.MiscellaneousFunctionMapper(plan.function,
+                                                             plan.args))
+        return inner
+
+    def _mat_ApplySortFunction(self, plan, q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        inner.add_transformer(tf.SortFunctionMapper(plan.descending))
+        return inner
+
+    def _mat_ApplyAbsentFunction(self, plan: lp.ApplyAbsentFunction,
+                                 q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        inner.add_transformer(tf.AbsentFunctionMapper(
+            plan.filters, plan.start, plan.step or 1000, plan.end))
+        return inner
+
+    def _mat_ApplyLimitFunction(self, plan, q) -> ExecPlan:
+        inner = self._walk(plan.vector, q)
+        inner.add_transformer(tf.LimitFunctionMapper(plan.limit))
+        return inner
+
+    # -- subqueries --
+
+    def _mat_SubqueryWithWindowing(self, plan: lp.SubqueryWithWindowing,
+                                   q) -> ExecPlan:
+        # evaluate inner over the extended range at the subquery step, then
+        # apply the range function over the produced matrix
+        inner_start = plan.start - plan.subquery_window - plan.offset
+        inner_end = plan.end - plan.offset
+        sub_step = plan.subquery_step or 60_000
+        # align inner steps to multiples of sub_step (prom semantics)
+        inner_start = (inner_start // sub_step) * sub_step
+        inner = _retime(plan.inner, inner_start, sub_step, inner_end)
+        inner_exec = self._walk(inner, q)
+        inner_exec.add_transformer(tf.PeriodicSamplesMapper(
+            plan.start, plan.step, plan.end, window=plan.subquery_window,
+            function=plan.function, params=plan.params, offset=plan.offset))
+        return inner_exec
+
+    def _mat_TopLevelSubquery(self, plan: lp.TopLevelSubquery, q) -> ExecPlan:
+        inner = _retime(plan.inner, plan.start, plan.step, plan.end)
+        return self._walk(inner, q)
+
+    # -- scalars --
+
+    def _mat_ScalarFixedDoublePlan(self, plan, q) -> ExecPlan:
+        return ScalarFixedDoubleExec(value=plan.value, start=plan.start,
+                                     step=plan.step or 1000, end=plan.end)
+
+    def _mat_ScalarTimeBasedPlan(self, plan, q) -> ExecPlan:
+        return TimeScalarGeneratorExec(function=plan.function,
+                                       start=plan.start,
+                                       step=plan.step or 1000, end=plan.end)
+
+    def _mat_ScalarVaryingDoublePlan(self, plan, q) -> ExecPlan:
+        return ScalarVaryingExec(inner=self._walk(plan.vector, q))
+
+    def _mat_ScalarBinaryOperation(self, plan, q) -> ExecPlan:
+        def conv(x):
+            if isinstance(x, (int, float)):
+                return float(x)
+            return self._walk(x, q)
+
+        return ScalarBinaryOperationExec(op=plan.op, lhs=conv(plan.lhs),
+                                         rhs=conv(plan.rhs), start=plan.start,
+                                         step=plan.step or 1000, end=plan.end)
+
+    def _mat_VectorPlan(self, plan, q) -> ExecPlan:
+        return VectorFromScalarExec(inner=self._walk(plan.scalar, q))
+
+
+def _retime(plan: lp.LogicalPlan, start: int, step: int, end: int):
+    """Rewrite a plan tree's evaluation range (subquery materialization)."""
+    import dataclasses
+    if isinstance(plan, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing)):
+        raw = dataclasses.replace(plan.raw, range_start=start, range_end=end)
+        return dataclasses.replace(plan, raw=raw, start=start, step=step,
+                                   end=end)
+    if isinstance(plan, lp.SubqueryWithWindowing):
+        return dataclasses.replace(plan, start=start, step=step, end=end)
+    if isinstance(plan, (lp.ScalarFixedDoublePlan, lp.ScalarTimeBasedPlan,
+                         lp.ScalarBinaryOperation)):
+        return dataclasses.replace(plan, start=start, step=step, end=end)
+    if dataclasses.is_dataclass(plan):
+        changes = {}
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                changes[f.name] = _retime(v, start, step, end)
+        if changes:
+            return dataclasses.replace(plan, **changes)
+    return plan
+
+
+class _ScalarOpDeferred(tf.RangeVectorTransformer):
+    """ScalarOperationMapper whose scalar side is an exec plan evaluated at
+    apply time (needs the ExecContext — captured via a late bind)."""
+
+    def __init__(self, op, scalar_exec, scalar_is_lhs, bool_mode):
+        self.op = op
+        self.scalar_exec = scalar_exec
+        self.scalar_is_lhs = scalar_is_lhs
+        self.bool_mode = bool_mode
+        self._ctx = None
+
+    def bind(self, ctx):
+        self._ctx = ctx
+
+    def apply(self, data):
+        from filodb_tpu.query.exec.plan import ExecContext
+        ctx = self._ctx
+        if ctx is None:
+            # scalar plans that don't touch the store can run with a nil ctx
+            ctx = ExecContext(memstore=None, dataset="")
+        scalar = self.scalar_exec.execute_scalar(ctx)
+        return tf.ScalarOperationMapper(self.op, scalar, self.scalar_is_lhs,
+                                        self.bool_mode).apply(data)

@@ -1,0 +1,102 @@
+"""High-level query facade: PromQL string → results.
+
+Counterpart of the reference's QueryActor + client ask path
+(``coordinator/src/main/scala/filodb.coordinator/QueryActor.scala:43,119,171``):
+parse → plan → execute against the memstore, returning StepMatrix results.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec.plan import ExecContext
+from filodb_tpu.query.model import QueryContext, QueryResult
+from filodb_tpu.utils.metrics import Histogram
+
+query_latency = Histogram("query_latency_seconds")
+
+
+@dataclass
+class QueryService:
+    memstore: TimeSeriesMemStore
+    dataset: str
+    num_shards: int = 1
+    spread: int = 0
+    time_split_ms: int = 0
+    planner: SingleClusterPlanner = field(init=False)
+
+    def __post_init__(self):
+        self.planner = SingleClusterPlanner(
+            self.dataset, self.num_shards, self.spread,
+            time_split_ms=self.time_split_ms)
+
+    # ---- promql entry points --------------------------------------------
+
+    def query_range(self, promql: str, start_sec: int, step_sec: int,
+                    end_sec: int, qcontext: QueryContext | None = None
+                    ) -> QueryResult:
+        params = TimeStepParams(start_sec, step_sec, end_sec)
+        plan = parse_query(promql, params)
+        return self.execute_logical(plan, qcontext)
+
+    def query_instant(self, promql: str, time_sec: int,
+                      qcontext: QueryContext | None = None) -> QueryResult:
+        params = TimeStepParams(time_sec, 0, time_sec)
+        plan = parse_query(promql, params)
+        return self.execute_logical(plan, qcontext)
+
+    def execute_logical(self, plan: lp.LogicalPlan,
+                        qcontext: QueryContext | None = None) -> QueryResult:
+        qcontext = qcontext or QueryContext()
+        t0 = time.perf_counter()
+        if isinstance(plan, (lp.LabelValues, lp.LabelNames,
+                             lp.SeriesKeysByFilters)):
+            return self._metadata(plan, qcontext)
+        exec_plan = self.planner.materialize(plan, qcontext)
+        ctx = ExecContext(self.memstore, self.dataset, qcontext)
+        with query_latency.time():
+            result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
+        result.stats.wall_time_s = time.perf_counter() - t0
+        result.stats.result_series = result.result.num_series
+        return result
+
+    # ---- metadata -------------------------------------------------------
+
+    def _metadata(self, plan, qcontext) -> QueryResult:
+        from filodb_tpu.query.model import StepMatrix
+        import numpy as np
+        if isinstance(plan, lp.LabelValues):
+            vals = self.memstore.label_values(self.dataset, plan.label,
+                                              list(plan.filters) or None)
+            meta = [("__label_value__", v) for v in vals]
+        elif isinstance(plan, lp.LabelNames):
+            meta = [("__label_name__", v)
+                    for v in self.memstore.label_names(self.dataset)]
+        else:  # SeriesKeysByFilters
+            meta = []
+            for shard in self.memstore.shards_for(self.dataset):
+                for pid in shard.lookup_partitions(list(plan.filters),
+                                                   plan.start, plan.end):
+                    pk = shard.index.part_key(pid)
+                    if pk is not None:
+                        meta.append(("__series__", str(sorted(pk.labels))))
+        result = StepMatrix.empty()
+        result.meta = meta  # metadata rides alongside
+        qr = QueryResult(result, query_id=qcontext.query_id)
+        return qr
+
+    def series(self, filters, start_sec: int, end_sec: int) -> list[dict]:
+        out = []
+        for shard in self.memstore.shards_for(self.dataset):
+            for pid in shard.lookup_partitions(list(filters),
+                                               start_sec * 1000,
+                                               end_sec * 1000):
+                pk = shard.index.part_key(pid)
+                if pk is not None:
+                    out.append(pk.label_map)
+        return out

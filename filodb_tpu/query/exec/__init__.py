@@ -1,0 +1,4 @@
+"""ExecPlan tree: physical query execution.
+
+Counterpart of reference ``query/src/main/scala/filodb/query/exec/``.
+"""

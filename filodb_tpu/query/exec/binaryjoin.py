@@ -1,0 +1,206 @@
+"""Binary join and set operator exec nodes.
+
+Counterpart of reference ``BinaryJoinExec.scala:1-210`` (hash join on label
+subsets, one-to-one / group_left / group_right cardinalities) and
+``SetOperatorExec.scala:1-281`` (and/or/unless). Label matching happens on
+host (small), value computation is a vectorized elementwise kernel over
+gathered row indices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.core.partkey import METRIC_LABEL
+from filodb_tpu.query.engine.instantfns import apply_binary_op
+from filodb_tpu.query.exec.plan import ExecContext, NonLeafExecPlan
+from filodb_tpu.query.model import RangeVectorKey, StepMatrix
+
+
+def _join_key(key: RangeVectorKey, on, ignoring) -> RangeVectorKey:
+    if on is not None:
+        return key.only(on)
+    return key.without(tuple(ignoring) + (METRIC_LABEL,))
+
+
+@dataclass
+class BinaryJoinExec(NonLeafExecPlan):
+    lhs_plans: list = field(default_factory=list)
+    rhs_plans: list = field(default_factory=list)
+    op: str = "+"
+    cardinality: str = "one-to-one"
+    on: tuple[str, ...] | None = None
+    ignoring: tuple[str, ...] = ()
+    include: tuple[str, ...] = ()
+    bool_mode: bool = False
+
+    def children(self):
+        return self.lhs_plans + self.rhs_plans
+
+    def do_execute(self, ctx: ExecContext) -> StepMatrix:
+        lhs = StepMatrix.concat(
+            [p.dispatcher.dispatch(p, ctx).result for p in self.lhs_plans])
+        rhs = StepMatrix.concat(
+            [p.dispatcher.dispatch(p, ctx).result for p in self.rhs_plans])
+        steps = lhs.steps_ms if lhs.num_steps else rhs.steps_ms
+        if lhs.num_series == 0 or rhs.num_series == 0:
+            return StepMatrix([], np.zeros((0, len(steps))), steps)
+
+        flipped = self.cardinality == "one-to-many"  # group_right
+        many, one = (rhs, lhs) if flipped else (lhs, rhs)
+
+        one_index: dict[RangeVectorKey, int] = {}
+        for i, k in enumerate(one.keys):
+            jk = _join_key(k, self.on, self.ignoring)
+            if jk in one_index:
+                side = "right" if not flipped else "left"
+                raise ValueError(
+                    f"multiple matches on {side} side for {jk} "
+                    f"(many-to-many not allowed for {self.op})")
+            one_index[jk] = i
+
+        if self.cardinality == "one-to-one":
+            seen: dict[RangeVectorKey, int] = {}
+            for k in many.keys:
+                jk = _join_key(k, self.on, self.ignoring)
+                seen[jk] = seen.get(jk, 0) + 1
+                if seen[jk] > 1:
+                    raise ValueError(
+                        f"multiple matches on left side for {jk} "
+                        f"(use group_left/group_right)")
+
+        many_idx, one_idx, out_keys = [], [], []
+        for i, k in enumerate(many.keys):
+            jk = _join_key(k, self.on, self.ignoring)
+            j = one_index.get(jk)
+            if j is None:
+                continue
+            many_idx.append(i)
+            one_idx.append(j)
+            out_keys.append(self._result_key(k, one.keys[j]))
+        if not many_idx:
+            return StepMatrix([], np.zeros((0, len(steps))), steps)
+
+        mv = jnp.asarray(many.values[np.array(many_idx)])
+        ov = jnp.asarray(one.values[np.array(one_idx)])
+        l_v, r_v = (ov, mv) if flipped else (mv, ov)
+        if self.op in ("==", "!=", ">", "<", ">=", "<=") and not self.bool_mode:
+            cond = apply_binary_op(self.op, l_v, r_v, bool_mode=True) == 1.0
+            out = np.asarray(jnp.where(cond, mv, jnp.nan))
+        else:
+            out = np.asarray(apply_binary_op(self.op, l_v, r_v,
+                                             self.bool_mode))
+        return StepMatrix(out_keys, out, steps).compact()
+
+    def _result_key(self, many_key: RangeVectorKey,
+                    one_key: RangeVectorKey) -> RangeVectorKey:
+        if self.cardinality == "one-to-one":
+            if self.on is not None:
+                return many_key.only(self.on)
+            return many_key.without(tuple(self.ignoring) + (METRIC_LABEL,))
+        # group_left/right: keys of the "many" side (metric dropped) plus
+        # include labels copied from the "one" side
+        lm = many_key.without((METRIC_LABEL,)).label_map
+        one_lm = one_key.label_map
+        for lbl in self.include:
+            if lbl in one_lm:
+                lm[lbl] = one_lm[lbl]
+            else:
+                lm.pop(lbl, None)
+        return RangeVectorKey.of(lm)
+
+    def __repr__(self):
+        return (f"BinaryJoinExec(op={self.op}, card={self.cardinality}, "
+                f"on={self.on}, ignoring={self.ignoring})")
+
+
+@dataclass
+class SetOperatorExec(NonLeafExecPlan):
+    """and / or / unless (reference ``SetOperatorExec.scala``). Presence is
+    per-step: `and` keeps lhs samples where a matching rhs series has a
+    sample at the same step."""
+
+    lhs_plans: list = field(default_factory=list)
+    rhs_plans: list = field(default_factory=list)
+    op: str = "and"
+    on: tuple[str, ...] | None = None
+    ignoring: tuple[str, ...] = ()
+
+    def children(self):
+        return self.lhs_plans + self.rhs_plans
+
+    def do_execute(self, ctx: ExecContext) -> StepMatrix:
+        lhs = StepMatrix.concat(
+            [p.dispatcher.dispatch(p, ctx).result for p in self.lhs_plans])
+        rhs = StepMatrix.concat(
+            [p.dispatcher.dispatch(p, ctx).result for p in self.rhs_plans])
+        steps = lhs.steps_ms if lhs.num_steps else rhs.steps_ms
+        K = len(steps)
+
+        # per join-key presence masks of rhs, per step
+        rhs_present: dict[RangeVectorKey, np.ndarray] = {}
+        for i, k in enumerate(rhs.keys):
+            jk = _join_key(k, self.on, self.ignoring)
+            m = ~np.isnan(rhs.values[i])
+            if jk in rhs_present:
+                rhs_present[jk] |= m
+            else:
+                rhs_present[jk] = m
+
+        if self.op == "and":
+            keys, vals = [], []
+            for i, k in enumerate(lhs.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                m = rhs_present.get(jk)
+                if m is None:
+                    continue
+                keys.append(k)
+                vals.append(np.where(m, lhs.values[i], np.nan))
+            out = (np.stack(vals) if vals else np.zeros((0, K)))
+            return StepMatrix(keys, out, steps).compact()
+
+        if self.op == "unless":
+            keys, vals = [], []
+            for i, k in enumerate(lhs.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                m = rhs_present.get(jk)
+                v = lhs.values[i] if m is None else np.where(m, np.nan,
+                                                             lhs.values[i])
+                keys.append(k)
+                vals.append(v)
+            out = (np.stack(vals) if vals else np.zeros((0, K)))
+            return StepMatrix(keys, out, steps).compact()
+
+        if self.op == "or":
+            lhs_present: dict[RangeVectorKey, np.ndarray] = {}
+            for i, k in enumerate(lhs.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                m = ~np.isnan(lhs.values[i])
+                if jk in lhs_present:
+                    lhs_present[jk] |= m
+                else:
+                    lhs_present[jk] = m
+            keys = list(lhs.keys)
+            vals = [lhs.values[i] for i in range(lhs.num_series)]
+            for i, k in enumerate(rhs.keys):
+                jk = _join_key(k, self.on, self.ignoring)
+                lm = lhs_present.get(jk)
+                if lm is None:
+                    keys.append(k)
+                    vals.append(rhs.values[i])
+                else:
+                    # rhs samples only at steps where no lhs series present
+                    v = np.where(lm, np.nan, rhs.values[i])
+                    if not np.isnan(v).all():
+                        keys.append(k)
+                        vals.append(v)
+            out = (np.stack(vals) if vals else np.zeros((0, K)))
+            return StepMatrix(keys, out, steps).compact()
+
+        raise ValueError(f"unknown set op {self.op}")
+
+    def __repr__(self):
+        return f"SetOperatorExec(op={self.op})"

@@ -1,0 +1,398 @@
+"""RangeVectorTransformers: per-plan post-processing stages.
+
+Counterpart of reference ``RangeVectorTransformer.scala:1-489`` +
+``PeriodicSamplesMapper.scala`` + ``HistogramQuantileMapper.scala`` — but
+operating on whole StepMatrix batches; each transformer is host orchestration
+around jitted kernels.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from filodb_tpu.core.partkey import METRIC_LABEL
+from filodb_tpu.query.engine import kernels
+from filodb_tpu.query.engine.aggregations import (
+    aggregate as agg_kernel,
+    histogram_quantile,
+    quantile_across,
+    topk_mask,
+)
+from filodb_tpu.query.engine.batch import TS_PAD, SeriesBatch
+from filodb_tpu.query.engine.instantfns import apply_binary_op, apply_instant_fn
+from filodb_tpu.query.model import RangeVectorKey, ScalarResult, StepMatrix
+
+
+class RangeVectorTransformer:
+    def apply(self, data: StepMatrix) -> StepMatrix:  # pragma: no cover
+        raise NotImplementedError
+
+
+def steps_array(start: int, step: int, end: int) -> np.ndarray:
+    """Step timestamps [start, end] inclusive (epoch ms)."""
+    if step <= 0:
+        return np.array([end], dtype=np.int64)
+    return np.arange(start, end + 1, step, dtype=np.int64)
+
+
+@dataclass
+class PeriodicSamplesMapper(RangeVectorTransformer):
+    """THE hot windowing operator (reference ``PeriodicSamplesMapper.scala``):
+    evaluates a range function (or instant-vector last-sample materialization)
+    at each step. Operates on a SeriesBatch via the kernel library — O(P·(S+K))
+    instead of per-sample sliding windows."""
+
+    start: int
+    step: int
+    end: int
+    window: int = 0
+    function: str | None = None  # None => instant last-sample semantics
+    params: tuple = ()
+    offset: int = 0
+    is_counter: bool = False
+    keep_metric: bool = False
+
+    def eval_batch(self, batch: SeriesBatch,
+                   keys: list[RangeVectorKey]) -> StepMatrix:
+        steps = steps_array(self.start, self.step, self.end)
+        eval_steps = steps - self.offset
+        rel_steps = (eval_steps - batch.base_ts).astype(np.int32)
+        fn = self.function or "last_sample"
+        window = self.window if self.function else 300_000  # staleness lookback
+        ts_j = jnp.asarray(batch.ts)
+        counts_j = jnp.asarray(batch.counts)
+        steps_j = jnp.asarray(rel_steps)
+        win_j = jnp.asarray(np.int32(window))
+
+        if batch.is_histogram:
+            # apply the range function per bucket: vmap over B
+            import jax
+            vals_j = jnp.asarray(batch.vals)  # [P, S, B]
+
+            def per_bucket(vb):
+                return kernels.range_eval(fn, ts_j, vb, counts_j, steps_j,
+                                          win_j, counter=self.is_counter)
+
+            out = jax.vmap(per_bucket, in_axes=2, out_axes=2)(vals_j)
+            out = np.asarray(out)[: batch.num_series]
+            m = StepMatrix(self._out_keys(keys), out, steps, batch.les)
+            return m
+
+        vals_j = jnp.asarray(batch.vals)
+        if fn == "quantile_over_time":
+            out = kernels.quantile_over_time(self.params[0], ts_j, vals_j,
+                                             counts_j, steps_j, win_j)
+        elif fn == "holt_winters":
+            sf, tf = self.params
+            out = kernels.holt_winters(sf, tf, ts_j, vals_j, counts_j,
+                                       steps_j, win_j)
+        elif fn == "predict_linear":
+            out = kernels.range_eval("predict_linear", ts_j, vals_j, counts_j,
+                                     steps_j, win_j,
+                                     extra=float(self.params[0]))
+        else:
+            out = kernels.range_eval(fn, ts_j, vals_j, counts_j, steps_j,
+                                     win_j, counter=self.is_counter)
+        out = np.asarray(out)[: batch.num_series]
+        if fn == "timestamp" and self.offset == 0:
+            pass  # timestamps already epoch-relative; rebase below
+        if fn == "timestamp":
+            # kernel returned relative seconds; rebase to epoch
+            out = out + batch.base_ts / 1000.0
+        return StepMatrix(self._out_keys(keys), out, steps)
+
+    def _out_keys(self, keys):
+        if self.function and not self.keep_metric:
+            return [k.drop_metric() for k in keys]
+        return list(keys)
+
+    # matrix-in/matrix-out path (subqueries)
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        """Apply the range function over an already-evaluated inner matrix
+        (subquery): inner steps act as samples."""
+        steps = steps_array(self.start, self.step, self.end)
+        P = data.num_series
+        if P == 0:
+            return StepMatrix([], np.zeros((0, len(steps))), steps)
+        # compact per-series NaN samples into padded ts/vals arrays
+        inner_ts = data.steps_ms  # [S]
+        S = len(inner_ts)
+        base = int(inner_ts[0]) if S else 0
+        ts_arr = np.full((P, max(S, 1)), TS_PAD, np.int32)
+        vals_arr = np.zeros((P, max(S, 1)), np.float64)
+        counts = np.zeros(P, np.int32)
+        for i in range(P):
+            valid = ~np.isnan(data.values[i])
+            n = int(valid.sum())
+            counts[i] = n
+            ts_arr[i, :n] = (inner_ts[valid] - base).astype(np.int32)
+            vals_arr[i, :n] = data.values[i][valid]
+        batch = SeriesBatch(base, ts_arr, vals_arr, counts,
+                            list(range(P)), data.les)
+        return self.eval_batch(batch, data.keys)
+
+
+@dataclass
+class AggregateMapReduce(RangeVectorTransformer):
+    """Label-grouped aggregation (reference ``AggregateMapReduce`` +
+    RowAggregators), lowered to segment reductions."""
+
+    op: str
+    params: tuple = ()
+    by: tuple[str, ...] = ()
+    without: tuple[str, ...] = ()
+
+    def group_keys(self, keys: list[RangeVectorKey]) -> list[RangeVectorKey]:
+        if self.by:
+            return [k.only(self.by) for k in keys]
+        if self.without:
+            return [k.without(self.without).drop_metric() for k in keys]
+        return [RangeVectorKey(()) for _ in keys]
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if data.num_series == 0:
+            return data
+        gkeys = self.group_keys(data.keys)
+        uniq: dict[RangeVectorKey, int] = {}
+        gids = np.empty(len(gkeys), np.int32)
+        for i, gk in enumerate(gkeys):
+            gids[i] = uniq.setdefault(gk, len(uniq))
+        out_keys = list(uniq.keys())
+        G = len(uniq)
+        v = jnp.asarray(data.values)
+        g = jnp.asarray(gids)
+
+        if self.op in ("sum", "avg", "count", "min", "max", "stddev",
+                       "stdvar", "group"):
+            if data.is_histogram:  # hist sum aggregates per bucket
+                import jax
+                out = jax.vmap(
+                    lambda vb: agg_kernel(self.op, vb, g, G),
+                    in_axes=2, out_axes=2)(v)
+                return StepMatrix(out_keys, np.asarray(out), data.steps_ms,
+                                  data.les)
+            out = agg_kernel(self.op, v, g, G)
+            return StepMatrix(out_keys, np.asarray(out), data.steps_ms)
+
+        if self.op in ("topk", "bottomk"):
+            k = int(self.params[0])
+            mask = np.asarray(topk_mask(v, g, G, k, self.op == "bottomk"))
+            vals = np.where(mask, data.values, np.nan)
+            return StepMatrix(list(data.keys), vals, data.steps_ms).compact()
+
+        if self.op == "quantile":
+            out = quantile_across(float(self.params[0]), v, g, G)
+            return StepMatrix(out_keys, np.asarray(out), data.steps_ms)
+
+        if self.op == "count_values":
+            label = str(self.params[0])
+            # host-side: distinct values become output series
+            out_map: dict[tuple[RangeVectorKey, str], np.ndarray] = {}
+            vals = data.values
+            K = data.num_steps
+            for gi, gk in enumerate(out_keys):
+                members = np.where(gids == gi)[0]
+                sub = vals[members]  # [m, K]
+                for k_step in range(K):
+                    col = sub[:, k_step]
+                    col = col[~np.isnan(col)]
+                    for val, cnt in zip(*np.unique(col, return_counts=True)):
+                        vstr = _fmt_value(val)
+                        key = (gk, vstr)
+                        if key not in out_map:
+                            out_map[key] = np.full(K, np.nan)
+                        out_map[key][k_step] = cnt
+            keys = [RangeVectorKey(tuple(sorted(
+                list(gk.labels) + [(label, vstr)])))
+                for (gk, vstr) in out_map]
+            values = (np.stack(list(out_map.values()))
+                      if out_map else np.zeros((0, K)))
+            return StepMatrix(keys, values, data.steps_ms)
+
+        raise ValueError(f"unknown aggregation {self.op}")
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+@dataclass
+class InstantVectorFunctionMapper(RangeVectorTransformer):
+    function: str
+    args: tuple = ()
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if self.function in ("histogram_quantile", "histogram_max_quantile"):
+            q = float(self.args[0])
+            if data.is_histogram:
+                out = np.asarray(histogram_quantile(
+                    q, jnp.asarray(data.values), jnp.asarray(data.les)))
+                keys = [k.drop_metric() for k in data.keys]
+                return StepMatrix(keys, out, data.steps_ms)
+            return self._bucket_quantile(q, data)
+        vals = jnp.asarray(data.values)
+        if self.function in ("hour", "minute", "month", "year", "day_of_month",
+                             "day_of_week", "day_of_year", "days_in_month"):
+            out = np.asarray(apply_instant_fn(self.function, vals))
+        else:
+            params = tuple(float(a) for a in self.args)
+            out = np.asarray(apply_instant_fn(self.function, vals,
+                                              params=params))
+        keys = [k.drop_metric() for k in data.keys]
+        return StepMatrix(keys, out, data.steps_ms, data.les)
+
+    def _bucket_quantile(self, q: float, data: StepMatrix) -> StepMatrix:
+        """histogram_quantile over prom-style `le`-labelled bucket series
+        (reference ``HistogramQuantileMapper.scala:1-149``)."""
+        groups: dict[RangeVectorKey, list[tuple[float, int]]] = {}
+        for i, k in enumerate(data.keys):
+            lm = k.label_map
+            le = lm.get("le")
+            if le is None:
+                continue
+            gk = k.without(("le", METRIC_LABEL))
+            groups.setdefault(gk, []).append((float(le), i))
+        if not groups:
+            return StepMatrix([], np.zeros((0, data.num_steps)),
+                              data.steps_ms)
+        out_keys = []
+        outs = []
+        for gk, buckets in groups.items():
+            buckets.sort()
+            les = np.array([b[0] for b in buckets])
+            idx = [b[1] for b in buckets]
+            h = data.values[idx]  # [B, K]
+            # make cumulative counts monotonic across buckets (prom tolerates
+            # slight non-monotonicity from scrapes)
+            h = np.maximum.accumulate(np.nan_to_num(h, nan=0.0), axis=0)
+            res = np.asarray(histogram_quantile(
+                q, jnp.asarray(h.T[None]), jnp.asarray(les)))[0]  # [K]
+            out_keys.append(gk)
+            outs.append(res)
+        return StepMatrix(out_keys, np.stack(outs), data.steps_ms)
+
+
+@dataclass
+class ScalarOperationMapper(RangeVectorTransformer):
+    """vector-scalar binary op (reference ``ScalarOperationMapper``)."""
+
+    op: str
+    scalar: "ScalarResult | float"
+    scalar_is_lhs: bool = True
+    bool_mode: bool = False
+
+    _COMPARISONS = ("==", "!=", ">", "<", ">=", "<=")
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        v = jnp.asarray(data.values)
+        if isinstance(self.scalar, ScalarResult):
+            sc = jnp.asarray(self.scalar.values)[None, :]
+        else:
+            sc = jnp.asarray(float(self.scalar))
+        sc = jnp.broadcast_to(sc, v.shape)
+        lhs, rhs = (sc, v) if self.scalar_is_lhs else (v, sc)
+        if self.op in self._COMPARISONS and not self.bool_mode:
+            # comparison filtering keeps the *vector* sample values
+            cond = ~jnp.isnan(apply_binary_op(self.op, lhs, rhs,
+                                              bool_mode=True)) \
+                & (apply_binary_op(self.op, lhs, rhs, bool_mode=True) == 1.0)
+            out = np.asarray(jnp.where(cond, v, jnp.nan))
+        else:
+            out = np.asarray(apply_binary_op(self.op, lhs, rhs,
+                                             self.bool_mode))
+        keys = [k.drop_metric() for k in data.keys]
+        return StepMatrix(keys, out, data.steps_ms)
+
+
+@dataclass
+class MiscellaneousFunctionMapper(RangeVectorTransformer):
+    function: str
+    args: tuple = ()
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if self.function == "label_replace":
+            dst, repl, src, regex = self.args[:4]
+            pat = re.compile(f"^(?:{regex})$")
+            keys = []
+            for k in data.keys:
+                lm = k.label_map
+                m = pat.match(lm.get(src, ""))
+                if m:
+                    val = m.expand(_dollar_to_backslash(repl))
+                    if val:
+                        lm[dst] = val
+                    else:
+                        lm.pop(dst, None)
+                keys.append(RangeVectorKey.of(lm))
+            return StepMatrix(keys, data.values, data.steps_ms, data.les)
+        if self.function == "label_join":
+            dst, sep, *srcs = self.args
+            keys = []
+            for k in data.keys:
+                lm = k.label_map
+                lm[dst] = sep.join(lm.get(s, "") for s in srcs)
+                keys.append(RangeVectorKey.of(lm))
+            return StepMatrix(keys, data.values, data.steps_ms, data.les)
+        raise ValueError(f"unknown misc function {self.function}")
+
+
+def _dollar_to_backslash(repl: str) -> str:
+    # promql uses $1; python re.expand uses \1
+    return re.sub(r"\$(\d+|\{\w+\})", lambda m: "\\" +
+                  m.group(1).strip("{}"), repl)
+
+
+@dataclass
+class SortFunctionMapper(RangeVectorTransformer):
+    descending: bool = False
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if data.num_series == 0:
+            return data
+        # sort by value at the last step with any data (prom: instant sort)
+        v = np.nan_to_num(data.values[:, -1], nan=-np.inf if not
+                          self.descending else np.inf)
+        order = np.argsort(-v if self.descending else v, kind="stable")
+        return StepMatrix([data.keys[i] for i in order], data.values[order],
+                          data.steps_ms, data.les)
+
+
+@dataclass
+class AbsentFunctionMapper(RangeVectorTransformer):
+    filters: tuple = ()
+    start: int = 0
+    step: int = 1000
+    end: int = 0
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        steps = steps_array(self.start, self.step, self.end)
+        if data.num_series == 0:
+            present = np.zeros(len(steps), bool)
+        else:
+            present = ~np.all(np.isnan(data.values), axis=0)
+        out = np.where(present, np.nan, 1.0)[None, :]
+        labels = {}
+        from filodb_tpu.core.filters import Equals
+        for f in self.filters:
+            if isinstance(f.filter, Equals) and f.column != METRIC_LABEL:
+                labels[f.column] = f.filter.value
+        if not np.isnan(out).all():
+            return StepMatrix([RangeVectorKey.of(labels)], out, steps)
+        return StepMatrix([], np.zeros((0, len(steps))), steps)
+
+
+@dataclass
+class LimitFunctionMapper(RangeVectorTransformer):
+    limit: int = 1000
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if data.num_series <= self.limit:
+            return data
+        return StepMatrix(data.keys[: self.limit],
+                          data.values[: self.limit], data.steps_ms, data.les)

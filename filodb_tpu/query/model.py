@@ -1,0 +1,160 @@
+"""Query result model.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/query/``
+(``RangeVector.scala:27,121,315``, ``QueryContext.scala:44``, ``ResultTypes``):
+but column-oriented — the unit of data flowing through the exec tree is a
+``StepMatrix``: a batch of series keys plus a dense [P, K] value matrix (or
+[P, K, B] for histogram-valued vectors) over shared step timestamps. NaN marks
+"no sample". This is the TPU-first replacement for per-row RangeVector
+iterators; a ``StepMatrix`` converts to per-series (ts, value) pairs only at
+the API boundary.
+"""
+
+from __future__ import annotations
+
+import time as _time
+import uuid
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from filodb_tpu.core.partkey import METRIC_LABEL
+
+
+@dataclass(frozen=True)
+class RangeVectorKey:
+    """Series identity: a frozen label set (reference ``RangeVectorKey``)."""
+
+    labels: tuple[tuple[str, str], ...]
+
+    @staticmethod
+    def of(labels: dict[str, str]) -> "RangeVectorKey":
+        return RangeVectorKey(tuple(sorted(labels.items())))
+
+    @property
+    def label_map(self) -> dict[str, str]:
+        return dict(self.labels)
+
+    def without(self, names) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple((k, v) for k, v in self.labels
+                                    if k not in ns))
+
+    def only(self, names) -> "RangeVectorKey":
+        ns = set(names)
+        return RangeVectorKey(tuple((k, v) for k, v in self.labels if k in ns))
+
+    def drop_metric(self) -> "RangeVectorKey":
+        return self.without((METRIC_LABEL,))
+
+    def __str__(self) -> str:
+        return "{" + ",".join(f"{k}={v}" for k, v in self.labels) + "}"
+
+
+@dataclass
+class StepMatrix:
+    """A batch of series sharing step timestamps.
+
+    values: float64 [P, K]; histogram results use values [P, K, B] + les [B].
+    """
+
+    keys: list[RangeVectorKey]
+    values: np.ndarray
+    steps_ms: np.ndarray  # int64 [K] epoch millis
+    les: np.ndarray | None = None
+
+    @property
+    def num_series(self) -> int:
+        return len(self.keys)
+
+    @property
+    def num_steps(self) -> int:
+        return len(self.steps_ms)
+
+    @property
+    def is_histogram(self) -> bool:
+        return self.values.ndim == 3
+
+    def compact(self) -> "StepMatrix":
+        """Drop series with no samples at all."""
+        if self.num_series == 0:
+            return self
+        if self.is_histogram:
+            keep = ~np.all(np.isnan(self.values[:, :, -1]), axis=1)
+        else:
+            keep = ~np.all(np.isnan(self.values), axis=1)
+        if keep.all():
+            return self
+        keys = [k for k, m in zip(self.keys, keep) if m]
+        return StepMatrix(keys, self.values[keep], self.steps_ms, self.les)
+
+    @staticmethod
+    def empty(steps_ms: np.ndarray | None = None) -> "StepMatrix":
+        steps = steps_ms if steps_ms is not None else np.array([], np.int64)
+        return StepMatrix([], np.zeros((0, len(steps))), steps)
+
+    @staticmethod
+    def concat(parts: list["StepMatrix"]) -> "StepMatrix":
+        parts = [p for p in parts if p.num_series > 0]
+        if not parts:
+            return StepMatrix.empty()
+        keys = [k for p in parts for k in p.keys]
+        values = np.concatenate([p.values for p in parts], axis=0)
+        return StepMatrix(keys, values, parts[0].steps_ms, parts[0].les)
+
+
+@dataclass
+class ScalarResult:
+    """A per-step scalar (time(), scalar(v), scalar literals)."""
+
+    values: np.ndarray  # [K]
+    steps_ms: np.ndarray
+
+
+@dataclass
+class QueryError:
+    message: str
+    query_id: str = ""
+
+
+@dataclass
+class QueryStats:
+    series_scanned: int = 0
+    samples_scanned: int = 0
+    result_series: int = 0
+    wall_time_s: float = 0.0
+    cpu_prep_s: float = 0.0
+    device_time_s: float = 0.0
+
+
+@dataclass
+class QueryResult:
+    result: StepMatrix
+    stats: QueryStats = field(default_factory=QueryStats)
+    query_id: str = ""
+
+
+@dataclass
+class PlannerParams:
+    """Reference ``PlannerParams`` (spread, sample limits...)."""
+
+    spread: int = 1
+    sample_limit: int = 1_000_000
+    enforce_sample_limit: bool = True
+    shard_overrides: list[int] | None = None
+    process_failure: bool = True
+
+
+@dataclass
+class QueryContext:
+    """Reference ``QueryContext.scala:44``."""
+
+    query_id: str = field(default_factory=lambda: uuid.uuid4().hex[:12])
+    submit_time_ms: int = field(
+        default_factory=lambda: int(_time.time() * 1000))
+    origin: str = ""
+    planner_params: PlannerParams = field(default_factory=PlannerParams)
+
+
+class QueryLimitExceeded(RuntimeError):
+    pass
