@@ -1,0 +1,15 @@
+"""Distributed execution over a jax.sharding.Mesh.
+
+The TPU-native replacement for the reference's distributed communication
+backend (SURVEY.md §2.14: Akka Cluster + remoting + Kryo-serialized ExecPlan
+shipping): intra-query distribution is expressed as SPMD programs over a
+``Mesh`` with XLA collectives —
+
+- axis ``"shard"``: data parallelism over series (the reference's shard
+  partitioning P1) — cross-shard aggregation via ``psum`` riding ICI;
+- axis ``"time"``: sequence parallelism over the sample/time dimension (the
+  reference's temporal-splitting axis P5) — windows crossing block boundaries
+  are reconciled by exchanging tiny per-step partial summaries
+  (``all_gather`` over the time axis), the TSDB analog of ring-attention's
+  halo exchange.
+"""
