@@ -1,0 +1,197 @@
+"""ctypes bindings to the C++ native runtime (``native/filodb_native.cpp``).
+
+Builds the shared library on demand (cached by source mtime) and exposes:
+- fast NibblePack pack/unpack, zigzag, XOR-double prep — byte-identical to
+  the numpy reference implementations; used by the ingest/flush hot path.
+- the block arena (reference ``BlockManager`` semantics).
+
+Falls back gracefully (``HAVE_NATIVE = False``) when no compiler is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libfilodb_native.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "filodb_native.cpp")
+
+_lib = None
+_lock = threading.Lock()
+HAVE_NATIVE = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # pragma: no cover - toolchain missing
+        log.warning("native build failed, using numpy codecs: %s", e)
+        return False
+
+
+def _load():
+    global _lib, HAVE_NATIVE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO_PATH)
+                or os.path.getmtime(_SO_PATH) < os.path.getmtime(_SRC_PATH)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:  # pragma: no cover
+            log.warning("native load failed: %s", e)
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        i64 = ctypes.c_int64
+        lib.nibble_pack.argtypes = [u64p, i64, u8p]
+        lib.nibble_pack.restype = i64
+        lib.nibble_unpack.argtypes = [u8p, i64, u64p, i64]
+        lib.nibble_unpack.restype = i64
+        lib.zigzag_encode_i64.argtypes = [i64p, u64p, i64]
+        lib.zigzag_decode_u64.argtypes = [u64p, i64p, i64]
+        lib.xor_encode_f64.argtypes = [f64p, u64p, i64]
+        lib.xor_decode_f64.argtypes = [u64p, f64p, i64]
+        lib.delta_delta_residuals.argtypes = [i64p, i64, i64, i64, i64p]
+        lib.delta_delta_residuals.restype = ctypes.c_int
+        lib.delta_delta_reconstruct.argtypes = [i64p, i64, i64, i64, i64p]
+        lib.arena_create.argtypes = [i64]
+        lib.arena_create.restype = ctypes.c_void_p
+        lib.arena_alloc_block.argtypes = [ctypes.c_void_p, i64]
+        lib.arena_alloc_block.restype = ctypes.c_void_p
+        lib.block_alloc.argtypes = [ctypes.c_void_p, i64]
+        lib.block_alloc.restype = i64
+        lib.block_data.argtypes = [ctypes.c_void_p]
+        lib.block_data.restype = u8p
+        lib.block_remaining.argtypes = [ctypes.c_void_p]
+        lib.block_remaining.restype = i64
+        lib.arena_reclaim_owner.argtypes = [ctypes.c_void_p, i64]
+        lib.arena_reclaim_owner.restype = i64
+        lib.arena_stats.argtypes = [ctypes.c_void_p, i64]
+        lib.arena_stats.restype = i64
+        lib.arena_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        HAVE_NATIVE = True
+        return lib
+
+
+def get_lib():
+    return _load()
+
+
+def _as_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def nibble_pack_native(values: np.ndarray) -> bytes | None:
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(values, dtype=np.uint64)
+    n = len(vals)
+    out = np.empty(2 + 10 * max(n, 8), np.uint8)
+    written = lib.nibble_pack(_as_ptr(vals, ctypes.c_uint64), n,
+                              _as_ptr(out, ctypes.c_uint8))
+    return out[:written].tobytes()
+
+
+def nibble_unpack_native(data: bytes, count: int) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(count, np.uint64)
+    consumed = lib.nibble_unpack(_as_ptr(buf, ctypes.c_uint8), len(buf),
+                                 _as_ptr(out, ctypes.c_uint64), count)
+    if consumed < 0:
+        raise ValueError("truncated NibblePack stream")
+    return out
+
+
+def xor_encode_native(values: np.ndarray) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    out = np.empty(len(v), np.uint64)
+    lib.xor_encode_f64(_as_ptr(v, ctypes.c_double),
+                       _as_ptr(out, ctypes.c_uint64), len(v))
+    return out
+
+
+def xor_decode_native(xored: np.ndarray) -> np.ndarray | None:
+    lib = _load()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(xored, dtype=np.uint64)
+    out = np.empty(len(x), np.float64)
+    lib.xor_decode_f64(_as_ptr(x, ctypes.c_uint64),
+                       _as_ptr(out, ctypes.c_double), len(x))
+    return out
+
+
+class NativeArena:
+    """Block arena handle (reference ``PageAlignedBlockManager``)."""
+
+    def __init__(self, block_size: int = 1 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._arena = lib.arena_create(block_size)
+        self.block_size = block_size
+
+    def alloc_block(self, owner: int) -> ctypes.c_void_p:
+        return ctypes.c_void_p(self._lib.arena_alloc_block(self._arena, owner))
+
+    def block_alloc(self, block, nbytes: int) -> int:
+        return self._lib.block_alloc(block, nbytes)
+
+    def block_remaining(self, block) -> int:
+        return self._lib.block_remaining(block)
+
+    def write(self, block, offset: int, data: bytes) -> None:
+        ptr = self._lib.block_data(block)
+        ctypes.memmove(ctypes.addressof(ptr.contents) + offset, data,
+                       len(data))
+
+    def read(self, block, offset: int, n: int) -> bytes:
+        ptr = self._lib.block_data(block)
+        return ctypes.string_at(ctypes.addressof(ptr.contents) + offset, n)
+
+    def reclaim_owner(self, owner: int) -> int:
+        return self._lib.arena_reclaim_owner(self._arena, owner)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "allocated_blocks": self._lib.arena_stats(self._arena, 0),
+            "reclaimed_blocks": self._lib.arena_stats(self._arena, 1),
+            "bytes_in_use": self._lib.arena_stats(self._arena, 2),
+        }
+
+    def close(self):
+        if self._arena:
+            self._lib.arena_destroy(self._arena)
+            self._arena = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -57,7 +57,18 @@ def _trailing_zero_nibbles(x: int) -> int:
 
 
 def nibble_pack(values: np.ndarray) -> bytes:
-    """Pack an array of uint64 into NibblePack bytes."""
+    """Pack an array of uint64 into NibblePack bytes (native fast path when
+    the C++ library is available, byte-identical output)."""
+    from filodb_tpu.memory import native
+
+    out = native.nibble_pack_native(values)
+    if out is not None:
+        return out
+    return nibble_pack_py(values)
+
+
+def nibble_pack_py(values: np.ndarray) -> bytes:
+    """Pure-python reference implementation."""
     vals = np.ascontiguousarray(values, dtype=np.uint64)
     out = bytearray()
     n = len(vals)
@@ -97,6 +108,16 @@ def nibble_pack(values: np.ndarray) -> bytes:
 
 def nibble_unpack(data: bytes, count: int) -> np.ndarray:
     """Unpack ``count`` uint64 values from NibblePack bytes."""
+    from filodb_tpu.memory import native
+
+    out = native.nibble_unpack_native(data, count)
+    if out is not None:
+        return out
+    return nibble_unpack_py(data, count)
+
+
+def nibble_unpack_py(data: bytes, count: int) -> np.ndarray:
+    """Pure-python reference implementation."""
     out = np.zeros(count, dtype=np.uint64)
     pos = 0
     idx = 0

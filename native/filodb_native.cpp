@@ -1,0 +1,301 @@
+// filodb_tpu native runtime: columnar codecs + block arena.
+//
+// Counterpart of the reference's off-heap "native tier"
+// (memory/src/main/scala/filodb.memory: UnsafeUtils + jffi page allocation,
+// NibblePack.scala, DeltaDeltaVector.scala, DoubleVector XOR encoding,
+// BlockManager.scala) — here as real native code exposed through a C ABI
+// consumed via ctypes. Byte-identical wire format with the numpy reference
+// implementation in filodb_tpu/memory/nibblepack.py.
+//
+// Build: make -C native   (produces libfilodb_native.so)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <atomic>
+#include <initializer_list>
+#include <new>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// zigzag
+
+void zigzag_encode_i64(const int64_t* in, uint64_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t v = in[i];
+        out[i] = (static_cast<uint64_t>(v) << 1) ^ static_cast<uint64_t>(v >> 63);
+    }
+}
+
+void zigzag_decode_u64(const uint64_t* in, int64_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = in[i];
+        out[i] = static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// NibblePack (see filodb_tpu/memory/nibblepack.py for the format spec)
+
+static inline int nibble_width(uint64_t x) {
+    if (x == 0) return 1;
+    return (64 - __builtin_clzll(x) + 3) / 4;
+}
+
+static inline int trailing_zero_nibbles(uint64_t x) {
+    if (x == 0) return 16;
+    return __builtin_ctzll(x) / 4;
+}
+
+// out must have capacity >= 2 + 8*9 bytes per group of 8 (worst case);
+// returns bytes written.
+int64_t nibble_pack(const uint64_t* vals, int64_t n, uint8_t* out) {
+    uint8_t* p = out;
+    for (int64_t g = 0; g < n; g += 8) {
+        uint64_t group[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+        int64_t cnt = (n - g) < 8 ? (n - g) : 8;
+        std::memcpy(group, vals + g, cnt * sizeof(uint64_t));
+        uint8_t bitmap = 0;
+        for (int i = 0; i < 8; i++)
+            if (group[i]) bitmap |= (1u << i);
+        *p++ = bitmap;
+        if (!bitmap) continue;
+        int tz = 16, lead = 1;
+        for (int i = 0; i < 8; i++) {
+            if (!group[i]) continue;
+            int t = trailing_zero_nibbles(group[i]);
+            if (t < tz) tz = t;
+            int w = nibble_width(group[i]);
+            if (w > lead) lead = w;
+        }
+        int num_nibbles = lead - tz;
+        *p++ = static_cast<uint8_t>(((num_nibbles - 1) << 4) | tz);
+        // pack nibbles little-endian across nonzero values (128-bit
+        // accumulator: up to 64 value bits on top of <8 residual bits)
+        unsigned __int128 acc = 0;
+        int acc_bits = 0;
+        uint64_t mask = (num_nibbles >= 16) ? ~0ULL
+                        : ((1ULL << (4 * num_nibbles)) - 1);
+        for (int i = 0; i < 8; i++) {
+            if (!group[i]) continue;
+            uint64_t x = (group[i] >> (4 * tz)) & mask;
+            acc |= static_cast<unsigned __int128>(x) << acc_bits;
+            acc_bits += 4 * num_nibbles;
+            while (acc_bits >= 8) {
+                *p++ = static_cast<uint8_t>(acc & 0xFF);
+                acc >>= 8;
+                acc_bits -= 8;
+            }
+        }
+        if (acc_bits > 0) *p++ = static_cast<uint8_t>(acc & 0xFF);
+    }
+    return p - out;
+}
+
+// returns bytes consumed, or -1 on truncated input.
+int64_t nibble_unpack(const uint8_t* in, int64_t in_len, uint64_t* out,
+                      int64_t count) {
+    const uint8_t* p = in;
+    const uint8_t* end = in + in_len;
+    int64_t idx = 0;
+    while (idx < count) {
+        if (p >= end) return -1;
+        uint8_t bitmap = *p++;
+        if (!bitmap) {
+            for (int i = 0; i < 8 && idx + i < count; i++) out[idx + i] = 0;
+            idx += 8;
+            continue;
+        }
+        if (p >= end) return -1;
+        uint8_t desc = *p++;
+        int num_nibbles = (desc >> 4) + 1;
+        int tz = desc & 0xF;
+        int nnz = __builtin_popcount(bitmap);
+        int64_t nbytes = (static_cast<int64_t>(nnz) * num_nibbles + 1) / 2;
+        if (p + nbytes > end) return -1;
+        uint64_t mask = (num_nibbles >= 16) ? ~0ULL
+                        : ((1ULL << (4 * num_nibbles)) - 1);
+        // stream nibbles from the byte stream (128-bit accumulator)
+        unsigned __int128 acc = 0;
+        int acc_bits = 0;
+        const uint8_t* q = p;
+        for (int i = 0; i < 8; i++) {
+            uint64_t v = 0;
+            if (bitmap & (1u << i)) {
+                while (acc_bits < 4 * num_nibbles && q < p + nbytes) {
+                    acc |= static_cast<unsigned __int128>(*q++) << acc_bits;
+                    acc_bits += 8;
+                }
+                v = (static_cast<uint64_t>(acc) & mask) << (4 * tz);
+                acc >>= 4 * num_nibbles;
+                acc_bits -= 4 * num_nibbles;
+            }
+            if (idx + i < count) out[idx + i] = v;
+        }
+        p += nbytes;
+        idx += 8;
+    }
+    return p - in;
+}
+
+// ---------------------------------------------------------------------------
+// XOR-double prep
+
+void xor_encode_f64(const double* in, uint64_t* out, int64_t n) {
+    uint64_t prev = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t bits;
+        std::memcpy(&bits, &in[i], 8);
+        out[i] = bits ^ prev;
+        prev = bits;
+    }
+}
+
+void xor_decode_f64(const uint64_t* in, double* out, int64_t n) {
+    uint64_t acc = 0;
+    for (int64_t i = 0; i < n; i++) {
+        acc ^= in[i];
+        std::memcpy(&out[i], &acc, 8);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// delta-delta helpers (sloped-line predictor residuals)
+
+// residual[i] = v[i] - (base + slope*i); returns 1 if all residuals zero
+int delta_delta_residuals(const int64_t* in, int64_t n, int64_t base,
+                          int64_t slope, int64_t* out) {
+    int all_zero = 1;
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = in[i] - (base + slope * i);
+        if (out[i] != 0) all_zero = 0;
+    }
+    return all_zero;
+}
+
+void delta_delta_reconstruct(const int64_t* resid, int64_t n, int64_t base,
+                             int64_t slope, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) out[i] = base + slope * i + resid[i];
+}
+
+// ---------------------------------------------------------------------------
+// Block arena (reference BlockManager/PageAlignedBlockManager semantics:
+// fixed-size page-aligned blocks, owner-tagged, reclaimable lists, stats)
+
+struct Block {
+    uint8_t* data;
+    int64_t size;
+    int64_t used;
+    int64_t owner;
+    Block* next;
+};
+
+struct Arena {
+    int64_t block_size;
+    std::atomic<int64_t> allocated_blocks;
+    std::atomic<int64_t> reclaimed_blocks;
+    std::atomic<int64_t> bytes_in_use;
+    Block* free_list;
+    Block* used_list;
+};
+
+void* arena_create(int64_t block_size) {
+    Arena* a = new (std::nothrow) Arena();
+    if (!a) return nullptr;
+    a->block_size = block_size;
+    a->allocated_blocks = 0;
+    a->reclaimed_blocks = 0;
+    a->bytes_in_use = 0;
+    a->free_list = nullptr;
+    a->used_list = nullptr;
+    return a;
+}
+
+// allocate one block for an owner; returns block handle (or null)
+void* arena_alloc_block(void* arena, int64_t owner) {
+    Arena* a = static_cast<Arena*>(arena);
+    Block* b = a->free_list;
+    if (b) {
+        a->free_list = b->next;
+    } else {
+        b = new (std::nothrow) Block();
+        if (!b) return nullptr;
+        // page-aligned like the reference's PageAlignedBlockManager
+        if (posix_memalign(reinterpret_cast<void**>(&b->data), 4096,
+                           a->block_size) != 0) {
+            delete b;
+            return nullptr;
+        }
+        b->size = a->block_size;
+        a->allocated_blocks++;
+    }
+    b->used = 0;
+    b->owner = owner;
+    b->next = a->used_list;
+    a->used_list = b;
+    a->bytes_in_use += a->block_size;
+    return b;
+}
+
+// bump-allocate within a block; returns offset or -1 when full
+int64_t block_alloc(void* block, int64_t nbytes) {
+    Block* b = static_cast<Block*>(block);
+    int64_t aligned = (nbytes + 7) & ~7LL;
+    if (b->used + aligned > b->size) return -1;
+    int64_t off = b->used;
+    b->used += aligned;
+    return off;
+}
+
+uint8_t* block_data(void* block) { return static_cast<Block*>(block)->data; }
+int64_t block_remaining(void* block) {
+    Block* b = static_cast<Block*>(block);
+    return b->size - b->used;
+}
+
+// reclaim all blocks of an owner back to the free list; returns count
+int64_t arena_reclaim_owner(void* arena, int64_t owner) {
+    Arena* a = static_cast<Arena*>(arena);
+    Block** prev = &a->used_list;
+    int64_t n = 0;
+    while (*prev) {
+        Block* b = *prev;
+        if (b->owner == owner) {
+            *prev = b->next;
+            b->next = a->free_list;
+            a->free_list = b;
+            a->bytes_in_use -= a->block_size;
+            a->reclaimed_blocks++;
+            n++;
+        } else {
+            prev = &b->next;
+        }
+    }
+    return n;
+}
+
+int64_t arena_stats(void* arena, int64_t which) {
+    Arena* a = static_cast<Arena*>(arena);
+    switch (which) {
+        case 0: return a->allocated_blocks.load();
+        case 1: return a->reclaimed_blocks.load();
+        case 2: return a->bytes_in_use.load();
+        default: return -1;
+    }
+}
+
+void arena_destroy(void* arena) {
+    Arena* a = static_cast<Arena*>(arena);
+    for (Block* l : {a->free_list, a->used_list}) {
+        while (l) {
+            Block* nxt = l->next;
+            std::free(l->data);
+            delete l;
+            l = nxt;
+        }
+    }
+    delete a;
+}
+
+}  // extern "C"
