@@ -1,0 +1,6 @@
+"""HTTP API server: Prometheus-compatible query API + admin/health routes.
+
+Counterpart of reference ``http/`` module (``FiloHttpServer.scala:23``,
+``PrometheusApiRoute.scala:37-82``, ``ClusterApiRoute``, ``HealthRoute``; full
+endpoint list in reference ``doc/http_api.md:25-264``).
+"""

@@ -1,0 +1,94 @@
+"""Prometheus HTTP API JSON rendering.
+
+Counterpart of reference ``query/PrometheusModel.scala:13-51`` +
+``PromCirceSupport.scala``: StepMatrix → Prom ``matrix``/``vector``/``scalar``
+response payloads. NaN entries are gaps and are omitted; first-class histogram
+results are flattened to ``le``-labelled bucket series (as the reference does
+when converting histogram RangeVectors to the Prom wire model).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from filodb_tpu.core.partkey import METRIC_LABEL
+from filodb_tpu.query.model import QueryResult, StepMatrix
+
+
+def _fmt(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    return repr(float(v))
+
+
+def _labels_json(key) -> dict:
+    out = {}
+    for k, v in key.labels:
+        out["__name__" if k == METRIC_LABEL else k] = v
+    return out
+
+
+def _flatten_histograms(m: StepMatrix) -> StepMatrix:
+    """[P,K,B] histogram matrix -> per-bucket series with le labels."""
+    from filodb_tpu.query.model import RangeVectorKey
+
+    keys, rows = [], []
+    les = m.les if m.les is not None else np.arange(m.values.shape[2])
+    for i, k in enumerate(m.keys):
+        for b, le in enumerate(les):
+            lm = k.label_map
+            lm["le"] = _fmt(float(le))
+            keys.append(RangeVectorKey.of(lm))
+            rows.append(m.values[i, :, b])
+    return StepMatrix(keys, np.stack(rows) if rows
+                      else np.zeros((0, m.num_steps)), m.steps_ms)
+
+
+def matrix_json(result: QueryResult) -> dict:
+    m = result.result
+    if m.is_histogram:
+        m = _flatten_histograms(m)
+    series = []
+    for i, key in enumerate(m.keys):
+        vals = []
+        row = m.values[i]
+        for k in range(m.num_steps):
+            v = row[k]
+            if not math.isnan(v):
+                vals.append([m.steps_ms[k] / 1000.0, _fmt(v)])
+        if vals:
+            series.append({"metric": _labels_json(key), "values": vals})
+    return {"status": "success",
+            "data": {"resultType": "matrix", "result": series}}
+
+
+def vector_json(result: QueryResult) -> dict:
+    m = result.result
+    if m.is_histogram:
+        m = _flatten_histograms(m)
+    out = []
+    k = m.num_steps - 1
+    for i, key in enumerate(m.keys):
+        v = m.values[i, k] if m.num_steps else float("nan")
+        if not math.isnan(v):
+            out.append({"metric": _labels_json(key),
+                        "value": [m.steps_ms[k] / 1000.0, _fmt(v)]})
+    return {"status": "success",
+            "data": {"resultType": "vector", "result": out}}
+
+
+def scalar_json(result: QueryResult) -> dict:
+    m = result.result
+    k = m.num_steps - 1
+    v = m.values[0, k] if m.num_series else float("nan")
+    return {"status": "success",
+            "data": {"resultType": "scalar",
+                     "result": [m.steps_ms[k] / 1000.0, _fmt(v)]}}
+
+
+def error_json(message: str, error_type: str = "bad_data") -> dict:
+    return {"status": "error", "errorType": error_type, "error": message}

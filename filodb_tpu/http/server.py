@@ -1,0 +1,183 @@
+"""HTTP server exposing the Prometheus API + cluster admin routes.
+
+Counterpart of reference ``FiloHttpServer.scala`` route composition
+(endpoints per ``doc/http_api.md``):
+
+- ``GET /promql/{dataset}/api/v1/query_range?query=&start=&end=&step=``
+- ``GET/POST /promql/{dataset}/api/v1/query?query=&time=``
+- ``GET /promql/{dataset}/api/v1/series?match[]=&start=&end=``
+- ``GET /promql/{dataset}/api/v1/labels``
+- ``GET /promql/{dataset}/api/v1/label/{name}/values``
+- ``GET /api/v1/cluster/{dataset}/status`` (shard statuses)
+- ``GET /__health``, ``GET /metrics`` (Prometheus exposition)
+
+Threaded stdlib server: queries run on the request thread; the memstore's
+read path is immutable-snapshot based so no global lock is needed (mirrors
+the reference's reader/ingester separation).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.http import promjson
+from filodb_tpu.promql.parser import ParseError, TimeStepParams, parse_query
+from filodb_tpu.query.model import QueryLimitExceeded
+from filodb_tpu.utils.metrics import render_prometheus
+
+log = logging.getLogger(__name__)
+
+
+class FiloHttpServer:
+    def __init__(self, services: dict[str, QueryService], host="127.0.0.1",
+                 port=8080, cluster=None):
+        self.services = services
+        self.cluster = cluster
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FiloHttpServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _parse_time(s: str) -> float:
+    return float(s)
+
+
+def _make_handler(server: FiloHttpServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            log.debug(fmt, *args)
+
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._route()
+
+        def do_POST(self):
+            self._route()
+
+        def _route(self):
+            try:
+                url = urlparse(self.path)
+                qs = parse_qs(url.query)
+                if self.command == "POST":
+                    ln = int(self.headers.get("Content-Length") or 0)
+                    if ln:
+                        body = self.rfile.read(ln).decode()
+                        ctype = self.headers.get("Content-Type", "")
+                        if "x-www-form-urlencoded" in ctype:
+                            for k, v in parse_qs(body).items():
+                                qs.setdefault(k, v)
+                parts = [p for p in url.path.split("/") if p]
+                self._dispatch(parts, qs)
+            except (ParseError, ValueError) as e:
+                self._send(400, promjson.error_json(str(e)))
+            except QueryLimitExceeded as e:
+                self._send(422, promjson.error_json(str(e), "query_limit"))
+            except Exception as e:  # pragma: no cover
+                log.exception("request failed")
+                self._send(500, promjson.error_json(str(e), "internal"))
+
+        def _dispatch(self, parts: list[str], qs: dict):
+            if parts == ["__health"]:
+                return self._send(200, {"status": "healthy"})
+            if parts == ["metrics"]:
+                body = render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if len(parts) >= 4 and parts[0] == "promql" \
+                    and parts[2] == "api" and parts[3] == "v1":
+                dataset = parts[1]
+                svc = server.services.get(dataset)
+                if svc is None:
+                    return self._send(404, promjson.error_json(
+                        f"unknown dataset {dataset}"))
+                return self._prom_api(svc, parts[4:], qs)
+            if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1" \
+                    and parts[2] == "cluster":
+                return self._cluster_api(parts[3:], qs)
+            self._send(404, promjson.error_json("not found", "not_found"))
+
+        # -- Prom API --
+
+        def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
+            if rest == ["query_range"]:
+                query = qs["query"][0]
+                start = int(_parse_time(qs["start"][0]))
+                end = int(_parse_time(qs["end"][0]))
+                step = int(float(qs.get("step", ["60"])[0]))
+                r = svc.query_range(query, start, step, end)
+                return self._send(200, promjson.matrix_json(r))
+            if rest == ["query"]:
+                query = qs["query"][0]
+                t = int(_parse_time(qs.get("time", ["0"])[0]))
+                r = svc.query_instant(query, t)
+                return self._send(200, promjson.vector_json(r))
+            if rest == ["series"]:
+                matches = qs.get("match[]", [])
+                start = int(_parse_time(qs.get("start", ["0"])[0]))
+                end = int(_parse_time(qs.get("end", ["9999999999"])[0]))
+                out = []
+                for mtext in matches:
+                    plan = parse_query(mtext, TimeStepParams(start, 0, end))
+                    raw = getattr(plan, "raw", None)
+                    filters = raw.filters if raw is not None else ()
+                    for lm in svc.series(list(filters), start, end):
+                        out.append({("__name__" if k == "_metric_" else k): v
+                                    for k, v in lm.items()})
+                return self._send(200, {"status": "success", "data": out})
+            if rest == ["labels"]:
+                names = svc.memstore.label_names(svc.dataset)
+                return self._send(200, {"status": "success", "data": names})
+            if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
+                vals = svc.memstore.label_values(svc.dataset,
+                                                 unquote(rest[1]))
+                return self._send(200, {"status": "success", "data": vals})
+            self._send(404, promjson.error_json("unknown endpoint"))
+
+        # -- cluster admin --
+
+        def _cluster_api(self, rest: list[str], qs: dict):
+            cluster = server.cluster
+            if not rest:
+                return self._send(200, {"status": "success",
+                                        "data": list(server.services)})
+            dataset = rest[0]
+            if len(rest) == 2 and rest[1] == "status":
+                if cluster is not None:
+                    data = cluster.shard_statuses(dataset)
+                else:
+                    svc = server.services.get(dataset)
+                    data = [{"shard": s.shard_num, "status": "active",
+                             "numPartitions": s.num_partitions}
+                            for s in svc.memstore.shards_for(dataset)] \
+                        if svc else []
+                return self._send(200, {"status": "success", "data": data})
+            self._send(404, promjson.error_json("unknown cluster endpoint"))
+
+    return Handler

@@ -1,0 +1,111 @@
+"""HTTP API tests against a live in-process server.
+
+Mirrors reference ``http/src/test/scala/filodb/http/PrometheusApiRouteSpec``.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.testing.data import counter_series, counter_stream
+
+START = 1_600_000_000
+
+
+@pytest.fixture(scope="module")
+def server():
+    ms = TimeSeriesMemStore()
+    for s in range(4):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100))
+    keys = counter_series(5, metric="http_requests_total")
+    ingest_routed(ms, "timeseries",
+                  counter_stream(keys, 400, start_ms=START * 1000), 4, 1)
+    svc = QueryService(ms, "timeseries", 4, spread=1)
+    srv = FiloHttpServer({"timeseries": svc}, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def get(server, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{server.port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestPromApi:
+    def test_query_range(self, server):
+        code, body = get(
+            server, "/promql/timeseries/api/v1/query_range",
+            query='sum(rate(http_requests_total[5m]))',
+            start=START + 600, end=START + 3000, step=60)
+        assert code == 200 and body["status"] == "success"
+        data = body["data"]
+        assert data["resultType"] == "matrix"
+        assert len(data["result"]) == 1
+        values = data["result"][0]["values"]
+        assert len(values) == 41
+        ts0, v0 = values[0]
+        assert ts0 == START + 600 and float(v0) > 0
+
+    def test_query_instant(self, server):
+        code, body = get(server, "/promql/timeseries/api/v1/query",
+                         query="http_requests_total", time=START + 1000)
+        assert code == 200
+        data = body["data"]
+        assert data["resultType"] == "vector"
+        assert len(data["result"]) == 5
+        assert data["result"][0]["metric"]["__name__"] == \
+            "http_requests_total"
+
+    def test_series(self, server):
+        code, body = get(server, "/promql/timeseries/api/v1/series",
+                         **{"match[]": "http_requests_total"},
+                         start=START, end=START + 4000)
+        assert code == 200 and len(body["data"]) == 5
+
+    def test_labels_and_values(self, server):
+        code, body = get(server, "/promql/timeseries/api/v1/labels")
+        assert code == 200 and "instance" in body["data"]
+        code, body = get(server,
+                         "/promql/timeseries/api/v1/label/job/values")
+        assert code == 200
+        assert body["data"] == ["job-0", "job-1", "job-2"]
+
+    def test_parse_error_400(self, server):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/promql/timeseries/api/v1/query_range",
+                query="sum(((", start=START, end=START + 60, step=60)
+        assert e.value.code == 400
+
+    def test_unknown_dataset_404(self, server):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/promql/nope/api/v1/query", query="x", time=0)
+        assert e.value.code == 404
+
+
+class TestAdminApi:
+    def test_health(self, server):
+        code, body = get(server, "/__health")
+        assert code == 200 and body["status"] == "healthy"
+
+    def test_cluster_status(self, server):
+        code, body = get(server, "/api/v1/cluster/timeseries/status")
+        assert code == 200
+        assert len(body["data"]) == 4
+        assert sum(s["numPartitions"] for s in body["data"]) == 5
+
+    def test_metrics_exposition(self, server):
+        url = f"http://127.0.0.1:{server.port}/metrics"
+        with urllib.request.urlopen(url) as r:
+            text = r.read().decode()
+        assert "rows_ingested_total" in text
