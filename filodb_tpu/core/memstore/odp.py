@@ -1,0 +1,80 @@
+"""On-demand paging: pull cold chunks from the column store at query time.
+
+Counterpart of reference ``OnDemandPagingShard.scala:27`` +
+``DemandPagedChunkStore.scala:1-125``: when a query needs data older than
+what's resident in memory (flushed-then-evicted chunks, or partitions
+restored index-only after recovery), the missing chunk range is read from the
+column store and attached to the partition as transient paged chunks (bounded
+LRU per shard).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+
+from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+from filodb_tpu.core.memstore.shard import TimeSeriesShard
+from filodb_tpu.utils.metrics import Counter
+
+log = logging.getLogger(__name__)
+
+odp_chunks_paged = Counter("odp_chunks_paged")
+odp_requests = Counter("odp_requests")
+
+
+class DemandPagedChunkCache:
+    """Bounded per-shard cache of paged-in chunks, keyed (part_id, chunk_id)."""
+
+    def __init__(self, max_chunks: int = 10_000):
+        self.max_chunks = max_chunks
+        self._lru: OrderedDict[tuple[int, int], object] = OrderedDict()
+
+    def get_or_load(self, shard: TimeSeriesShard, part: TimeSeriesPartition,
+                    start: int, end: int) -> list:
+        """Chunks from the column store overlapping [start, end] that are not
+        resident in memory."""
+        odp_requests.inc()
+        resident = {c.id for c in part.chunks}
+        disk_chunks = shard.column_store.read_chunks(
+            shard.dataset, shard.shard_num, part.part_key, start, end)
+        out = []
+        for ch in disk_chunks:
+            if ch.id in resident:
+                continue
+            key = (part.part_id, ch.id)
+            cached = self._lru.get(key)
+            if cached is None:
+                self._lru[key] = ch
+                odp_chunks_paged.inc()
+                cached = ch
+            else:
+                self._lru.move_to_end(key)
+            out.append(cached)
+        while len(self._lru) > self.max_chunks:
+            self._lru.popitem(last=False)
+        return out
+
+
+def needs_paging(part: TimeSeriesPartition, index_start: int,
+                 query_start: int) -> bool:
+    """True when the partition's in-memory data doesn't reach back to the
+    query start but the index says data exists there."""
+    earliest_mem = part.earliest_ts
+    if earliest_mem == -1:
+        return index_start < 2**62  # nothing in memory; anything on disk?
+    return query_start < earliest_mem and index_start < earliest_mem
+
+
+def page_partitions(shard: TimeSeriesShard, parts: list[TimeSeriesPartition],
+                    start: int, end: int,
+                    cache: DemandPagedChunkCache) -> dict[int, list]:
+    """Return {part_id: odp_chunks} for partitions needing older data."""
+    out = {}
+    for p in parts:
+        idx_start = shard.index.start_time(p.part_id)
+        if needs_paging(p, idx_start, start):
+            chunks = cache.get_or_load(shard, p, start, end)
+            if chunks:
+                out[p.part_id] = chunks
+    return out

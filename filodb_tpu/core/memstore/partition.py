@@ -188,18 +188,34 @@ class TimeSeriesPartition:
                 cols.append(data[: b.n])
         return encode_chunk(self.schema, b.ts[: b.n], cols, 0xFFF)
 
-    def read_samples(self, start: int, end: int, col: int = None):
+    def evict_flushed_chunks(self) -> int:
+        """Drop already-persisted chunks from memory (they remain readable via
+        on-demand paging). Reference: block reclaim / partition eviction."""
+        before = len(self.chunks)
+        self.chunks = [c for c in self.chunks if c.id > self._flushed_id]
+        return before - len(self.chunks)
+
+    def read_samples(self, start: int, end: int, col: int = None,
+                     extra_chunks: list | None = None):
         """Decode all samples with start <= ts <= end for one value column.
 
         Returns (ts int64[n], values) where values is float64[n] or
-        HistogramColumn. Host-side convenience for tests/flush; the query
-        engine batches decode across partitions instead.
+        HistogramColumn. ``extra_chunks`` holds ODP-paged chunks merged in
+        (deduped by chunk id).
         """
         if col is None:
             col = self.schema.data.value_column
+        chunks = self.chunks_in_range(start, end)
+        if extra_chunks:
+            have = {c.id for c in chunks}
+            for c in extra_chunks:
+                if (c.id not in have and c.end_time >= start
+                        and c.start_time <= end):
+                    chunks.append(c)
+            chunks.sort(key=lambda c: c.id)
         ts_parts, val_parts = [], []
         les = None
-        for c in self.chunks_in_range(start, end):
+        for c in chunks:
             ts = c.decode_column(0)
             vals = c.decode_column(col)
             mask = (ts >= start) & (ts <= end)

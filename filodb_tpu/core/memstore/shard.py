@@ -75,6 +75,9 @@ class TimeSeriesShard:
         self._dirty_part_keys: set[int] = set()
         self._last_flushed_group = -1
         self._ingested_offset = -1
+        # on-demand paging cache (reference OnDemandPagingShard)
+        from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
+        self.odp_cache = DemandPagedChunkCache()
 
     # ---- partition lifecycle --------------------------------------------
 
@@ -221,6 +224,13 @@ class TimeSeriesShard:
             self.stats.partitions_purged.inc(purged)
             self.stats.num_partitions.set(len(self._by_key))
         return purged
+
+    def evict_partition_chunks(self, part_id: int) -> int:
+        """Memory-pressure eviction: drop persisted chunks, keep the
+        partition + index entry; reads fall back to ODP (reference
+        ``TimeSeriesShard`` eviction ``:1611``)."""
+        part = self.partitions[part_id]
+        return part.evict_flushed_chunks() if part else 0
 
     def mark_part_ended(self, part_id: int, end_time: int) -> None:
         self.index.update_end_time(part_id, end_time)

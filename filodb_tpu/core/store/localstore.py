@@ -1,0 +1,181 @@
+"""Durable local column store + meta store (sqlite-backed).
+
+Counterpart of the reference's Cassandra plugin (``cassandra/`` module) with
+the same four-table data model:
+
+- ``chunks``      — (partition, chunkid) → encoded chunkset
+  (reference ``TimeSeriesChunksTable.scala:34``)
+- ``ingestion_time_index`` — (partition, ingestion_time, chunkid) for
+  downsampler/ODP scans by ingestion window
+  (reference ``IngestionTimeIndexTable.scala:31``)
+- ``partkeys``    — partKey → (startTime, endTime) per shard
+  (reference ``PartitionKeysTable.scala:26``)
+- ``checkpoints`` — (shard, group) → offset
+  (reference ``metastore/CheckpointTable.scala:24``)
+
+sqlite (stdlib) provides the durable KV substrate the way Cassandra does for
+the reference; the store interface (``ColumnStore``/``MetaStore``) is the
+pluggable seam for object-store/Cassandra backends later.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.store.api import ColumnStore, MetaStore, PartKeyRecord
+from filodb_tpu.memory.chunk import Chunk
+
+
+def _pk_blob(pk: PartKey) -> bytes:
+    return pk.serialized
+
+
+def _pk_from_blob(blob: bytes) -> PartKey:
+    parts = blob.split(b"\x00")
+    schema = parts[0].decode()
+    labels = []
+    for p in parts[1:]:
+        k, v = p.split(b"\x01", 1)
+        labels.append((k.decode(), v.decode()))
+    return PartKey(schema, tuple(labels))
+
+
+class _Db:
+    """One sqlite database per (dataset, shard), lazily opened."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self._conns: dict[tuple[str, int], sqlite3.Connection] = {}
+        self._lock = threading.Lock()
+
+    def conn(self, dataset: str, shard: int) -> sqlite3.Connection:
+        key = (dataset, shard)
+        with self._lock:
+            c = self._conns.get(key)
+            if c is None:
+                d = os.path.join(self.root, dataset)
+                os.makedirs(d, exist_ok=True)
+                c = sqlite3.connect(os.path.join(d, f"shard-{shard}.db"),
+                                    check_same_thread=False)
+                c.execute("PRAGMA journal_mode=WAL")
+                c.execute("PRAGMA synchronous=NORMAL")
+                c.execute("""CREATE TABLE IF NOT EXISTS chunks (
+                    partition BLOB, chunkid INTEGER, start_time INTEGER,
+                    end_time INTEGER, data BLOB,
+                    PRIMARY KEY (partition, chunkid))""")
+                c.execute("""CREATE TABLE IF NOT EXISTS ingestion_time_index (
+                    partition BLOB, ingestion_time INTEGER, chunkid INTEGER,
+                    PRIMARY KEY (partition, ingestion_time, chunkid))""")
+                c.execute("""CREATE TABLE IF NOT EXISTS partkeys (
+                    partition BLOB PRIMARY KEY, start_time INTEGER,
+                    end_time INTEGER)""")
+                c.execute("""CREATE TABLE IF NOT EXISTS checkpoints (
+                    grp INTEGER PRIMARY KEY, offset INTEGER)""")
+                self._conns[key] = c
+            return c
+
+    def close(self):
+        with self._lock:
+            for c in self._conns.values():
+                c.close()
+            self._conns.clear()
+
+
+class LocalDiskColumnStore(ColumnStore):
+    def __init__(self, root: str):
+        self.root = root
+        self._db = _Db(root)
+        self._wlock = threading.Lock()
+
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        for s in range(num_shards):
+            self._db.conn(dataset, s)
+
+    def write_chunks(self, dataset, shard, part_key, chunks, ingestion_time):
+        c = self._db.conn(dataset, shard)
+        blob = _pk_blob(part_key)
+        with self._wlock:
+            c.executemany(
+                "INSERT OR IGNORE INTO chunks VALUES (?,?,?,?,?)",
+                [(blob, ch.id, ch.start_time, ch.end_time, ch.serialize())
+                 for ch in chunks])
+            c.executemany(
+                "INSERT OR IGNORE INTO ingestion_time_index VALUES (?,?,?)",
+                [(blob, ingestion_time, ch.id) for ch in chunks])
+            c.commit()
+
+    def read_chunks(self, dataset, shard, part_key, start_time, end_time):
+        c = self._db.conn(dataset, shard)
+        rows = c.execute(
+            "SELECT data FROM chunks WHERE partition=? AND end_time>=? AND "
+            "start_time<=? ORDER BY chunkid", (_pk_blob(part_key), start_time,
+                                               end_time)).fetchall()
+        return [Chunk.deserialize(r[0]) for r in rows]
+
+    def write_part_keys(self, dataset, shard, records):
+        c = self._db.conn(dataset, shard)
+        with self._wlock:
+            for r in records:
+                c.execute(
+                    "INSERT INTO partkeys VALUES (?,?,?) ON CONFLICT(partition)"
+                    " DO UPDATE SET start_time=MIN(start_time, excluded."
+                    "start_time), end_time=excluded.end_time",
+                    (_pk_blob(r.part_key), r.start_time, r.end_time))
+            c.commit()
+
+    def scan_part_keys(self, dataset, shard):
+        c = self._db.conn(dataset, shard)
+        rows = c.execute(
+            "SELECT partition, start_time, end_time FROM partkeys").fetchall()
+        return [PartKeyRecord(_pk_from_blob(b), st, et) for b, st, et in rows]
+
+    def scan_chunks_by_ingestion_time(self, dataset, shard, start, end):
+        c = self._db.conn(dataset, shard)
+        parts = c.execute(
+            "SELECT DISTINCT partition FROM ingestion_time_index WHERE "
+            "ingestion_time>=? AND ingestion_time<?", (start, end)).fetchall()
+        for (blob,) in parts:
+            ids = [r[0] for r in c.execute(
+                "SELECT chunkid FROM ingestion_time_index WHERE partition=? "
+                "AND ingestion_time>=? AND ingestion_time<?",
+                (blob, start, end))]
+            if not ids:
+                continue
+            q = ",".join("?" * len(ids))
+            rows = c.execute(
+                f"SELECT data FROM chunks WHERE partition=? AND chunkid IN "
+                f"({q}) ORDER BY chunkid", (blob, *ids)).fetchall()
+            yield _pk_from_blob(blob), [Chunk.deserialize(r[0]) for r in rows]
+
+    def truncate(self, dataset):
+        import glob
+        import os as _os
+        self._db.close()
+        for f in glob.glob(os.path.join(self.root, dataset, "shard-*.db*")):
+            _os.remove(f)
+
+    def close(self):
+        self._db.close()
+
+
+class LocalDiskMetaStore(MetaStore):
+    def __init__(self, root: str):
+        self._db = _Db(root)
+        self._wlock = threading.Lock()
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        c = self._db.conn(dataset, shard)
+        with self._wlock:
+            c.execute("INSERT INTO checkpoints VALUES (?,?) ON CONFLICT(grp) "
+                      "DO UPDATE SET offset=excluded.offset", (group, offset))
+            c.commit()
+
+    def read_checkpoints(self, dataset, shard):
+        c = self._db.conn(dataset, shard)
+        return dict(c.execute("SELECT grp, offset FROM checkpoints"))
+
+    def close(self):
+        self._db.close()

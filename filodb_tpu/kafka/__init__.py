@@ -1,0 +1,9 @@
+"""Ingestion log transport: Kafka-compatible contract.
+
+Counterpart of reference ``kafka/`` module (``KafkaIngestionStream.scala:24,63``):
+one log partition == one shard; messages are serialized RecordContainers;
+offsets are replayable for recovery. The broker is pluggable — in-memory and
+file-backed logs here, a real Kafka client slots behind the same interface.
+"""
+
+from filodb_tpu.kafka.log import FileLog, InMemoryLog, ReplayLog  # noqa: F401

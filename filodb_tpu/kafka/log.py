@@ -1,0 +1,136 @@
+"""Replayable ingestion logs.
+
+The reference's durability story is "no data loss within Kafka retention":
+shards checkpoint (group → offset) and, on restart, replay the log from
+``min(checkpoints)`` skipping below-watermark rows (reference
+``doc/ingestion.md:114``, ``TimeSeriesMemStore.recoverStream``). These logs
+provide that contract in-process (tests) and on disk (standalone server).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from collections.abc import Iterator
+
+from filodb_tpu.core.record import RecordContainer, SomeData
+
+
+class ReplayLog:
+    """One shard's ordered, offset-addressed container log."""
+
+    def append(self, container: RecordContainer) -> int:
+        raise NotImplementedError
+
+    def read_from(self, offset: int) -> Iterator[SomeData]:
+        raise NotImplementedError
+
+    @property
+    def latest_offset(self) -> int:
+        raise NotImplementedError
+
+
+class InMemoryLog(ReplayLog):
+    def __init__(self):
+        self._entries: list[RecordContainer] = []
+        self._lock = threading.Lock()
+
+    def append(self, container: RecordContainer) -> int:
+        with self._lock:
+            self._entries.append(container)
+            return len(self._entries) - 1
+
+    def read_from(self, offset: int) -> Iterator[SomeData]:
+        start = max(offset, 0)
+        for i in range(start, len(self._entries)):
+            yield SomeData(self._entries[i], i)
+
+    @property
+    def latest_offset(self) -> int:
+        return len(self._entries) - 1
+
+
+class FileLog(ReplayLog):
+    """Append-only length-prefixed record log with a sparse offset index.
+
+    Layout per entry: u32 length | container bytes. A side index file holds
+    (offset, file_pos) every ``index_every`` entries for seek-on-replay.
+    """
+
+    MAGIC = b"FLOG1"
+
+    def __init__(self, path: str, index_every: int = 64):
+        self.path = path
+        self.index_every = index_every
+        self._lock = threading.Lock()
+        self._count = 0
+        self._index: list[tuple[int, int]] = []  # (offset, pos)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if os.path.exists(path):
+            self._recover_scan()
+        else:
+            with open(path, "wb") as f:
+                f.write(self.MAGIC)
+        self._f = open(path, "ab")
+
+    def _recover_scan(self):
+        size = os.path.getsize(self.path)
+        with open(self.path, "rb") as f:
+            magic = f.read(5)
+            assert magic == self.MAGIC, "bad log file"
+            pos = 5
+            while pos + 4 <= size:
+                f.seek(pos)
+                (ln,) = struct.unpack("<I", f.read(4))
+                if pos + 4 + ln > size:
+                    break  # truncated tail (torn write): ignore
+                if self._count % self.index_every == 0:
+                    self._index.append((self._count, pos))
+                pos += 4 + ln
+                self._count += 1
+
+    def append(self, container: RecordContainer) -> int:
+        payload = container.serialize()
+        with self._lock:
+            pos = self._f.tell()
+            if self._count % self.index_every == 0:
+                self._index.append((self._count, pos))
+            self._f.write(struct.pack("<I", len(payload)))
+            self._f.write(payload)
+            self._f.flush()
+            off = self._count
+            self._count += 1
+            return off
+
+    def read_from(self, offset: int) -> Iterator[SomeData]:
+        offset = max(offset, 0)
+        with self._lock:
+            self._f.flush()
+            count = self._count
+            # seek via sparse index
+            seek_off, seek_pos = 0, 5
+            for o, p in self._index:
+                if o <= offset:
+                    seek_off, seek_pos = o, p
+                else:
+                    break
+        with open(self.path, "rb") as f:
+            f.seek(seek_pos)
+            cur = seek_off
+            while cur < count:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    break
+                (ln,) = struct.unpack("<I", hdr)
+                data = f.read(ln)
+                if cur >= offset:
+                    yield SomeData(RecordContainer.deserialize(data), cur)
+                cur += 1
+
+    @property
+    def latest_offset(self) -> int:
+        return self._count - 1
+
+    def close(self):
+        self._f.close()

@@ -138,11 +138,19 @@ class SelectRawPartitionsExec(ExecPlan):
         by_schema: dict[str, list] = {}
         for p in parts:
             by_schema.setdefault(p.schema.name, []).append(p)
+        # on-demand paging: pull cold chunks for partitions whose in-memory
+        # data doesn't reach back to the query start
+        extra_chunks = None
+        if shard.config.demand_paging_enabled:
+            from filodb_tpu.core.memstore.odp import page_partitions
+            extra_chunks = page_partitions(shard, parts, self.chunk_start,
+                                           self.chunk_end, shard.odp_cache)
         outs = []
         for schema_name, sparts in by_schema.items():
             schema = sparts[0].schema
             col = self._value_col_index(schema)
-            batch = build_batch(sparts, self.chunk_start, self.chunk_end, col)
+            batch = build_batch(sparts, self.chunk_start, self.chunk_end, col,
+                                extra_chunks=extra_chunks)
             ctx.stats.samples_scanned += int(batch.counts.sum())
             keys = [RangeVectorKey.of(p.part_key.label_map) for p in sparts]
             is_counter = schema.data.columns[col].is_counter
