@@ -1,0 +1,118 @@
+"""LongTimeRangePlanner: route/split queries between raw and downsampled data.
+
+Counterpart of reference ``queryplanner/LongTimeRangePlanner.scala:1-135``:
+queries entirely within raw retention go to the raw cluster planner; queries
+entirely older go to the downsample planner; straddling queries split at the
+earliest-raw-time step boundary and the two ExecPlans are stitched
+(``StitchRvsExec``).
+
+Range functions are rewritten for the ds-gauge rollup schema (reference: the
+downsample schema's column selection): min/max/sum_over_time read the
+corresponding rollup column; count_over_time sums the count column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from dataclasses import dataclass
+
+from filodb_tpu.coordinator.planner import QueryPlanner, SingleClusterPlanner, _retime
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec.plan import ExecPlan, StitchRvsExec
+from filodb_tpu.query.model import QueryContext
+
+# range fn -> (ds column, replacement fn)
+_DS_FN_MAP = {
+    "min_over_time": ("min", "min_over_time"),
+    "max_over_time": ("max", "max_over_time"),
+    "sum_over_time": ("sum", "sum_over_time"),
+    "count_over_time": ("count", "sum_over_time"),
+    "avg_over_time": ("avg", "avg_over_time"),  # approximate (unweighted)
+}
+
+
+def rewrite_for_downsample(plan: lp.LogicalPlan) -> lp.LogicalPlan:
+    if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        m = _DS_FN_MAP.get(plan.function)
+        if m is not None and plan.raw.column is None:
+            col, fn = m
+            raw = dataclasses.replace(plan.raw, column=col)
+            return dataclasses.replace(plan, raw=raw, function=fn)
+        return plan
+    if dataclasses.is_dataclass(plan):
+        changes = {}
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                changes[f.name] = rewrite_for_downsample(v)
+        if changes:
+            return dataclasses.replace(plan, **changes)
+    return plan
+
+
+def _plan_times(plan: lp.LogicalPlan):
+    """(start, step, end, max_lookback) over the plan tree."""
+    lo, st, hi, lb = [], [], [], [0]
+
+    def walk(p):
+        if isinstance(p, (lp.PeriodicSeries, lp.PeriodicSeriesWithWindowing,
+                          lp.SubqueryWithWindowing)):
+            lo.append(p.start)
+            st.append(p.step)
+            hi.append(p.end)
+            if isinstance(p, lp.PeriodicSeriesWithWindowing):
+                lb.append(p.window + p.offset)
+            elif isinstance(p, lp.SubqueryWithWindowing):
+                lb.append(p.subquery_window + p.offset)
+            else:
+                lb.append(300_000 + p.offset)
+        if dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name)
+                if isinstance(v, lp.LogicalPlan):
+                    walk(v)
+
+    walk(plan)
+    if not lo:
+        return None
+    return min(lo), max(st), max(hi), max(lb)
+
+
+@dataclass
+class LongTimeRangePlanner(QueryPlanner):
+    raw_planner: SingleClusterPlanner
+    ds_planner: SingleClusterPlanner
+    raw_retention_ms: int
+    now_ms: "callable" = lambda: int(time.time() * 1000)
+
+    def materialize(self, plan: lp.LogicalPlan,
+                    qcontext: QueryContext | None = None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        times = _plan_times(plan)
+        if times is None:
+            return self.raw_planner.materialize(plan, qcontext)
+        start, step, end, lookback = times
+        earliest_raw = self.now_ms() - self.raw_retention_ms
+        if start - lookback >= earliest_raw:
+            return self.raw_planner.materialize(plan, qcontext)
+        if end < earliest_raw:
+            return self.ds_planner.materialize(rewrite_for_downsample(plan),
+                                               qcontext)
+        # straddling: first step whose full window lies in raw data
+        step = max(step, 1)
+        boundary = start
+        while boundary - lookback < earliest_raw and boundary <= end:
+            boundary += step
+        ds_end = boundary - step
+        parts = []
+        if ds_end >= start:
+            ds_plan = rewrite_for_downsample(_retime(plan, start, step,
+                                                     ds_end))
+            parts.append(self.ds_planner.materialize(ds_plan, qcontext))
+        if boundary <= end:
+            raw_plan = _retime(plan, boundary, step, end)
+            parts.append(self.raw_planner.materialize(raw_plan, qcontext))
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(children_plans=parts)

@@ -51,6 +51,9 @@ class SingleClusterPlanner(QueryPlanner):
     # sub-plans + stitch (reference materializeTimeSplitPlan)
     time_split_ms: int = 0
     dispatcher_for_shard: "callable | None" = None
+    # leaves read this store instead of the exec context's (downsample plans)
+    store: object = None
+    dataset_name_override: str | None = None
 
     # ---- shard selection ------------------------------------------------
 
@@ -91,7 +94,8 @@ class SingleClusterPlanner(QueryPlanner):
         for shard in self.shards_for_filters(raw.filters):
             leaf = SelectRawPartitionsExec(
                 shard=shard, filters=raw.filters, chunk_start=chunk_start,
-                chunk_end=chunk_end, value_column=raw.column)
+                chunk_end=chunk_end, value_column=raw.column,
+                store=self.store, dataset_name=self.dataset_name_override)
             d = self._dispatcher(shard)
             if d is not None:
                 leaf.dispatcher = d

@@ -1,0 +1,17 @@
+"""Downsampling: raw chunks → lower-resolution rollups for long retention.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/downsample/``
+(ChunkDownsampler hierarchy, DownsamplePeriodMarker, ShardDownsampler,
+DownsampledTimeSeriesStore) and the Spark batch job
+(``spark-jobs/.../downsampler/chunk/DownsamplerMain.scala``) — without Spark:
+the batch job walks the column store's ingestion-time index directly.
+"""
+
+from filodb_tpu.core.downsample.downsampler import (  # noqa: F401
+    DownsamplerJob,
+    ShardDownsampler,
+    downsample_partition,
+)
+from filodb_tpu.core.downsample.dsstore import (  # noqa: F401
+    DownsampledTimeSeriesStore,
+)

@@ -1,0 +1,161 @@
+"""Chunk downsamplers + streaming/batch downsampling.
+
+Counterpart of reference ``ChunkDownsampler.scala:16-31`` (dMin/dMax/dSum/
+dCount/dAvg/tTime/dLast), ``DownsamplePeriodMarker.scala`` (time-based period
+boundaries), ``ShardDownsampler.scala:1-103`` (emit downsample records at
+flush) and ``BatchDownsampler.scala:37`` (offline job over the ingestion-time
+index).
+
+Gauge rows downsample into the ``ds-gauge`` schema (ts,min,max,sum,count,avg);
+counters keep last-sample semantics (``dLast``); period timestamps are the
+last raw sample time in the period (``tTime`` semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.record import IngestRecord, RecordContainer
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, Schemas
+from filodb_tpu.core.store.api import ColumnStore, PartKeyRecord
+
+log = logging.getLogger(__name__)
+
+
+def downsample_samples(ts: np.ndarray, vals: np.ndarray, resolution_ms: int):
+    """Aggregate (ts, vals) into time buckets of ``resolution_ms``.
+
+    Returns (bucket_last_ts, min, max, sum, count, avg, last) arrays — the
+    full downsampler family evaluated in one segmented pass (numpy reduceat;
+    bulk batches go through the same prefix-sum kernels as queries).
+    """
+    if len(ts) == 0:
+        z = np.array([], np.float64)
+        return np.array([], np.int64), z, z, z, z, z, z
+    bucket = ts // resolution_ms
+    # segment boundaries (ts sorted)
+    starts = np.flatnonzero(np.concatenate([[True], bucket[1:] != bucket[:-1]]))
+    ends = np.concatenate([starts[1:], [len(ts)]])
+    t_last = ts[ends - 1]
+    mins = np.minimum.reduceat(vals, starts)
+    maxs = np.maximum.reduceat(vals, starts)
+    sums = np.add.reduceat(vals, starts)
+    counts = (ends - starts).astype(np.float64)
+    avgs = sums / counts
+    lasts = vals[ends - 1]
+    return t_last, mins, maxs, sums, counts, avgs, lasts
+
+
+def downsample_partition(part: TimeSeriesPartition, resolution_ms: int,
+                         start: int, end: int) -> list[IngestRecord]:
+    """Downsample one partition's raw samples into ds records."""
+    schema = part.schema
+    ts, vals = part.read_samples(start, end)
+    if len(ts) == 0 or not np.ndim(vals):
+        return []
+    is_counter = schema.data.columns[schema.data.value_column].is_counter
+    ds_key = PartKey(schema.data.downsample_schema or "ds-gauge",
+                     part.part_key.labels)
+    t_last, mins, maxs, sums, counts, avgs, lasts = downsample_samples(
+        np.asarray(ts), np.asarray(vals, np.float64), resolution_ms)
+    out = []
+    for i in range(len(t_last)):
+        if is_counter:
+            # prom-counter ds schema: (ts, value=dLast)
+            out.append(IngestRecord(
+                PartKey("prom-counter", part.part_key.labels),
+                int(t_last[i]), (float(lasts[i]),)))
+        else:
+            out.append(IngestRecord(ds_key, int(t_last[i]),
+                                    (float(mins[i]), float(maxs[i]),
+                                     float(sums[i]), float(counts[i]),
+                                     float(avgs[i]))))
+    return out
+
+
+@dataclass
+class ShardDownsampler:
+    """Streaming downsampler: emits downsample records at flush time
+    (reference ``ShardDownsampler`` publishing to the downsample dataset)."""
+
+    resolutions_ms: tuple[int, ...] = (300_000, 3_600_000)
+    publish: "callable | None" = None  # fn(resolution, RecordContainer)
+
+    def on_flush(self, part: TimeSeriesPartition, flushed_chunks) -> None:
+        if self.publish is None or not flushed_chunks:
+            return
+        start = min(c.start_time for c in flushed_chunks)
+        end = max(c.end_time for c in flushed_chunks)
+        for res in self.resolutions_ms:
+            recs = downsample_partition(part, res, start, end)
+            if recs:
+                c = RecordContainer()
+                for r in recs:
+                    c.add(r)
+                self.publish(res, c)
+
+
+def ds_dataset_name(dataset: str, resolution_ms: int) -> str:
+    return f"{dataset}_ds_{resolution_ms // 60000}m"
+
+
+@dataclass
+class DownsamplerJob:
+    """Batch downsampler (reference ``DownsamplerMain``/``BatchDownsampler``):
+    scans raw chunks by ingestion-time window, replays them through the
+    downsamplers, writes ds chunks + part keys to the column store under the
+    downsample dataset."""
+
+    column_store: ColumnStore
+    dataset: str
+    num_shards: int
+    resolutions_ms: tuple[int, ...] = (300_000, 3_600_000)
+    schemas: Schemas = field(default_factory=lambda: DEFAULT_SCHEMAS)
+    max_chunk_size: int = 400
+
+    def run(self, ingestion_start: int, ingestion_end: int,
+            user_start: int = 0, user_end: int = 2**62) -> dict:
+        stats = {"partitions": 0, "ds_chunks": 0, "ds_samples": 0}
+        for shard in range(self.num_shards):
+            for res in self.resolutions_ms:
+                self._downsample_shard(shard, res, ingestion_start,
+                                       ingestion_end, user_start, user_end,
+                                       stats)
+        return stats
+
+    def _downsample_shard(self, shard, res, t0, t1, us, ue, stats):
+        ds_name = ds_dataset_name(self.dataset, res)
+        pkrecs = []
+        for part_key, chunks in self.column_store.scan_chunks_by_ingestion_time(
+                self.dataset, shard, t0, t1):
+            schema = self.schemas[part_key.schema]
+            if schema.data.downsample_schema is None:
+                continue
+            # rebuild a transient partition from the persisted chunks
+            part = TimeSeriesPartition(0, part_key, schema,
+                                       self.max_chunk_size)
+            part.chunks = sorted(chunks, key=lambda c: c.id)
+            recs = downsample_partition(part, res, us, ue)
+            if not recs:
+                continue
+            stats["partitions"] += 1
+            stats["ds_samples"] += len(recs)
+            ds_schema = self.schemas[recs[0].part_key.schema]
+            ds_part = TimeSeriesPartition(0, recs[0].part_key, ds_schema,
+                                          self.max_chunk_size)
+            for r in recs:
+                ds_part.ingest(r.timestamp, r.values)
+            out_chunks = ds_part.make_flush_chunks()
+            self.column_store.write_chunks(ds_name, shard, recs[0].part_key,
+                                           out_chunks, ingestion_time=t1)
+            stats["ds_chunks"] += len(out_chunks)
+            pkrecs.append(PartKeyRecord(recs[0].part_key,
+                                        recs[0].timestamp,
+                                        recs[-1].timestamp))
+        if pkrecs:
+            self.column_store.write_part_keys(ds_name, shard, pkrecs)

@@ -1,0 +1,135 @@
+"""Downsample read store: serves queries directly from the column store.
+
+Counterpart of reference ``DownsampledTimeSeriesStore.scala:22`` /
+``DownsampledTimeSeriesShard.scala:48``: no write buffers — the in-memory
+state is just the part-key index (bootstrapped from the persisted part keys);
+chunk data is read from the column store per query (and flows through the
+same SeriesBatch → kernel path as raw data).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from filodb_tpu.core.downsample.downsampler import ds_dataset_name
+from filodb_tpu.core.memstore.index import PartKeyIndex
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, Schemas
+from filodb_tpu.core.store.api import ColumnStore
+from filodb_tpu.core.store.config import StoreConfig
+
+log = logging.getLogger(__name__)
+
+
+class PagedReadablePartition:
+    """Read-only partition view over persisted chunks (reference
+    ``PagedReadablePartition``). Duck-types TimeSeriesPartition's read API."""
+
+    def __init__(self, part_id, part_key, schema, column_store, dataset,
+                 shard):
+        self.part_id = part_id
+        self.part_key = part_key
+        self.schema = schema
+        self._cs = column_store
+        self._dataset = dataset
+        self._shard = shard
+
+    def read_samples(self, start, end, col=None, extra_chunks=None):
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        chunks = self._cs.read_chunks(self._dataset, self._shard,
+                                      self.part_key, start, end)
+        tmp = TimeSeriesPartition(self.part_id, self.part_key, self.schema)
+        tmp.chunks = chunks
+        return tmp.read_samples(start, end, col)
+
+
+class DownsampledTimeSeriesShard:
+    def __init__(self, dataset: str, ds_dataset: str, shard: int,
+                 column_store: ColumnStore, schemas: Schemas):
+        self.dataset = dataset
+        self.ds_dataset = ds_dataset
+        self.shard_num = shard
+        self.column_store = column_store
+        self.schemas = schemas
+        self.index = PartKeyIndex()
+        self.config = StoreConfig(demand_paging_enabled=False)
+        self._refreshed = False
+        self._known: dict = {}
+        self._parts: dict = {}
+
+    def refresh_index(self) -> int:
+        """Bootstrap/refresh the index from persisted ds part keys
+        (reference index bootstrap + periodic refresh thread)."""
+        n = 0
+        for rec in self.column_store.scan_part_keys(self.ds_dataset,
+                                                    self.shard_num):
+            if rec.part_key in self._known:
+                pid = self._known[rec.part_key]
+                self.index.update_end_time(pid, rec.end_time)
+                continue
+            pid = len(self._known)
+            self._known[rec.part_key] = pid
+            self.index.add_part_key(pid, rec.part_key, rec.start_time,
+                                    rec.end_time)
+            self._parts[pid] = PagedReadablePartition(
+                pid, rec.part_key, self.schemas[rec.part_key.schema],
+                self.column_store, self.ds_dataset, self.shard_num)
+            n += 1
+        self._refreshed = True
+        return n
+
+    def lookup_partitions(self, filters, start, end):
+        if not self._refreshed:
+            self.refresh_index()
+        return self.index.part_ids_from_filters(filters, start, end)
+
+    def partition(self, pid):
+        return self._parts.get(pid)
+
+    def label_values(self, label, filters=None, start=0, end=2**62):
+        if not self._refreshed:
+            self.refresh_index()
+        return self.index.label_values(label, filters, start, end)
+
+    def label_names(self):
+        if not self._refreshed:
+            self.refresh_index()
+        return self.index.label_names()
+
+    @property
+    def num_partitions(self):
+        return len(self._known)
+
+
+class DownsampledTimeSeriesStore:
+    """Memstore-shaped facade over downsampled data for the exec layer."""
+
+    def __init__(self, column_store: ColumnStore, dataset: str,
+                 resolution_ms: int, num_shards: int,
+                 schemas: Schemas | None = None):
+        self.column_store = column_store
+        self.dataset = dataset
+        self.resolution_ms = resolution_ms
+        self.ds_dataset = ds_dataset_name(dataset, resolution_ms)
+        self.schemas = schemas or DEFAULT_SCHEMAS
+        self._shards = {
+            s: DownsampledTimeSeriesShard(dataset, self.ds_dataset, s,
+                                          column_store, self.schemas)
+            for s in range(num_shards)}
+
+    def get_shard(self, dataset: str, shard: int):
+        return self._shards[shard]
+
+    def shards_for(self, dataset: str):
+        return [self._shards[s] for s in sorted(self._shards)]
+
+    def label_values(self, dataset, label, filters=None, start=0, end=2**62):
+        out = set()
+        for s in self.shards_for(dataset):
+            out.update(s.label_values(label, filters, start, end))
+        return sorted(out)
+
+    def label_names(self, dataset):
+        out = set()
+        for s in self.shards_for(dataset):
+            out.update(s.label_names())
+        return sorted(out)

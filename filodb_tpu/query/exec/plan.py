@@ -123,9 +123,14 @@ class SelectRawPartitionsExec(ExecPlan):
     chunk_start: int = 0  # ms; already includes lookback extension
     chunk_end: int = 0
     value_column: str | None = None
+    # overrides for leaves that read a different store (downsample plans)
+    store: object = None
+    dataset_name: str | None = None
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:
-        shard = ctx.memstore.get_shard(ctx.dataset, self.shard)
+        memstore = self.store if self.store is not None else ctx.memstore
+        dataset = self.dataset_name or ctx.dataset
+        shard = memstore.get_shard(dataset, self.shard)
         part_ids = shard.lookup_partitions(list(self.filters),
                                            self.chunk_start, self.chunk_end)
         parts = [shard.partition(pid) for pid in part_ids]
