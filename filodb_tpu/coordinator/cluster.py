@@ -1,0 +1,269 @@
+"""FilodbCluster: node membership, per-node shard lifecycle, failure
+detection and recovery.
+
+Counterpart of the reference's Akka-cluster control plane
+(``FilodbCluster.scala:31,40``, ``NodeClusterActor.scala:61,187,368-412``
+cluster-singleton + ``ShardManager``, ``IngestionActor.scala:43-57,237,294``,
+``NodeCoordinatorActor``): a coordinator (in the real deployment: one
+elected node; here a plain object shareable in-process or fronted by RPC)
+tracks members, assigns shards, and drives per-node ingestion lifecycles:
+
+  start shard → recover index from column store → read checkpoints →
+  replay the shard's log from min(checkpoint) (group watermarks skip
+  persisted rows) → continuous ingestion (reference ``doRecovery`` →
+  ``normalIngestion``).
+
+Failure detection: heartbeat probes over the plan-shipping channel (or
+liveness flags for in-process nodes) stand in for Akka's phi-accrual
+detector; on member loss, shards are marked DOWN and reassigned, and the new
+owner recovers from the shared column store + log — the reference's
+elastic-recovery story (``doc/sharding.md:158``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.shard_manager import ShardManager
+from filodb_tpu.coordinator.shardmapper import ShardStatus
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import IngestionConfig
+from filodb_tpu.kafka.log import ReplayLog
+from filodb_tpu.query.exec.plan import ExecContext, PlanDispatcher
+
+log = logging.getLogger(__name__)
+
+
+class NodeDispatcher(PlanDispatcher):
+    """In-process dispatch to another node's memstore (stands in for the
+    remote dispatcher when nodes share a process, e.g. tests)."""
+
+    def __init__(self, node: "Node"):
+        self.node = node
+
+    def dispatch(self, plan, ctx):
+        if not self.node.alive:
+            raise ConnectionError(f"node {self.node.name} is down")
+        ctx2 = ExecContext(self.node.memstore, ctx.dataset, ctx.qcontext)
+        return plan.execute(ctx2)
+
+
+@dataclass
+class Node:
+    """One cluster member: local memstore + ingestion workers.
+
+    Reference: one FiloServer process (NodeCoordinatorActor + per-dataset
+    IngestionActor/QueryActor).
+    """
+
+    name: str
+    memstore: TimeSeriesMemStore
+    alive: bool = True
+    executor_port: int | None = None  # set when fronted by PlanExecutorServer
+    _workers: dict = field(default_factory=dict)  # (dataset, shard) -> worker
+
+    def start_shard(self, dataset: str, shard: int, config: IngestionConfig,
+                    shard_log: ReplayLog, on_status=None) -> None:
+        """Start ingestion for a shard: recover then tail the log
+        (reference ``IngestionActor.start``)."""
+        key = (dataset, shard)
+        if key in self._workers:
+            return
+        try:
+            self.memstore.setup(dataset, shard, config.store)
+        except ValueError:
+            pass  # already set up (restart)
+        s = self.memstore.get_shard(dataset, shard)
+        s.recover_index()
+        start_offset = s.setup_watermarks_for_recovery()
+        if on_status:
+            on_status(shard, ShardStatus.RECOVERY, 0)
+        worker = _IngestWorker(self, s, shard_log, start_offset, on_status)
+        self._workers[key] = worker
+        worker.start()
+
+    def stop_shard(self, dataset: str, shard: int) -> None:
+        w = self._workers.pop((dataset, shard), None)
+        if w:
+            w.stop()
+        self.memstore.teardown(dataset, shard)
+
+    def kill(self) -> None:
+        """Simulate process death (multi-jvm kill tests)."""
+        self.alive = False
+        for w in list(self._workers.values()):
+            w.stop()
+        self._workers.clear()
+
+    def owned_shards(self, dataset: str) -> list[int]:
+        return sorted(s for (d, s) in self._workers if d == dataset)
+
+
+class _IngestWorker(threading.Thread):
+    """Per-shard ingestion thread: replay from the recovery offset, then tail
+    (the reference's per-shard single-writer ingest scheduler)."""
+
+    def __init__(self, node: Node, shard, log_: ReplayLog, start_offset: int,
+                 on_status=None, poll_interval: float = 0.01):
+        super().__init__(daemon=True,
+                         name=f"ingest-{shard.dataset}-{shard.shard_num}")
+        self.node = node
+        self.shard = shard
+        self.log = log_
+        self.offset = start_offset
+        self.on_status = on_status
+        self.poll_interval = poll_interval
+        self._stop_ev = threading.Event()
+        self.caught_up = threading.Event()
+
+    def run(self):
+        recovered = False
+        while not self._stop_ev.is_set() and self.node.alive:
+            progressed = False
+            for sd in self.log.read_from(self.offset + 1):
+                if self._stop_ev.is_set() or not self.node.alive:
+                    return
+                self.shard.ingest(sd)
+                self.offset = sd.offset
+                progressed = True
+            if not recovered:
+                recovered = True
+                self.caught_up.set()
+                if self.on_status:
+                    self.on_status(self.shard.shard_num, ShardStatus.ACTIVE,
+                                   100)
+            if not progressed:
+                time.sleep(self.poll_interval)
+
+    def stop(self):
+        self._stop_ev.set()
+        self.join(timeout=5)
+
+
+@dataclass
+class FilodbCluster:
+    """The cluster singleton: membership + shard managers + dataset setup."""
+
+    nodes: dict[str, Node] = field(default_factory=dict)
+    shard_managers: dict[str, ShardManager] = field(default_factory=dict)
+    configs: dict[str, IngestionConfig] = field(default_factory=dict)
+    logs: dict[tuple[str, int], ReplayLog] = field(default_factory=dict)
+    heartbeat_interval_s: float = 0.05
+    _hb_thread: threading.Thread | None = None
+    _stop_hb: threading.Event = field(default_factory=threading.Event)
+
+    # -- membership --
+
+    def join(self, node: Node) -> None:
+        self.nodes[node.name] = node
+        for dataset, sm in self.shard_managers.items():
+            for ev in sm.add_member(node.name):
+                self._on_event(dataset, ev)
+
+    def leave(self, name: str) -> None:
+        node = self.nodes.pop(name, None)
+        if node:
+            node.kill()
+        for dataset, sm in self.shard_managers.items():
+            for ev in sm.remove_member(name):
+                self._on_event(dataset, ev)
+
+    # -- datasets --
+
+    def setup_dataset(self, config: IngestionConfig,
+                      logs: dict[int, ReplayLog]) -> None:
+        """Reference ``NodeClusterActor ! SetupDataset``."""
+        dataset = config.dataset
+        self.configs[dataset] = config
+        for shard, log_ in logs.items():
+            self.logs[(dataset, shard)] = log_
+        sm = ShardManager(dataset, config.num_shards, config.min_num_nodes)
+        self.shard_managers[dataset] = sm
+        for name in self.nodes:
+            for ev in sm.add_member(name):
+                self._on_event(dataset, ev)
+
+    def _on_event(self, dataset: str, ev) -> None:
+        if ev.status == ShardStatus.ASSIGNED and ev.node:
+            node = self.nodes[ev.node]
+            config = self.configs[dataset]
+            sm = self.shard_managers[dataset]
+
+            def on_status(shard, status, progress, _node=ev.node):
+                if status == ShardStatus.ACTIVE:
+                    sm.shard_active(shard, _node)
+                elif status == ShardStatus.RECOVERY:
+                    sm.shard_recovery(shard, _node, progress)
+
+            node.start_shard(dataset, ev.shard, config,
+                             self.logs[(dataset, ev.shard)], on_status)
+
+    # -- failure detection --
+
+    def start_failure_detector(self) -> None:
+        """Heartbeat loop (reference: Akka phi-accrual → MemberRemoved)."""
+        if self._hb_thread:
+            return
+        self._hb_thread = threading.Thread(target=self._hb_loop, daemon=True)
+        self._hb_thread.start()
+
+    def _hb_loop(self):
+        while not self._stop_hb.wait(self.heartbeat_interval_s):
+            dead = [n for n, node in self.nodes.items() if not node.alive]
+            for name in dead:
+                log.warning("failure detector: node %s down", name)
+                self.leave(name)
+
+    def stop(self):
+        self._stop_hb.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+        for node in list(self.nodes.values()):
+            node.kill()
+
+    # -- query --
+
+    def query_service(self, dataset: str, spread: int = 0) -> QueryService:
+        """Planner whose leaves dispatch to the shard-owning nodes."""
+        sm = self.shard_managers[dataset]
+        cluster = self
+
+        def dispatcher_for_shard(shard: int) -> PlanDispatcher:
+            owner = sm.mapper.node_for(shard)
+            if owner is None:
+                raise RuntimeError(f"shard {shard} unassigned")
+            node = cluster.nodes[owner]
+            if node.executor_port is not None:
+                from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+                return RemotePlanDispatcher("127.0.0.1", node.executor_port)
+            return NodeDispatcher(node)
+
+        # the facade's local memstore is only used for metadata fan-out;
+        # use the first node's
+        any_node = next(iter(self.nodes.values()))
+        svc = QueryService(any_node.memstore, dataset,
+                           self.configs[dataset].num_shards, spread)
+        svc.planner = SingleClusterPlanner(
+            dataset, self.configs[dataset].num_shards, spread,
+            dispatcher_for_shard=dispatcher_for_shard)
+        return svc
+
+    def shard_statuses(self, dataset: str) -> list[dict]:
+        sm = self.shard_managers.get(dataset)
+        return sm.mapper.snapshot() if sm else []
+
+    def wait_active(self, dataset: str, timeout: float = 10.0) -> bool:
+        """Wait until every shard reached ACTIVE (recovery complete) —
+        RECOVERY shards are queryable but still replaying."""
+        sm = self.shard_managers[dataset]
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if all(st == ShardStatus.ACTIVE for st in sm.mapper.statuses):
+                return True
+            time.sleep(0.01)
+        return False

@@ -1,0 +1,143 @@
+"""ShardManager: shard ↔ node assignment on membership change.
+
+Counterpart of reference ``ShardManager.scala:28,40`` +
+``ShardAssignmentStrategy.scala:9,36``: assigns shards to nodes on member
+add/remove via a pluggable strategy (default: spread evenly, stable for
+existing assignments), publishes shard events to subscribers, and
+rate-limits auto-reassignment after failures
+(``shard-manager.reassignment-min-interval``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from filodb_tpu.coordinator.shardmapper import (
+    ShardEvent,
+    ShardMapper,
+    ShardStatus,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ShardAssignmentStrategy:
+    def assignments(self, mapper: ShardMapper, nodes: list[str],
+                    min_num_nodes: int = 1) -> dict[int, str]:
+        """Return {shard: node} for shards that should (re)assign."""
+        raise NotImplementedError
+
+
+class DefaultShardAssignmentStrategy(ShardAssignmentStrategy):
+    """Spread unassigned shards across nodes, keeping counts balanced and
+    existing assignments stable (reference default strategy): a node takes at
+    most ceil(num_shards / max(num_nodes, min_num_nodes)) shards, so early
+    joiners leave capacity for the expected cluster size."""
+
+    def assignments(self, mapper, nodes, min_num_nodes: int = 1):
+        if not nodes:
+            return {}
+        per_node = {n: len(mapper.shards_of(n)) for n in nodes}
+        max_per_node = -(-mapper.num_shards
+                         // max(len(nodes), min_num_nodes))
+        out = {}
+        for shard in mapper.unassigned_shards():
+            # least-loaded node with capacity
+            candidates = [n for n in nodes if per_node[n] < max_per_node]
+            if not candidates:
+                break
+            node = min(candidates, key=lambda n: per_node[n])
+            out[shard] = node
+            per_node[node] += 1
+        return out
+
+
+@dataclass
+class ShardManager:
+    """Per-dataset shard coordination (held by the cluster singleton)."""
+
+    dataset: str
+    num_shards: int
+    min_num_nodes: int = 1
+    strategy: ShardAssignmentStrategy = field(
+        default_factory=DefaultShardAssignmentStrategy)
+    reassignment_min_interval_s: float = 0.0
+    mapper: ShardMapper = field(init=False)
+    subscribers: list = field(default_factory=list)
+    _nodes: list[str] = field(default_factory=list)
+    _last_reassign: dict[int, float] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.mapper = ShardMapper(self.num_shards)
+
+    # -- membership --
+
+    def add_member(self, node: str) -> list[ShardEvent]:
+        if node in self._nodes:
+            return []
+        self._nodes.append(node)
+        return self._assign()
+
+    def remove_member(self, node: str) -> list[ShardEvent]:
+        """Node lost: mark its shards down, then reassign (rate-limited)
+        (reference ``removeMember`` → ``MemberRemoved`` handling)."""
+        if node not in self._nodes:
+            return []
+        self._nodes.remove(node)
+        events = []
+        now = time.monotonic()
+        for shard in self.mapper.shards_of(node):
+            events.append(self._publish(ShardEvent(shard, ShardStatus.DOWN,
+                                                   None)))
+        if len(self._nodes) >= self.min_num_nodes:
+            for shard, ev in [(e.shard, e) for e in events]:
+                last = self._last_reassign.get(shard, 0.0)
+                if now - last < self.reassignment_min_interval_s:
+                    log.warning("shard %d reassignment rate-limited", shard)
+                    continue
+                self._last_reassign[shard] = now
+            events += self._assign()
+        return events
+
+    @property
+    def nodes(self) -> list[str]:
+        return list(self._nodes)
+
+    # -- assignment --
+
+    def _assign(self) -> list[ShardEvent]:
+        out = []
+        for shard, node in sorted(self.strategy.assignments(
+                self.mapper, self._nodes, self.min_num_nodes).items()):
+            out.append(self._publish(ShardEvent(shard, ShardStatus.ASSIGNED,
+                                                node)))
+        return out
+
+    def shard_active(self, shard: int, node: str) -> ShardEvent:
+        return self._publish(ShardEvent(shard, ShardStatus.ACTIVE, node))
+
+    def shard_recovery(self, shard: int, node: str,
+                       progress: int) -> ShardEvent:
+        return self._publish(ShardEvent(shard, ShardStatus.RECOVERY, node,
+                                        progress))
+
+    def shard_error(self, shard: int, node: str) -> ShardEvent:
+        ev = self._publish(ShardEvent(shard, ShardStatus.ERROR, None))
+        return ev
+
+    def _publish(self, ev: ShardEvent) -> ShardEvent:
+        self.mapper.apply(ev)
+        for sub in self.subscribers:
+            try:
+                sub(ev)
+            except Exception:
+                log.exception("shard event subscriber failed")
+        return ev
+
+    def subscribe(self, fn) -> None:
+        self.subscribers.append(fn)
+        # resync: replay current state (reference SubscribeShardUpdates)
+        for s in range(self.num_shards):
+            fn(ShardEvent(s, self.mapper.statuses[s], self.mapper.owners[s]))

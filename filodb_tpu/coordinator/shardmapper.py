@@ -1,0 +1,84 @@
+"""ShardMapper: shard → node routing table with shard statuses.
+
+Counterpart of reference ``coordinator/src/main/scala/filodb.coordinator/
+ShardMapper.scala:26-49`` and ``ShardStatus.scala:1-94``: tracks, per shard,
+the owning node and its lifecycle status; computes ingestion routing and
+query fan-out sets (hash + spread semantics live in ``core.partkey``).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+from filodb_tpu.core.partkey import ingestion_shard, shards_for_shard_key
+
+
+class ShardStatus(enum.Enum):
+    UNASSIGNED = "unassigned"
+    ASSIGNED = "assigned"
+    ACTIVE = "active"
+    RECOVERY = "recovery"
+    ERROR = "error"
+    STOPPED = "stopped"
+    DOWN = "down"
+
+    @property
+    def queryable(self) -> bool:
+        return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY)
+
+
+@dataclass
+class ShardEvent:
+    """Reference ``ShardEvent`` family (IngestionStarted, ShardDown, ...)."""
+
+    shard: int
+    status: ShardStatus
+    node: str | None = None
+    progress: int = 0  # recovery progress percent
+
+
+@dataclass
+class ShardMapper:
+    num_shards: int
+    statuses: list[ShardStatus] = field(default_factory=list)
+    owners: list[str | None] = field(default_factory=list)
+
+    def __post_init__(self):
+        assert self.num_shards & (self.num_shards - 1) == 0, \
+            "num_shards must be a power of 2"
+        if not self.statuses:
+            self.statuses = [ShardStatus.UNASSIGNED] * self.num_shards
+            self.owners = [None] * self.num_shards
+
+    def apply(self, ev: ShardEvent) -> None:
+        self.statuses[ev.shard] = ev.status
+        if ev.node is not None or ev.status in (ShardStatus.UNASSIGNED,
+                                                ShardStatus.DOWN):
+            self.owners[ev.shard] = ev.node
+
+    def node_for(self, shard: int) -> str | None:
+        return self.owners[shard]
+
+    def shards_of(self, node: str) -> list[int]:
+        return [s for s, o in enumerate(self.owners) if o == node]
+
+    def active_shards(self) -> list[int]:
+        return [s for s, st in enumerate(self.statuses) if st.queryable]
+
+    def unassigned_shards(self) -> list[int]:
+        return [s for s, o in enumerate(self.owners) if o is None]
+
+    def ingestion_shard(self, shard_key_h: int, part_h: int,
+                        spread: int) -> int:
+        return ingestion_shard(shard_key_h, part_h, self.num_shards, spread)
+
+    def query_shards(self, shard_key_h: int, spread: int) -> list[int]:
+        return shards_for_shard_key(shard_key_h, self.num_shards, spread)
+
+    def all_queryable(self, shards: list[int]) -> bool:
+        return all(self.statuses[s].queryable for s in shards)
+
+    def snapshot(self) -> list[dict]:
+        return [{"shard": s, "status": self.statuses[s].value,
+                 "node": self.owners[s]} for s in range(self.num_shards)]
