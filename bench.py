@@ -17,10 +17,36 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+
+def _ensure_backend(probe_timeout_s: int = 600) -> str:
+    """Probe the configured accelerator in a subprocess; fall back to CPU if
+    backend init doesn't complete (the TPU tunnel can be down) so the bench
+    always reports a number."""
+    if os.environ.get("FILODB_BENCH_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); import jax.numpy as jnp; "
+             "jnp.arange(4).sum().block_until_ready()"],
+            check=True, timeout=probe_timeout_s, capture_output=True)
+        import jax
+        return jax.devices()[0].platform
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        sys.stderr.write(f"accelerator probe failed ({type(e).__name__}); "
+                         "falling back to CPU\n")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
 
 NUM_SHARDS = 8
 NUM_SERIES = 100
@@ -106,6 +132,8 @@ def naive_baseline_qps(svc, start_sec, end_sec, n_iters=5):
 
 
 def main():
+    platform = _ensure_backend()
+    sys.stderr.write(f"bench backend: {platform}\n")
     svc, _ = build_service()
     start_sec = START_SEC + 1800
     end_sec = START_SEC + 1800 + 30 * 60  # 30-min range, 31 steps

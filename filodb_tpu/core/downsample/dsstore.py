@@ -55,6 +55,14 @@ class DownsampledTimeSeriesShard:
         self._refreshed = False
         self._known: dict = {}
         self._parts: dict = {}
+        # leaf-exec batch cache protocol (see TimeSeriesShard.batch_cache);
+        # ds data only changes when the downsampler job republishes
+        self.batch_cache: dict = {}
+        self.batch_cache_cap = 64
+
+    @property
+    def data_version(self) -> int:
+        return len(self._known)
 
     def refresh_index(self) -> int:
         """Bootstrap/refresh the index from persisted ds part keys

@@ -205,7 +205,7 @@ class TimeSeriesPartition:
         """
         if col is None:
             col = self.schema.data.value_column
-        chunks = self.chunks_in_range(start, end)
+        chunks = self.chunks_in_range(start, end, include_buffer=False)
         if extra_chunks:
             have = {c.id for c in chunks}
             for c in extra_chunks:
@@ -225,6 +225,23 @@ class TimeSeriesPartition:
                 val_parts.append(vals.rows[mask])
             else:
                 val_parts.append(np.asarray(vals)[mask])
+        # append the active write buffer directly (no encode round-trip)
+        b = self._buf
+        if b.n:
+            bts = b.ts[: b.n]
+            mask = (bts >= start) & (bts <= end)
+            if mask.any():
+                ts_parts.append(bts[mask].copy())
+                data = b.cols[col - 1]
+                colspec = self.schema.data.columns[col]
+                if colspec.ctype == ColumnType.HISTOGRAM:
+                    les = (self.bucket_les if self.bucket_les is not None
+                           else les)
+                    rows = (data[: b.n] if data is not None
+                            else np.zeros((b.n, 0), np.int64))
+                    val_parts.append(rows[mask].copy())
+                else:
+                    val_parts.append(np.asarray(data[: b.n])[mask].copy())
         if not ts_parts:
             empty = np.array([], np.int64)
             return empty, (HistogramColumn(np.array([]), np.zeros((0, 0), np.int64))

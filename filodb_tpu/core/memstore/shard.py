@@ -78,6 +78,17 @@ class TimeSeriesShard:
         # on-demand paging cache (reference OnDemandPagingShard)
         from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
         self.odp_cache = DemandPagedChunkCache()
+        # query-batch cache: repeated scans of unchanged data reuse the
+        # decoded/padded SeriesBatch (keyed by ingest version; the analog of
+        # the reference keeping chunks hot in block memory across queries)
+        self.batch_cache: dict = {}
+        self.batch_cache_cap = 64
+
+    @property
+    def data_version(self) -> int:
+        """Monotonic version bumped by every ingested row; query caches key
+        on it."""
+        return self.stats.rows_ingested.value + self.stats.partitions_purged.value
 
     # ---- partition lifecycle --------------------------------------------
 

@@ -41,7 +41,18 @@ class Chunk:
         return sum(len(v) for v in self.vectors)
 
     def decode_column(self, i: int):
-        return codecs.decode_any(self.vectors[i])
+        """Decode one column; memoized — chunks are immutable, and queries
+        with overlapping ranges re-read the same chunks (the reference keeps
+        decoded-adjacent state in block memory; here the decode cache plays
+        that role)."""
+        cache = self.__dict__.get("_decoded")
+        if cache is None:
+            object.__setattr__(self, "_decoded", {})
+            cache = self.__dict__["_decoded"]
+        out = cache.get(i)
+        if out is None:
+            out = cache[i] = codecs.decode_any(self.vectors[i])
+        return out
 
     def serialize(self) -> bytes:
         head = struct.pack("<qIqqI", self.id, self.num_rows, self.start_time,

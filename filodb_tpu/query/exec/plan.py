@@ -151,14 +151,26 @@ class SelectRawPartitionsExec(ExecPlan):
             extra_chunks = page_partitions(shard, parts, self.chunk_start,
                                            self.chunk_end, shard.odp_cache)
         outs = []
+        version = shard.data_version
         for schema_name, sparts in by_schema.items():
             schema = sparts[0].schema
             col = self._value_col_index(schema)
-            batch = build_batch(sparts, self.chunk_start, self.chunk_end, col,
-                                extra_chunks=extra_chunks)
+            cache_key = (schema_name, str(self.filters), self.chunk_start,
+                         self.chunk_end, col, tuple(p.part_id for p in sparts))
+            cached = shard.batch_cache.get(cache_key)
+            if cached is not None and cached[0] == version:
+                _, batch, keys, is_counter = cached
+            else:
+                batch = build_batch(sparts, self.chunk_start, self.chunk_end,
+                                    col, extra_chunks=extra_chunks)
+                keys = [RangeVectorKey.of(p.part_key.label_map)
+                        for p in sparts]
+                is_counter = schema.data.columns[col].is_counter
+                if len(shard.batch_cache) >= shard.batch_cache_cap:
+                    shard.batch_cache.pop(next(iter(shard.batch_cache)))
+                shard.batch_cache[cache_key] = (version, batch, keys,
+                                                is_counter)
             ctx.stats.samples_scanned += int(batch.counts.sum())
-            keys = [RangeVectorKey.of(p.part_key.label_map) for p in sparts]
-            is_counter = schema.data.columns[col].is_counter
             outs.append((batch, keys, is_counter))
         # the first transformer must be the windowing mapper — it consumes the
         # batch directly; the rest apply to the concatenated step matrix
