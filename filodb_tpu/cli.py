@@ -1,0 +1,209 @@
+"""filo-cli: operator command line.
+
+Counterpart of reference ``cli/src/main/scala/filodb.cli/CliMain.scala:80,
+100-115,378`` commands: init / list / status / indexnames / indexvalues /
+labelvalues / importcsv / promql execution / partkey+vector decode debug.
+
+Embedded mode: opens the local data dir directly. Remote mode: ``--host``
+targets a running server's HTTP API.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+
+import numpy as np
+
+
+def _open_stores(data_dir: str):
+    import os
+
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.localstore import (
+        LocalDiskColumnStore,
+        LocalDiskMetaStore,
+    )
+    root = os.path.join(data_dir, "columnstore")
+    cs = LocalDiskColumnStore(root)
+    meta = LocalDiskMetaStore(root)
+    return cs, meta, TimeSeriesMemStore(cs, meta)
+
+
+def cmd_init(args):
+    cs, _, _ = _open_stores(args.data_dir)
+    cs.initialize(args.dataset, args.num_shards)
+    print(f"initialized dataset {args.dataset} with {args.num_shards} shards")
+
+
+def cmd_list(args):
+    cs, _, _ = _open_stores(args.data_dir)
+    total = 0
+    for shard in range(args.num_shards):
+        recs = cs.scan_part_keys(args.dataset, shard)
+        total += len(recs)
+        for r in recs[: args.limit]:
+            print(f"shard={shard} {r.part_key} "
+                  f"[{r.start_time}, {r.end_time}]")
+    print(f"total partitions: {total}")
+
+
+def cmd_status(args):
+    import urllib.request
+    url = f"http://{args.host}/api/v1/cluster/{args.dataset}/status"
+    with urllib.request.urlopen(url) as r:
+        print(json.dumps(json.load(r), indent=2))
+
+
+def cmd_indexnames(args):
+    cs, meta, ms = _open_stores(args.data_dir)
+    from filodb_tpu.core.store.config import StoreConfig
+    names = set()
+    for shard in range(args.num_shards):
+        s = ms.setup(args.dataset, shard, StoreConfig())
+        s.recover_index()
+        names.update(s.label_names())
+    print("\n".join(sorted(names)))
+
+
+def cmd_labelvalues(args):
+    cs, meta, ms = _open_stores(args.data_dir)
+    from filodb_tpu.core.store.config import StoreConfig
+    vals = set()
+    for shard in range(args.num_shards):
+        s = ms.setup(args.dataset, shard, StoreConfig())
+        s.recover_index()
+        vals.update(s.label_values(args.label))
+    print("\n".join(sorted(vals)))
+
+
+def cmd_importcsv(args):
+    """CSV: timestamp_ms,value,label1=value1,label2=value2,..."""
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.core.partkey import METRIC_LABEL, PartKey
+    from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
+    from filodb_tpu.core.store.config import StoreConfig
+
+    cs, meta, ms = _open_stores(args.data_dir)
+    for shard in range(args.num_shards):
+        s = ms.setup(args.dataset, shard, StoreConfig())
+        s.recover_index()
+        s.setup_watermarks_for_recovery()
+    container = RecordContainer()
+    n = 0
+    with open(args.file) as f:
+        for row in csv.reader(f):
+            if not row or row[0].startswith("#"):
+                continue
+            ts, value = int(row[0]), float(row[1])
+            labels = {METRIC_LABEL: args.metric}
+            for pair in row[2:]:
+                k, v = pair.split("=", 1)
+                labels[k] = v
+            container.add(IngestRecord(PartKey.create("gauge", labels), ts,
+                                       (value,)))
+            n += 1
+            if len(container) >= 1000:
+                ingest_routed(ms, args.dataset, [SomeData(container, n)],
+                              args.num_shards, args.spread)
+                container = RecordContainer()
+    if len(container):
+        ingest_routed(ms, args.dataset, [SomeData(container, n)],
+                      args.num_shards, args.spread)
+    for s in ms.shards_for(args.dataset):
+        s.flush_all()
+    print(f"imported {n} samples")
+
+
+def cmd_promql(args):
+    if args.host:
+        import urllib.parse
+        import urllib.request
+        qs = urllib.parse.urlencode({
+            "query": args.promql, "start": args.start, "end": args.end,
+            "step": args.step})
+        url = (f"http://{args.host}/promql/{args.dataset}/api/v1/"
+               f"query_range?{qs}")
+        with urllib.request.urlopen(url) as r:
+            print(json.dumps(json.load(r), indent=2))
+        return
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.http.promjson import matrix_json
+
+    cs, meta, ms = _open_stores(args.data_dir)
+    for shard in range(args.num_shards):
+        s = ms.setup(args.dataset, shard, StoreConfig())
+        s.recover_index()
+    svc = QueryService(ms, args.dataset, args.num_shards, args.spread)
+    r = svc.query_range(args.promql, args.start, args.step, args.end)
+    print(json.dumps(matrix_json(r), indent=2))
+
+
+def cmd_decode_chunk(args):
+    """Debug: decode and dump a partition's chunk info + samples (reference
+    ``decodeChunkInfo`` / ``decodeVector`` commands)."""
+    cs, meta, ms = _open_stores(args.data_dir)
+    from filodb_tpu.memory.codecs import HistogramColumn
+    for shard in range(args.num_shards):
+        for rec in cs.scan_part_keys(args.dataset, shard):
+            if args.filter and args.filter not in str(rec.part_key):
+                continue
+            chunks = cs.read_chunks(args.dataset, shard, rec.part_key,
+                                    0, 2**62)
+            print(f"partition {rec.part_key} shard={shard}: "
+                  f"{len(chunks)} chunks")
+            for c in chunks[: args.limit]:
+                print(f"  chunk id={c.id} rows={c.num_rows} "
+                      f"[{c.start_time}..{c.end_time}] bytes={c.nbytes}")
+                if args.verbose:
+                    ts = c.decode_column(0)
+                    vals = c.decode_column(len(c.vectors) - 1)
+                    if isinstance(vals, HistogramColumn):
+                        print(f"    les={vals.les}")
+                    else:
+                        print(f"    ts[:5]={ts[:5]} vals[:5]="
+                              f"{np.asarray(vals)[:5]}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="filo-cli")
+    ap.add_argument("--data-dir", default="./filodb-data")
+    ap.add_argument("--dataset", default="timeseries")
+    ap.add_argument("--num-shards", type=int, default=4)
+    ap.add_argument("--spread", type=int, default=1)
+    ap.add_argument("--host", default=None,
+                    help="host:port of a running server (remote mode)")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("init")
+    p = sub.add_parser("list")
+    p.add_argument("--limit", type=int, default=20)
+    sub.add_parser("status")
+    sub.add_parser("indexnames")
+    p = sub.add_parser("labelvalues")
+    p.add_argument("label")
+    p = sub.add_parser("importcsv")
+    p.add_argument("file")
+    p.add_argument("--metric", required=True)
+    p = sub.add_parser("promql")
+    p.add_argument("promql")
+    p.add_argument("--start", type=int, required=True)
+    p.add_argument("--end", type=int, required=True)
+    p.add_argument("--step", type=int, default=60)
+    p = sub.add_parser("decodechunks")
+    p.add_argument("--filter", default=None)
+    p.add_argument("--limit", type=int, default=5)
+    p.add_argument("--verbose", action="store_true")
+
+    args = ap.parse_args(argv)
+    {"init": cmd_init, "list": cmd_list, "status": cmd_status,
+     "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
+     "importcsv": cmd_importcsv, "promql": cmd_promql,
+     "decodechunks": cmd_decode_chunk}[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Server/dataset configuration.
+
+Counterpart of the reference's layered HOCON config system
+(``filodb-defaults.conf`` ← server conf ← per-dataset source conf, parsed
+into ``FilodbSettings``/``StoreConfig``/``IngestionConfig``). The format here
+is JSON (stdlib; HOCON adds no capability), with the same layering: defaults
+← server file ← per-dataset blocks.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+
+DEFAULTS = {
+    "node_name": "node-0",
+    "data_dir": "./filodb-data",
+    "http_port": 8080,
+    "gateway_port": 0,            # 0 = disabled
+    "executor_port": 0,           # plan-shipping server; 0 = ephemeral
+    "seeds": [],                  # bootstrap seed addresses
+    "datasets": {
+        "timeseries": {
+            "num_shards": 4,
+            "min_num_nodes": 1,
+            "spread": 1,
+            "store": {
+                "flush_interval_ms": 3_600_000,
+                "max_chunk_size": 400,
+                "groups_per_shard": 20,
+                "retention_ms": 3 * 24 * 3_600_000,
+            },
+        }
+    },
+}
+
+
+@dataclass
+class ServerConfig:
+    node_name: str = "node-0"
+    data_dir: str = "./filodb-data"
+    http_port: int = 8080
+    gateway_port: int = 0
+    executor_port: int = 0
+    seeds: list[str] = field(default_factory=list)
+    datasets: dict[str, IngestionConfig] = field(default_factory=dict)
+    spreads: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def load(path: str | None = None) -> "ServerConfig":
+        cfg = json.loads(json.dumps(DEFAULTS))  # deep copy
+        if path:
+            with open(path) as f:
+                user = json.load(f)
+            _deep_merge(cfg, user)
+        datasets = {}
+        spreads = {}
+        for name, d in cfg["datasets"].items():
+            store = StoreConfig(**{k: v for k, v in d.get("store", {}).items()
+                                   if k in StoreConfig.__dataclass_fields__})
+            datasets[name] = IngestionConfig(
+                dataset=name, num_shards=d.get("num_shards", 4),
+                min_num_nodes=d.get("min_num_nodes", 1), store=store)
+            spreads[name] = d.get("spread", 1)
+        return ServerConfig(
+            node_name=cfg["node_name"], data_dir=cfg["data_dir"],
+            http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
+            executor_port=cfg["executor_port"], seeds=cfg["seeds"],
+            datasets=datasets, spreads=spreads)
+
+
+def _deep_merge(base: dict, over: dict) -> None:
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_merge(base[k], v)
+        else:
+            base[k] = v

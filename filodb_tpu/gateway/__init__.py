@@ -1,0 +1,5 @@
+"""Gateway: external wire protocols → ingestion records → shard-routed log.
+
+Counterpart of reference ``gateway/`` module (``GatewayServer.scala:58``,
+``InfluxProtocolParser``, ``KafkaContainerSink``).
+"""

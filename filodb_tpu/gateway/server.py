@@ -1,0 +1,99 @@
+"""Gateway TCP server: Influx line protocol in, shard-routed log out.
+
+Counterpart of reference ``GatewayServer.scala:58`` (Netty TCP →
+BinaryRecords → per-shard containers → Kafka): lines arrive over TCP (one
+per line, Influx wire format), are parsed to records, routed to shards by
+partition-key hash (identical hash/spread semantics as ingestion — so this
+gateway and the shards agree without coordination), batched per shard and
+appended to the shard logs.
+"""
+
+from __future__ import annotations
+
+import logging
+import socketserver
+import threading
+import time
+
+from filodb_tpu.coordinator.ingestion import route_container
+from filodb_tpu.core.record import RecordContainer
+from filodb_tpu.gateway.influx import InfluxParseError, parse_influx_line
+from filodb_tpu.kafka.log import ReplayLog
+from filodb_tpu.utils.metrics import Counter
+
+log = logging.getLogger(__name__)
+
+lines_parsed = Counter("gateway_lines_parsed")
+lines_failed = Counter("gateway_lines_failed")
+
+
+class ContainerSink:
+    """Batches records per shard and flushes to the shard logs (reference
+    ``KafkaContainerSink``)."""
+
+    def __init__(self, logs: dict[int, ReplayLog], num_shards: int,
+                 spread: int = 1, flush_every: int = 512):
+        self.logs = logs
+        self.num_shards = num_shards
+        self.spread = spread
+        self.flush_every = flush_every
+        self._pending = RecordContainer()
+        self._lock = threading.Lock()
+
+    def add(self, records) -> None:
+        with self._lock:
+            for r in records:
+                self._pending.add(r)
+            if len(self._pending) >= self.flush_every:
+                self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not len(self._pending):
+            return
+        for shard, cont in route_container(self._pending, self.num_shards,
+                                           self.spread).items():
+            self.logs[shard].append(cont)
+        self._pending = RecordContainer()
+
+
+class GatewayServer:
+    def __init__(self, sink: ContainerSink,
+                 default_labels: dict[str, str] | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.sink = sink
+        self.default_labels = default_labels or {"_ws_": "default",
+                                                 "_ns_": "default"}
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for raw in self.rfile:
+                    try:
+                        recs = parse_influx_line(
+                            raw.decode("utf-8", "replace"),
+                            outer.default_labels,
+                            now_ms=int(time.time() * 1000))
+                        if recs:
+                            outer.sink.add(recs)
+                            lines_parsed.inc()
+                    except (InfluxParseError, ValueError):
+                        lines_failed.inc()
+                outer.sink.flush()
+
+        self.server = socketserver.ThreadingTCPServer((host, port), Handler)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "GatewayServer":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()

@@ -1,0 +1,111 @@
+"""FiloServer: the standalone server process.
+
+Counterpart of reference ``standalone/src/main/scala/filodb.standalone/
+FiloServer.scala:38,86``: boots the stores, joins the cluster (seed
+discovery), starts per-shard ingestion with recovery, and serves the
+Prometheus HTTP API, the plan-executor port (remote dispatch) and optionally
+the Influx gateway.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+
+from filodb_tpu.config import ServerConfig
+from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+from filodb_tpu.coordinator.remote import PlanExecutorServer
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.localstore import (
+    LocalDiskColumnStore,
+    LocalDiskMetaStore,
+)
+from filodb_tpu.gateway.server import ContainerSink, GatewayServer
+from filodb_tpu.http.server import FiloHttpServer
+from filodb_tpu.kafka.log import FileLog
+
+log = logging.getLogger(__name__)
+
+
+class FiloServer:
+    def __init__(self, config: ServerConfig):
+        self.config = config
+        os.makedirs(config.data_dir, exist_ok=True)
+        self.column_store = LocalDiskColumnStore(
+            os.path.join(config.data_dir, "columnstore"))
+        self.meta_store = LocalDiskMetaStore(
+            os.path.join(config.data_dir, "columnstore"))
+        self.memstore = TimeSeriesMemStore(self.column_store, self.meta_store)
+        self.node = Node(config.node_name, self.memstore)
+        self.cluster = FilodbCluster()
+        self.logs: dict[tuple[str, int], FileLog] = {}
+        self.http: FiloHttpServer | None = None
+        self.gateway: GatewayServer | None = None
+        self.executor: PlanExecutorServer | None = None
+
+    def start(self) -> "FiloServer":
+        cfg = self.config
+        # plan-executor port (remote scatter-gather)
+        self.executor = PlanExecutorServer(self.memstore,
+                                           port=cfg.executor_port).start()
+        self.node.executor_port = self.executor.port
+        self.cluster.join(self.node)
+        services = {}
+        for name, ing_cfg in cfg.datasets.items():
+            logs = {}
+            for shard in range(ing_cfg.num_shards):
+                p = os.path.join(cfg.data_dir, "wal", name,
+                                 f"shard-{shard}.log")
+                logs[shard] = FileLog(p)
+                self.logs[(name, shard)] = logs[shard]
+            self.cluster.setup_dataset(ing_cfg, logs)
+            services[name] = self.cluster.query_service(
+                name, cfg.spreads.get(name, 1))
+        self.cluster.start_failure_detector()
+        self.http = FiloHttpServer(services, port=cfg.http_port,
+                                   cluster=self.cluster).start()
+        if cfg.gateway_port:
+            first = next(iter(cfg.datasets.values()))
+            sink = ContainerSink(
+                {s: self.logs[(first.dataset, s)]
+                 for s in range(first.num_shards)},
+                first.num_shards, cfg.spreads.get(first.dataset, 1))
+            self.gateway = GatewayServer(sink, port=cfg.gateway_port).start()
+        log.info("FiloServer up: http=%d executor=%d", self.http.port,
+                 self.executor.port)
+        return self
+
+    def shutdown(self):
+        if self.http:
+            self.http.stop()
+        if self.gateway:
+            self.gateway.stop()
+        if self.executor:
+            self.executor.stop()
+        self.cluster.stop()
+        for l in self.logs.values():
+            l.close()
+        self.column_store.close()
+        self.meta_store.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="filodb_tpu standalone server")
+    ap.add_argument("--config", help="server config JSON", default=None)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = FiloServer(ServerConfig.load(args.config)).start()
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    import time
+    while not stop:
+        time.sleep(0.5)
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

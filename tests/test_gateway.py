@@ -1,0 +1,103 @@
+"""Gateway tests: Influx line protocol parsing + TCP ingestion path.
+
+Mirrors reference ``gateway/src/test/scala/filodb/gateway`` specs
+(InfluxProtocolParser histogram-aware conversion, GatewaySerer routing).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.gateway.influx import InfluxParseError, parse_influx_line
+from filodb_tpu.gateway.server import ContainerSink, GatewayServer
+from filodb_tpu.kafka.log import InMemoryLog
+
+
+class TestInfluxParser:
+    def test_simple_gauge(self):
+        recs = parse_influx_line(
+            "cpu_usage,host=h1,app=api value=42.5 1600000000000000000")
+        assert len(recs) == 1
+        r = recs[0]
+        assert r.part_key.schema == "gauge"
+        assert r.part_key.metric == "cpu_usage"
+        assert r.part_key.label_map["host"] == "h1"
+        assert r.timestamp == 1_600_000_000_000
+        assert r.values == (42.5,)
+
+    def test_counter(self):
+        recs = parse_influx_line("reqs,host=h counter=100i 1600000000000000000")
+        assert recs[0].part_key.schema == "prom-counter"
+        assert recs[0].values == (100.0,)
+
+    def test_multi_field_fanout(self):
+        recs = parse_influx_line(
+            "disk,host=h used=10,free=90 1600000000000000000")
+        metrics = sorted(r.part_key.metric for r in recs)
+        assert metrics == ["disk_free", "disk_used"]
+
+    def test_histogram_first_class(self):
+        line = ("latency,app=api 0.025=1i,0.05=3i,0.1=6i,+Inf=10i,"
+                "sum=0.9,count=10i 1600000000000000000")
+        recs = parse_influx_line(line)
+        assert len(recs) == 1
+        r = recs[0]
+        assert r.part_key.schema == "prom-histogram"
+        s, c, (les, buckets) = r.values
+        assert s == 0.9 and c == 10.0
+        assert np.isinf(les[-1])
+        np.testing.assert_array_equal(buckets, [1, 3, 6, 10])
+
+    def test_escapes(self):
+        recs = parse_influx_line(
+            r"my\ metric,tag=a\,b value=1 1600000000000000000")
+        assert recs[0].part_key.metric == "my metric"
+        assert recs[0].part_key.label_map["tag"] == "a,b"
+
+    def test_default_labels(self):
+        recs = parse_influx_line("m value=1 1600000000000000000",
+                                 {"_ws_": "demo", "_ns_": "App-1"})
+        assert recs[0].part_key.label_map["_ws_"] == "demo"
+
+    def test_bool_and_int_suffixes(self):
+        recs = parse_influx_line("m up=t,n=5i 1600000000000000000")
+        vals = {r.part_key.metric: r.values[0] for r in recs}
+        assert vals == {"m_up": 1.0, "m_n": 5.0}
+
+    def test_string_fields_skipped(self):
+        recs = parse_influx_line('m value=1,note="hello" 1600000000000000000')
+        assert len(recs) == 1  # only numeric field survives
+
+    def test_missing_timestamp_uses_now(self):
+        recs = parse_influx_line("m value=1", now_ms=12345)
+        assert recs[0].timestamp == 12345
+
+    def test_malformed(self):
+        with pytest.raises(InfluxParseError):
+            parse_influx_line("justonefield")
+        assert parse_influx_line("") == []
+        assert parse_influx_line("# comment") == []
+
+
+class TestGatewayServer:
+    def test_tcp_to_logs(self):
+        logs = {s: InMemoryLog() for s in range(4)}
+        sink = ContainerSink(logs, num_shards=4, spread=1, flush_every=8)
+        srv = GatewayServer(sink, {"_ws_": "demo", "_ns_": "App-1"}).start()
+        try:
+            with socket.create_connection(("127.0.0.1", srv.port)) as s:
+                for i in range(20):
+                    s.sendall(
+                        f"cpu,host=h{i % 3} value={i} "
+                        f"{(1_600_000_000 + i) * 1_000_000_000}\n".encode())
+            time.sleep(0.2)
+            sink.flush()
+            total = 0
+            for log in logs.values():
+                for sd in log.read_from(0):
+                    total += len(sd.container)
+            assert total == 20
+        finally:
+            srv.stop()
