@@ -1,0 +1,237 @@
+"""HA and federation planners.
+
+Counterparts of reference ``coordinator/.../queryplanner/``:
+
+- ``HighAvailabilityPlanner`` + ``FailureProvider``
+  (``HighAvailabilityPlanner.scala``, ``FailureRoutingStrategy.scala``):
+  route around local-cluster failure time ranges by sending those sub-ranges
+  to a replica cluster as PromQL over HTTP, stitching results.
+- ``MultiPartitionPlanner`` (``MultiPartitionPlanner.scala``): federate
+  distinct FiloDB "partitions" (clusters) — a locator maps shard-key values
+  to the owning partition; non-local partitions are queried remotely.
+- ``SinglePartitionPlanner``: select a planner per query by metric/shard-key.
+- ``ShardKeyRegexPlanner`` (``ShardKeyRegexPlanner.scala``): fan out regex
+  shard-key filters into concrete shard keys, pushing aggregations down and
+  reducing across the fan-out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex
+from filodb_tpu.coordinator.longtime_planner import _plan_times
+from filodb_tpu.coordinator.planner import QueryPlanner, _retime
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec.plan import (
+    DistConcatExec,
+    ExecPlan,
+    ReduceAggregateExec,
+    StitchRvsExec,
+)
+from filodb_tpu.query.exec.remote_exec import PromQlRemoteExec
+from filodb_tpu.query.logical_parser import to_promql
+from filodb_tpu.query.model import QueryContext
+
+
+@dataclass(frozen=True)
+class TimeRange:
+    start: int
+    end: int
+
+
+class FailureProvider:
+    """Supplies known failure time ranges of a cluster (reference
+    ``FailureProvider``)."""
+
+    def failures(self, dataset: str, time_range: TimeRange
+                 ) -> list[TimeRange]:
+        raise NotImplementedError
+
+
+@dataclass
+class StaticFailureProvider(FailureProvider):
+    ranges: list[TimeRange] = field(default_factory=list)
+
+    def failures(self, dataset, time_range):
+        return [r for r in self.ranges
+                if r.end >= time_range.start and r.start <= time_range.end]
+
+
+@dataclass
+class HighAvailabilityPlanner(QueryPlanner):
+    dataset: str
+    local_planner: QueryPlanner
+    failure_provider: FailureProvider
+    remote_endpoint: str  # replica cluster base URL (…/promql/{dataset})
+
+    def materialize(self, plan, qcontext=None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        times = _plan_times(plan)
+        if times is None:
+            return self.local_planner.materialize(plan, qcontext)
+        start, step, end, lookback = times
+        fails = self.failure_provider.failures(
+            self.dataset, TimeRange(start - lookback, end))
+        if not fails:
+            return self.local_planner.materialize(plan, qcontext)
+        step = max(step, 1)
+        # classify each step: a step is poisoned when its window overlaps a
+        # failure; contiguous runs become local or remote sub-plans
+        parts: list[ExecPlan] = []
+        run_start = start
+        run_remote = self._poisoned(start, lookback, fails)
+        t = start + step
+        while t <= end + step:
+            poisoned = (self._poisoned(t, lookback, fails)
+                        if t <= end else not run_remote)
+            if t > end or poisoned != run_remote:
+                sub = _retime(plan, run_start, step, t - step)
+                parts.append(self._remote(sub, run_start, step, t - step)
+                             if run_remote
+                             else self.local_planner.materialize(sub,
+                                                                 qcontext))
+                run_start = t
+                run_remote = poisoned
+            t += step
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(children_plans=parts)
+
+    @staticmethod
+    def _poisoned(step_ms: int, lookback: int, fails) -> bool:
+        return any(f.start <= step_ms and step_ms - lookback <= f.end
+                   for f in fails)
+
+    def _remote(self, plan, start, step, end) -> PromQlRemoteExec:
+        return PromQlRemoteExec(endpoint=self.remote_endpoint,
+                                promql=to_promql(plan), start=start,
+                                step=step, end=end)
+
+
+class PartitionLocationProvider:
+    """Maps shard-key label values to the owning cluster partition
+    (reference ``PartitionLocationProvider``)."""
+
+    def partition_of(self, shard_key: dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def endpoint_of(self, partition: str) -> str:
+        raise NotImplementedError
+
+
+@dataclass
+class MultiPartitionPlanner(QueryPlanner):
+    locator: PartitionLocationProvider
+    local_partition: str
+    local_planner: QueryPlanner
+    shard_key_labels: tuple[str, ...] = ("_ws_", "_ns_")
+
+    def materialize(self, plan, qcontext=None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        keys = self._shard_keys(plan)
+        partitions = {self.locator.partition_of(k) for k in keys} or {
+            self.local_partition}
+        if partitions == {self.local_partition}:
+            return self.local_planner.materialize(plan, qcontext)
+        if len(partitions) == 1:
+            part = next(iter(partitions))
+            times = _plan_times(plan)
+            start, step, end, _ = times
+            return PromQlRemoteExec(
+                endpoint=self.locator.endpoint_of(part),
+                promql=to_promql(plan), start=start, step=max(step, 1),
+                end=end)
+        # spans partitions: evaluate leaves per partition and concat
+        # (aggregates above are handled by the exec tree's reduce node)
+        raise ValueError(
+            "queries spanning multiple partitions must target a single "
+            "shard key per selector (reference MultiPartitionPlanner "
+            "limitation)")
+
+    def _shard_keys(self, plan) -> list[dict[str, str]]:
+        out = []
+        for raw in lp.leaf_raw_series(plan):
+            eq = {f.column: f.filter.value for f in raw.filters
+                  if isinstance(f.filter, Equals)}
+            if all(lbl in eq for lbl in self.shard_key_labels):
+                out.append({k: eq[k] for k in self.shard_key_labels})
+        return out
+
+
+@dataclass
+class SinglePartitionPlanner(QueryPlanner):
+    """Pick a planner by a selector function over the plan (reference
+    ``SinglePartitionPlanner`` routes per metric)."""
+
+    planners: dict[str, QueryPlanner] = field(default_factory=dict)
+    select: "callable" = None  # plan -> planner name
+    default: str = ""
+
+    def materialize(self, plan, qcontext=None) -> ExecPlan:
+        name = self.select(plan) if self.select else self.default
+        return self.planners.get(name, self.planners[self.default]) \
+            .materialize(plan, qcontext or QueryContext())
+
+
+@dataclass
+class ShardKeyRegexPlanner(QueryPlanner):
+    """Expand regex/multi-valued shard-key filters into concrete shard keys
+    and fan out (reference ``ShardKeyRegexPlanner``): aggregations reduce
+    across the fan-out; plain selectors concat."""
+
+    inner_planner: QueryPlanner
+    shard_key_matcher: "callable"  # filters -> list[dict[label, value]]
+    shard_key_labels: tuple[str, ...] = ("_ws_", "_ns_")
+
+    def materialize(self, plan, qcontext=None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        raws = lp.leaf_raw_series(plan)
+        needs_fanout = any(
+            isinstance(f.filter, EqualsRegex) and f.column in
+            self.shard_key_labels for raw in raws for f in raw.filters)
+        if not needs_fanout:
+            return self.inner_planner.materialize(plan, qcontext)
+        combos = self.shard_key_matcher(raws[0].filters)
+
+        def fan(p):
+            return [self.inner_planner.materialize(
+                _replace_shard_keys(p, combo, self.shard_key_labels),
+                qcontext) for combo in combos]
+
+        if isinstance(plan, lp.Aggregate):
+            if plan.op in ("sum", "min", "max", "group"):
+                # associative: push down per combo, re-reduce with same op
+                return ReduceAggregateExec(children_plans=fan(plan),
+                                           op=plan.op, params=plan.params,
+                                           by=plan.by, without=plan.without)
+            if plan.op == "count":
+                # partial counts combine by summing
+                return ReduceAggregateExec(children_plans=fan(plan),
+                                           op="sum", params=plan.params,
+                                           by=plan.by, without=plan.without)
+            # non-associative (avg/stddev/topk/quantile...): fan out the
+            # unaggregated inner and aggregate once at the root
+            return ReduceAggregateExec(children_plans=fan(plan.vector),
+                                       op=plan.op, params=plan.params,
+                                       by=plan.by, without=plan.without)
+        return DistConcatExec(children_plans=fan(plan))
+
+
+def _replace_shard_keys(plan, combo: dict[str, str], shard_labels):
+    """Rewrite shard-key filters to the concrete combo values."""
+    if isinstance(plan, lp.RawSeries):
+        new_filters = tuple(
+            ColumnFilter(f.column, Equals(combo[f.column]))
+            if f.column in combo else f for f in plan.filters)
+        return dataclasses.replace(plan, filters=new_filters)
+    if dataclasses.is_dataclass(plan):
+        changes = {}
+        for f in dataclasses.fields(plan):
+            v = getattr(plan, f.name)
+            if isinstance(v, lp.LogicalPlan):
+                changes[f.name] = _replace_shard_keys(v, combo, shard_labels)
+        if changes:
+            return dataclasses.replace(plan, **changes)
+    return plan

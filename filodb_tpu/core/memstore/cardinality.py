@@ -1,0 +1,110 @@
+"""Cardinality tracking and quota enforcement.
+
+Counterpart of reference ``core/src/main/scala/filodb.core/memstore/ratelimit/``
+(``CardinalityTracker.scala:1-191``, ``QuotaSource``,
+``RocksDbCardinalityStore``): per shard, a tree over the shard-key prefix
+(workspace → namespace → metric) counting active/total time series, with
+per-prefix quotas; creation of series beyond quota is rejected at ingest.
+The store here is an in-process dict tree (the reference needs RocksDB
+because JVM heap can't hold high-card trees; our counts are plain ints —
+a few MB even at 1M series).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Cardinality:
+    """Counts at one tree node (reference ``Cardinality``)."""
+
+    name: str
+    active_ts: int = 0
+    total_ts: int = 0
+    children: int = 0
+    quota: int = 2**62
+
+
+class QuotaExceededError(Exception):
+    def __init__(self, prefix, quota):
+        super().__init__(f"cardinality quota exceeded at {prefix}: {quota}")
+        self.prefix = prefix
+        self.quota = quota
+
+
+@dataclass
+class _Node:
+    card: Cardinality
+    children: dict[str, "_Node"] = field(default_factory=dict)
+
+
+class CardinalityTracker:
+    """Tracks series cardinality along the shard-key path."""
+
+    def __init__(self, shard: int, shard_key_labels=("_ws_", "_ns_",
+                                                     "_metric_"),
+                 default_quotas: tuple[int, ...] | None = None):
+        self.shard = shard
+        self.shard_key_labels = shard_key_labels
+        self._root = _Node(Cardinality("__root__"))
+        # quota per depth: (root, ws, ns, metric)
+        self._default_quotas = default_quotas or (2**62,) * (
+            len(shard_key_labels) + 1)
+        self._root.card.quota = self._default_quotas[0]
+
+    def _path(self, labels: dict[str, str]) -> list[str]:
+        return [labels.get(k, "") for k in self.shard_key_labels]
+
+    def _walk(self, path: list[str], create: bool = False) -> list[_Node]:
+        nodes = [self._root]
+        cur = self._root
+        for depth, part in enumerate(path):
+            nxt = cur.children.get(part)
+            if nxt is None:
+                if not create:
+                    return nodes
+                nxt = _Node(Cardinality(part,
+                                        quota=self._default_quotas[
+                                            min(depth + 1,
+                                                len(self._default_quotas) - 1)]))
+                cur.children[part] = nxt
+                cur.card.children += 1
+            nodes.append(nxt)
+            cur = nxt
+        return nodes
+
+    def set_quota(self, prefix: list[str], quota: int) -> None:
+        nodes = self._walk(prefix, create=True)
+        nodes[-1].card.quota = quota
+
+    def series_created(self, labels: dict[str, str]) -> None:
+        """Increment counts; raises QuotaExceededError when a prefix is at
+        quota (reference ``CardinalityTracker.incrementCount``)."""
+        path = self._path(labels)
+        nodes = self._walk(path, create=True)
+        for i, n in enumerate(nodes):
+            if n.card.active_ts + 1 > n.card.quota:
+                raise QuotaExceededError(path[:i], n.card.quota)
+        for n in nodes:
+            n.card.active_ts += 1
+            n.card.total_ts += 1
+
+    def series_stopped(self, labels: dict[str, str]) -> None:
+        for n in self._walk(self._path(labels)):
+            n.card.active_ts = max(n.card.active_ts - 1, 0)
+
+    def cardinality(self, prefix: list[str]) -> Cardinality:
+        nodes = self._walk(prefix)
+        if len(nodes) <= len(prefix):
+            return Cardinality("/".join(prefix) or "__root__")
+        return nodes[-1].card
+
+    def top_k(self, prefix: list[str], k: int = 10) -> list[Cardinality]:
+        """Highest-cardinality children under a prefix (CLI ``topkcard``)."""
+        nodes = self._walk(prefix)
+        if len(nodes) <= len(prefix):
+            return []
+        children = nodes[-1].children.values()
+        return sorted((c.card for c in children),
+                      key=lambda c: -c.active_ts)[:k]

@@ -44,6 +44,7 @@ class ShardStats:
 
     rows_ingested: Counter = field(default_factory=lambda: Counter("rows_ingested"))
     rows_skipped: Counter = field(default_factory=lambda: Counter("rows_skipped"))
+    quota_dropped: Counter = field(default_factory=lambda: Counter("quota_dropped"))
     out_of_order_dropped: Counter = field(
         default_factory=lambda: Counter("out_of_order_dropped"))
     partitions_created: Counter = field(
@@ -75,6 +76,9 @@ class TimeSeriesShard:
         self._dirty_part_keys: set[int] = set()
         self._last_flushed_group = -1
         self._ingested_offset = -1
+        # cardinality metering + quotas (reference ratelimit/)
+        from filodb_tpu.core.memstore.cardinality import CardinalityTracker
+        self.cardinality = CardinalityTracker(shard_num)
         # on-demand paging cache (reference OnDemandPagingShard)
         from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
         self.odp_cache = DemandPagedChunkCache()
@@ -100,6 +104,7 @@ class TimeSeriesShard:
         pid = self._by_key.get(key)
         if pid is not None:
             return self.partitions[pid]
+        self.cardinality.series_created(key.label_map)  # may raise quota
         schema = self.schemas[key.schema]
         pid = len(self.partitions)
         part = TimeSeriesPartition(pid, key, schema,
@@ -125,12 +130,18 @@ class TimeSeriesShard:
         """Ingest one container at an offset. Returns rows ingested."""
         n = 0
         offset = data.offset
+        from filodb_tpu.core.memstore.cardinality import QuotaExceededError
         for rec in data.container:
             group = self.group_of(rec.part_key)
             if offset <= self.group_watermarks[group]:
                 self.stats.rows_skipped.inc()  # recovery replay below watermark
                 continue
-            part = self.get_or_create_partition(rec.part_key, rec.timestamp)
+            try:
+                part = self.get_or_create_partition(rec.part_key,
+                                                    rec.timestamp)
+            except QuotaExceededError:
+                self.stats.quota_dropped.inc()
+                continue
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
             else:
@@ -230,6 +241,7 @@ class TimeSeriesShard:
                 self.index.remove_part_key(pid)
                 del self._by_key[part.part_key]
                 self.partitions[pid] = None
+                self.cardinality.series_stopped(part.part_key.label_map)
                 purged += 1
         if purged:
             self.stats.partitions_purged.inc(purged)

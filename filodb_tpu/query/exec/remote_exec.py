@@ -1,0 +1,65 @@
+"""Remote HTTP exec: run a sub-query on another cluster via its Prom API.
+
+Counterpart of reference ``PromQlRemoteExec.scala:1-247`` / ``RemoteExec``:
+cross-cluster federation and HA routing ship PromQL text (not plans) to a
+remote endpoint's ``query_range`` API and convert the JSON matrix back into
+the internal result form.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from filodb_tpu.query.exec.plan import ExecPlan
+from filodb_tpu.query.exec.transformers import steps_array
+from filodb_tpu.query.model import RangeVectorKey, StepMatrix
+
+
+@dataclass
+class PromQlRemoteExec(ExecPlan):
+    endpoint: str = ""        # e.g. http://host:port/promql/timeseries
+    promql: str = ""
+    start: int = 0            # ms
+    step: int = 60_000
+    end: int = 0
+    timeout_s: float = 30.0
+
+    def do_execute(self, ctx) -> StepMatrix:
+        qs = urllib.parse.urlencode({
+            "query": self.promql,
+            "start": self.start // 1000,
+            "end": self.end // 1000,
+            "step": max(self.step // 1000, 1),
+        })
+        url = f"{self.endpoint}/api/v1/query_range?{qs}"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+            body = json.load(r)
+        if body.get("status") != "success":
+            raise RuntimeError(f"remote query failed: {body}")
+        return self._from_matrix_json(body["data"])
+
+    def _from_matrix_json(self, data) -> StepMatrix:
+        steps = steps_array(self.start, self.step, self.end)
+        idx = {int(t): i for i, t in enumerate(steps)}
+        keys, rows = [], []
+        for series in data.get("result", []):
+            labels = {("_metric_" if k == "__name__" else k): v
+                      for k, v in series.get("metric", {}).items()}
+            row = np.full(len(steps), np.nan)
+            for t, v in series.get("values", []):
+                ms = int(float(t) * 1000)
+                i = idx.get(ms)
+                if i is not None:
+                    row[i] = float(v)
+            keys.append(RangeVectorKey.of(labels))
+            rows.append(row)
+        values = np.stack(rows) if rows else np.zeros((0, len(steps)))
+        return StepMatrix(keys, values, steps)
+
+    def __repr__(self):
+        return f"PromQlRemoteExec({self.endpoint!r}, {self.promql!r})"

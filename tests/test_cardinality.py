@@ -1,0 +1,74 @@
+"""Cardinality tracking + quota tests.
+
+Mirrors reference ``CardinalityTrackerSpec`` (ratelimit package).
+"""
+
+import pytest
+
+from filodb_tpu.core.memstore.cardinality import (
+    CardinalityTracker,
+    QuotaExceededError,
+)
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+
+def labels(ws, ns, metric, **extra):
+    return {"_ws_": ws, "_ns_": ns, "_metric_": metric, **extra}
+
+
+class TestTracker:
+    def test_counts_along_path(self):
+        t = CardinalityTracker(0)
+        for i in range(5):
+            t.series_created(labels("w1", "ns1", "m1", instance=str(i)))
+        for i in range(3):
+            t.series_created(labels("w1", "ns2", "m2", instance=str(i)))
+        assert t.cardinality(["w1"]).active_ts == 8
+        assert t.cardinality(["w1", "ns1"]).active_ts == 5
+        assert t.cardinality(["w1", "ns1", "m1"]).active_ts == 5
+        assert t.cardinality(["w1"]).children == 2
+
+    def test_quota_enforced(self):
+        t = CardinalityTracker(0)
+        t.set_quota(["w1", "ns1"], 3)
+        for i in range(3):
+            t.series_created(labels("w1", "ns1", "m1", i=str(i)))
+        with pytest.raises(QuotaExceededError):
+            t.series_created(labels("w1", "ns1", "m1", i="overflow"))
+        # other namespaces unaffected
+        t.series_created(labels("w1", "ns2", "m1"))
+
+    def test_series_stopped_decrements(self):
+        t = CardinalityTracker(0)
+        t.series_created(labels("w", "n", "m", i="a"))
+        t.series_created(labels("w", "n", "m", i="b"))
+        t.series_stopped(labels("w", "n", "m", i="a"))
+        c = t.cardinality(["w", "n", "m"])
+        assert c.active_ts == 1 and c.total_ts == 2
+
+    def test_top_k(self):
+        t = CardinalityTracker(0)
+        for ns, n in (("big", 10), ("mid", 5), ("small", 1)):
+            for i in range(n):
+                t.series_created(labels("w", ns, "m", i=str(i)))
+        top = t.top_k(["w"], 2)
+        assert [c.name for c in top] == ["big", "mid"]
+
+    def test_unknown_prefix_empty(self):
+        t = CardinalityTracker(0)
+        assert t.cardinality(["nope"]).active_ts == 0
+        assert t.top_k(["nope"]) == []
+
+
+class TestShardQuota:
+    def test_ingest_respects_quota(self):
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("timeseries", 0, StoreConfig(max_chunk_size=50))
+        shard.cardinality.set_quota(["demo", "App-0"], 4)
+        keys = machine_metrics_series(10)  # all in demo/App-0
+        for sd in gauge_stream(keys, 10):
+            shard.ingest(sd)
+        assert shard.num_partitions == 4
+        assert shard.stats.quota_dropped.value > 0
