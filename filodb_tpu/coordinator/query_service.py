@@ -90,6 +90,27 @@ class QueryService:
         qr = QueryResult(result, query_id=qcontext.query_id)
         return qr
 
+    def chunk_infos(self, filters, start_ms: int, end_ms: int,
+                    include_buffer: bool = False) -> list[dict]:
+        """Chunk metadata for matching partitions (reference
+        ``SelectChunkInfosExec`` debug query)."""
+        out = []
+        for shard in self.memstore.shards_for(self.dataset):
+            for pid in shard.lookup_partitions(list(filters), start_ms,
+                                               end_ms):
+                part = shard.partition(pid)
+                if part is None:
+                    continue
+                for c in part.chunks_in_range(start_ms, end_ms,
+                                              include_buffer):
+                    out.append({
+                        "shard": shard.shard_num, "partId": pid,
+                        "partKey": str(part.part_key), "chunkId": c.id,
+                        "numRows": c.num_rows, "startTime": c.start_time,
+                        "endTime": c.end_time, "numBytes": c.nbytes,
+                    })
+        return out
+
     def series(self, filters, start_sec: int, end_sec: int) -> list[dict]:
         out = []
         for shard in self.memstore.shards_for(self.dataset):

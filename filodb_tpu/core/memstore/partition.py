@@ -16,6 +16,7 @@ the query engine consumes columns, not rows.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 
 import numpy as np
@@ -196,7 +197,7 @@ class TimeSeriesPartition:
         return before - len(self.chunks)
 
     def read_samples(self, start: int, end: int, col: int = None,
-                     extra_chunks: list | None = None):
+                     extra_chunks: list | None = None):  # noqa: C901
         """Decode all samples with start <= ts <= end for one value column.
 
         Returns (ts int64[n], values) where values is float64[n] or
@@ -251,3 +252,28 @@ class TimeSeriesPartition:
         if les is not None:
             return ts[order], HistogramColumn(les, np.concatenate(val_parts)[order])
         return ts[order], np.concatenate(val_parts)[order]
+
+
+class TracingTimeSeriesPartition(TimeSeriesPartition):
+    """Debug partition that logs every ingest/encode event for targeted
+    part keys (reference ``TracingTimeSeriesPartition``,
+    ``TimeSeriesPartition.scala:494``; enabled per part-key via
+    ``StoreConfig.trace_part_key_substrings``)."""
+
+    __slots__ = ()
+
+    def ingest(self, ts: int, values: tuple) -> bool:
+        ok = super().ingest(ts, values)
+        logging.getLogger("filodb_tpu.trace").info(
+            "TRACE %s shard=%d ingest ts=%d values=%s accepted=%s",
+            self.part_key, self.shard, ts, values, ok)
+        return ok
+
+    def switch_buffers(self):
+        chunk = super().switch_buffers()
+        if chunk is not None:
+            logging.getLogger("filodb_tpu.trace").info(
+                "TRACE %s shard=%d encoded chunk id=%d rows=%d bytes=%d",
+                self.part_key, self.shard, chunk.id, chunk.num_rows,
+                chunk.nbytes)
+        return chunk

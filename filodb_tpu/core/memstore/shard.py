@@ -107,8 +107,16 @@ class TimeSeriesShard:
         self.cardinality.series_created(key.label_map)  # may raise quota
         schema = self.schemas[key.schema]
         pid = len(self.partitions)
-        part = TimeSeriesPartition(pid, key, schema,
-                                   self.config.max_chunk_size, self.shard_num)
+        cls = TimeSeriesPartition
+        if self.config.trace_part_key_substrings:
+            from filodb_tpu.core.memstore.partition import (
+                TracingTimeSeriesPartition,
+            )
+            kstr = str(key)
+            if any(s in kstr for s in self.config.trace_part_key_substrings):
+                cls = TracingTimeSeriesPartition
+        part = cls(pid, key, schema, self.config.max_chunk_size,
+                   self.shard_num)
         self.partitions.append(part)
         self._by_key[key] = pid
         self.index.add_part_key(pid, key, first_ts)
@@ -128,6 +136,18 @@ class TimeSeriesShard:
 
     def ingest(self, data: SomeData) -> int:
         """Ingest one container at an offset. Returns rows ingested."""
+        if self.config.assert_single_writer:
+            # single-writer-per-shard discipline tripwire (reference
+            # FiloSchedulers.assertThreadName, TimeSeriesShard.scala:571)
+            import threading
+            tid = threading.get_ident()
+            owner = getattr(self, "_writer_thread", None)
+            if owner is None:
+                self._writer_thread = tid
+            elif owner != tid:
+                raise AssertionError(
+                    f"shard {self.shard_num} ingested from thread {tid}, "
+                    f"owner is {owner}")
         n = 0
         offset = data.offset
         from filodb_tpu.core.memstore.cardinality import QuotaExceededError

@@ -56,6 +56,11 @@ class ColumnStore:
     def truncate(self, dataset: str) -> None:
         raise NotImplementedError
 
+    def delete_part_keys(self, dataset: str, shard: int,
+                         part_keys: list[PartKey]) -> None:
+        """Remove part keys + their chunks (cardinality buster)."""
+        raise NotImplementedError
+
 
 class MetaStore:
     """Cluster metadata + ingestion checkpoints."""
@@ -143,6 +148,13 @@ class InMemoryColumnStore(ColumnStore):
             del self._chunks[key]
         for key in [k for k in self._part_keys if k[0] == dataset]:
             del self._part_keys[key]
+
+    def delete_part_keys(self, dataset, shard, part_keys):
+        d = self._part_keys[(dataset, shard)]
+        c = self._chunks[(dataset, shard)]
+        for pk in part_keys:
+            d.pop(pk, None)
+            c.pop(pk, None)
 
 
 class InMemoryMetaStore(MetaStore):

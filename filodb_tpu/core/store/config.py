@@ -23,6 +23,11 @@ class StoreConfig:
     max_query_matches: int = 250_000
     # evicted part-key bloom/tracking capacity
     evicted_pk_bloom_filter_capacity: int = 50_000
+    # debug: part keys whose str() contains any of these substrings get a
+    # TracingTimeSeriesPartition (reference trace-filters config)
+    trace_part_key_substrings: tuple[str, ...] = ()
+    # single-writer discipline check (reference FiloSchedulers.assertThreadName)
+    assert_single_writer: bool = False
 
 
 @dataclass(frozen=True)

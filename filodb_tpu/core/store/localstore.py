@@ -157,6 +157,17 @@ class LocalDiskColumnStore(ColumnStore):
         for f in glob.glob(os.path.join(self.root, dataset, "shard-*.db*")):
             _os.remove(f)
 
+    def delete_part_keys(self, dataset, shard, part_keys):
+        c = self._db.conn(dataset, shard)
+        with self._wlock:
+            for pk in part_keys:
+                blob = _pk_blob(pk)
+                c.execute("DELETE FROM partkeys WHERE partition=?", (blob,))
+                c.execute("DELETE FROM chunks WHERE partition=?", (blob,))
+                c.execute("DELETE FROM ingestion_time_index WHERE "
+                          "partition=?", (blob,))
+            c.commit()
+
     def close(self):
         self._db.close()
 

@@ -1,0 +1,128 @@
+"""Offline repair / migration jobs over column stores.
+
+Counterpart of reference ``spark-jobs`` repair plane (without Spark — the
+jobs walk the store's scan APIs directly):
+
+- ``ChunkCopier``           (``repair/ChunkCopier.scala:1-210``): copy chunks
+  between clusters/stores for a time window (disaster recovery, migration).
+- ``PartitionKeysCopier``   (``repair/PartitionKeysCopier.scala:1-180``).
+- ``CardinalityBuster``     (``cardbuster/PerShardCardinalityBuster.scala``):
+  delete part keys (and optionally chunks) matching filters to claw back
+  cardinality.
+- ``DSIndexJob``            (``downsampler/index/DSIndexJob.scala``): migrate
+  part-key updates from the raw to the downsample dataset.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from filodb_tpu.core.filters import ColumnFilter
+from filodb_tpu.core.store.api import ColumnStore, PartKeyRecord
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ChunkCopier:
+    source: ColumnStore
+    target: ColumnStore
+    dataset: str
+    num_shards: int
+
+    def run(self, ingestion_start: int, ingestion_end: int) -> dict:
+        stats = {"partitions": 0, "chunks": 0}
+        for shard in range(self.num_shards):
+            for part_key, chunks in self.source.scan_chunks_by_ingestion_time(
+                    self.dataset, shard, ingestion_start, ingestion_end):
+                self.target.write_chunks(self.dataset, shard, part_key,
+                                         chunks, ingestion_end)
+                stats["partitions"] += 1
+                stats["chunks"] += len(chunks)
+        return stats
+
+
+@dataclass
+class PartitionKeysCopier:
+    source: ColumnStore
+    target: ColumnStore
+    dataset: str
+    num_shards: int
+
+    def run(self) -> int:
+        n = 0
+        for shard in range(self.num_shards):
+            recs = self.source.scan_part_keys(self.dataset, shard)
+            if recs:
+                self.target.write_part_keys(self.dataset, shard, recs)
+                n += len(recs)
+        return n
+
+
+@dataclass
+class CardinalityBuster:
+    """Delete part keys matching filters (reference PerShardCardinalityBuster).
+
+    Requires the column store to support deletion; stores without it raise.
+    """
+
+    store: ColumnStore
+    dataset: str
+    num_shards: int
+
+    def run(self, filters: list[ColumnFilter]) -> int:
+        busted = 0
+        for shard in range(self.num_shards):
+            keep: list[PartKeyRecord] = []
+            victims = []
+            for rec in self.store.scan_part_keys(self.dataset, shard):
+                lm = rec.part_key.label_map
+                if all(f.filter.matches(lm.get(f.column, ""))
+                       for f in filters):
+                    victims.append(rec)
+                else:
+                    keep.append(rec)
+            if victims:
+                self._delete(shard, victims, keep)
+                busted += len(victims)
+        return busted
+
+    def _delete(self, shard, victims, keep):
+        delete = getattr(self.store, "delete_part_keys", None)
+        if delete is None:
+            raise NotImplementedError(
+                f"{type(self.store).__name__} does not support deletion")
+        delete(self.dataset, shard, [v.part_key for v in victims])
+
+
+@dataclass
+class DSIndexJob:
+    """Copy raw part-key updates into the downsample dataset's key table."""
+
+    store: ColumnStore
+    dataset: str
+    ds_dataset: str
+    num_shards: int
+
+    def run(self) -> int:
+        n = 0
+        for shard in range(self.num_shards):
+            recs = self.store.scan_part_keys(self.dataset, shard)
+            ds_recs = [PartKeyRecord(
+                r.part_key.__class__(
+                    _ds_schema_for(r.part_key.schema), r.part_key.labels),
+                r.start_time, r.end_time) for r in recs]
+            if ds_recs:
+                self.store.write_part_keys(self.ds_dataset, shard, ds_recs)
+                n += len(ds_recs)
+        return n
+
+
+def _ds_schema_for(schema: str) -> str:
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+    if schema in DEFAULT_SCHEMAS:
+        ds = DEFAULT_SCHEMAS[schema].data.downsample_schema
+        if ds:
+            return ds
+    return schema

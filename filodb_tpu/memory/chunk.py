@@ -44,15 +44,39 @@ class Chunk:
         """Decode one column; memoized — chunks are immutable, and queries
         with overlapping ranges re-read the same chunks (the reference keeps
         decoded-adjacent state in block memory; here the decode cache plays
-        that role)."""
+        that role). Decode failures raise CorruptVectorError with forensic
+        context (reference ``CorruptVectorException`` analysis,
+        ``MemStore.scala:220``)."""
         cache = self.__dict__.get("_decoded")
         if cache is None:
             object.__setattr__(self, "_decoded", {})
             cache = self.__dict__["_decoded"]
         out = cache.get(i)
         if out is None:
-            out = cache[i] = codecs.decode_any(self.vectors[i])
+            try:
+                out = cache[i] = codecs.decode_any(self.vectors[i])
+            except Exception as e:
+                raise CorruptVectorError(self, i, e) from e
         return out
+
+
+class CorruptVectorError(RuntimeError):
+    """A chunk vector failed to decode — data corruption tripwire.
+
+    The reference halts the process on corruption
+    (``Shutdown.haltAndCatchFire``, ``TimeSeriesShard.scala:349``); here the
+    error carries chunk forensics and the shard marks itself errored via the
+    standard error path (a Python process has no partially-written off-heap
+    state worth halting for)."""
+
+    def __init__(self, chunk: "Chunk", column: int, cause: Exception):
+        head = chunk.vectors[column][:16].hex() if chunk.vectors else ""
+        super().__init__(
+            f"corrupt vector: chunk id={chunk.id} rows={chunk.num_rows} "
+            f"range=[{chunk.start_time},{chunk.end_time}] column={column} "
+            f"head16={head} cause={cause!r}")
+        self.chunk_id = chunk.id
+        self.column = column
 
     def serialize(self) -> bytes:
         head = struct.pack("<qIqqI", self.id, self.num_rows, self.start_time,

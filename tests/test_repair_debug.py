@@ -1,0 +1,132 @@
+"""Repair jobs + debug plane tests (reference spark-jobs repair specs,
+TracingTimeSeriesPartition, chunk-info debug queries, corruption tripwires).
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.core.store.repair import (
+    CardinalityBuster,
+    ChunkCopier,
+    DSIndexJob,
+    PartitionKeysCopier,
+)
+from filodb_tpu.memory.chunk import Chunk, CorruptVectorError
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+START = 1_600_000_000
+
+
+def _populated_store(n_series=6):
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    ms = TimeSeriesMemStore(cs, meta)
+    for s in range(2):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100))
+    keys = machine_metrics_series(n_series)
+    ingest_routed(ms, "timeseries",
+                  gauge_stream(keys, 200, start_ms=START * 1000), 2, 1)
+    ms.flush_all("timeseries")
+    return ms, cs
+
+
+class TestRepairJobs:
+    def test_chunk_copier(self):
+        ms, src = _populated_store()
+        dst = InMemoryColumnStore()
+        stats = ChunkCopier(src, dst, "timeseries", 2).run(0, 2**62)
+        assert stats["partitions"] == 6 and stats["chunks"] >= 6
+        # copied chunks readable from the target
+        key = machine_metrics_series(6)[0]
+        assert dst.read_chunks("timeseries", _shard_of(src, key), key,
+                               0, 2**62)
+
+    def test_partition_keys_copier(self):
+        ms, src = _populated_store()
+        dst = InMemoryColumnStore()
+        n = PartitionKeysCopier(src, dst, "timeseries", 2).run()
+        assert n == 6
+        assert sum(len(dst.scan_part_keys("timeseries", s))
+                   for s in range(2)) == 6
+
+    def test_cardinality_buster(self):
+        ms, cs = _populated_store()
+        buster = CardinalityBuster(cs, "timeseries", 2)
+        busted = buster.run([ColumnFilter("instance", Equals("instance-0"))])
+        assert busted == 1
+        remaining = sum(len(cs.scan_part_keys("timeseries", s))
+                        for s in range(2))
+        assert remaining == 5
+
+    def test_ds_index_job(self):
+        ms, cs = _populated_store()
+        n = DSIndexJob(cs, "timeseries", "timeseries_ds_5m", 2).run()
+        assert n == 6
+        recs = sum((cs.scan_part_keys("timeseries_ds_5m", s)
+                    for s in range(2)), [])
+        assert len(recs) == 6
+        assert all(r.part_key.schema == "ds-gauge" for r in recs)
+
+
+class TestDebugPlane:
+    def test_chunk_infos(self):
+        ms, _ = _populated_store()
+        svc = QueryService(ms, "timeseries", 2, spread=1)
+        infos = svc.chunk_infos(
+            [ColumnFilter("_metric_", Equals("heap_usage"))], 0, 2**62)
+        assert len(infos) >= 6
+        assert {"chunkId", "numRows", "startTime", "numBytes"} <= set(
+            infos[0].keys())
+
+    def test_tracing_partition_logs(self, caplog):
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("timeseries", 0, StoreConfig(
+            max_chunk_size=50,
+            trace_part_key_substrings=("instance-1",)))
+        keys = machine_metrics_series(2)
+        with caplog.at_level(logging.INFO, logger="filodb_tpu.trace"):
+            for sd in gauge_stream(keys, 5):
+                shard.ingest(sd)
+        assert any("TRACE" in r.message for r in caplog.records)
+        traced = [r for r in caplog.records if "instance-1" in r.getMessage()]
+        assert len(traced) == 5
+
+    def test_corrupt_vector_error(self):
+        good = Chunk(1, 2, 0, 1000, (b"\x01garbage-not-a-vector", b"\xff"))
+        with pytest.raises(CorruptVectorError, match="corrupt vector"):
+            good.decode_column(1)
+
+    def test_single_writer_assert(self):
+        import threading
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("timeseries", 0,
+                         StoreConfig(assert_single_writer=True))
+        keys = machine_metrics_series(1)
+        stream = list(gauge_stream(keys, 2, batch=1))
+        shard.ingest(stream[0])
+        errs = []
+
+        def other():
+            try:
+                shard.ingest(stream[1])
+            except AssertionError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert errs
+
+
+def _shard_of(cs, key):
+    for s in range(2):
+        if any(r.part_key == key for r in cs.scan_part_keys("timeseries", s)):
+            return s
+    raise AssertionError("key not found")
