@@ -60,24 +60,6 @@ class Chunk:
         return out
 
 
-class CorruptVectorError(RuntimeError):
-    """A chunk vector failed to decode — data corruption tripwire.
-
-    The reference halts the process on corruption
-    (``Shutdown.haltAndCatchFire``, ``TimeSeriesShard.scala:349``); here the
-    error carries chunk forensics and the shard marks itself errored via the
-    standard error path (a Python process has no partially-written off-heap
-    state worth halting for)."""
-
-    def __init__(self, chunk: "Chunk", column: int, cause: Exception):
-        head = chunk.vectors[column][:16].hex() if chunk.vectors else ""
-        super().__init__(
-            f"corrupt vector: chunk id={chunk.id} rows={chunk.num_rows} "
-            f"range=[{chunk.start_time},{chunk.end_time}] column={column} "
-            f"head16={head} cause={cause!r}")
-        self.chunk_id = chunk.id
-        self.column = column
-
     def serialize(self) -> bytes:
         head = struct.pack("<qIqqI", self.id, self.num_rows, self.start_time,
                            self.end_time, len(self.vectors))
@@ -98,6 +80,25 @@ class CorruptVectorError(RuntimeError):
             vectors.append(data[off : off + ln])
             off += ln
         return Chunk(cid, rows, st, et, tuple(vectors))
+
+
+class CorruptVectorError(RuntimeError):
+    """A chunk vector failed to decode — data corruption tripwire.
+
+    The reference halts the process on corruption
+    (``Shutdown.haltAndCatchFire``, ``TimeSeriesShard.scala:349``); here the
+    error carries chunk forensics and the shard marks itself errored via the
+    standard error path (a Python process has no partially-written off-heap
+    state worth halting for)."""
+
+    def __init__(self, chunk: "Chunk", column: int, cause: Exception):
+        head = chunk.vectors[column][:16].hex() if chunk.vectors else ""
+        super().__init__(
+            f"corrupt vector: chunk id={chunk.id} rows={chunk.num_rows} "
+            f"range=[{chunk.start_time},{chunk.end_time}] column={column} "
+            f"head16={head} cause={cause!r}")
+        self.chunk_id = chunk.id
+        self.column = column
 
 
 def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0) -> Chunk:
