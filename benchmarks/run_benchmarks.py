@@ -1,0 +1,253 @@
+"""Benchmark suite: reproductions of the reference's JMH workloads.
+
+Counterpart of ``jmh/src/main/scala/filodb.jmh/`` (see SURVEY.md §6 /
+``run_benchmarks.sh``). Each benchmark prints one JSON line; run all with
+
+    python benchmarks/run_benchmarks.py [--only NAME] [--cpu]
+
+Workload definitions mirror the JMH classes:
+- ingestion        — ``IngestionBenchmark``: 100k samples through the shard
+  ingest path, samples/sec.
+- hist_ingest      — ``HistogramIngestBenchmark``: 30k first-class histograms.
+- query_inmemory   — ``QueryInMemoryBenchmark``: handled by ../bench.py.
+- query_hicard     — ``QueryHiCardInMemoryBenchmark``: 1 shard, 5k series.
+- query_and_ingest — ``QueryAndIngestBenchmark``: queries under concurrent
+  ingest.
+- hist_query       — ``HistogramQueryBenchmark``: histogram_quantile of rate.
+- partkey_index    — ``PartKeyIndexBenchmark``: index add + filter queries.
+- gateway          — ``GatewayBenchmark``: Influx line parse ops/sec.
+- encoding         — ``EncodingBenchmark``: vector encode/decode ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+
+
+def _force_cpu():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def bench_ingestion():
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    keys = machine_metrics_series(100)
+    stream = list(gauge_stream(keys, 1000, start_ms=START * 1000, batch=500))
+    t0 = time.perf_counter()
+    for sd in stream:
+        shard.ingest(sd)
+    dt = time.perf_counter() - t0
+    return {"metric": "ingestion_throughput", "value": round(100_000 / dt),
+            "unit": "samples/sec"}
+
+
+def bench_hist_ingest():
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import histogram_series, histogram_stream
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    keys = histogram_series(30)
+    stream = list(histogram_stream(keys, 1000, start_ms=START * 1000,
+                                   batch=500))
+    t0 = time.perf_counter()
+    for sd in stream:
+        shard.ingest(sd)
+    dt = time.perf_counter() - t0
+    return {"metric": "histogram_ingestion_throughput",
+            "value": round(30_000 / dt), "unit": "histograms/sec"}
+
+
+def bench_query_hicard():
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import counter_series, counter_stream
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    keys = counter_series(5000, metric="hicard_total")
+    for sd in counter_stream(keys, 60, start_ms=START * 1000, batch=5000):
+        shard.ingest(sd)
+    svc = QueryService(ms, "bench", 1, spread=0)
+    q = 'sum(rate(hicard_total[5m]))'
+    svc.query_range(q, START + 300, 60, START + 540)  # warm
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = svc.query_range(q, START + 300, 60, START + 540)
+    dt = time.perf_counter() - t0
+    return {"metric": "hicard_query_throughput", "value": round(n / dt, 2),
+            "unit": "queries/sec", "series": 5000}
+
+
+def bench_query_and_ingest():
+    import threading
+
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import counter_series, counter_stream
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    keys = counter_series(100, metric="qi_total")
+    for sd in counter_stream(keys, 720, start_ms=START * 1000):
+        shard.ingest(sd)
+    svc = QueryService(ms, "bench", 1, spread=0)
+    q = 'sum(rate(qi_total[5m]))'
+    svc.query_range(q, START + 3600, 60, START + 5400)
+    stop = threading.Event()
+
+    def ingester():
+        t = START + 7200
+        while not stop.is_set():
+            for sd in counter_stream(keys, 10, start_ms=t * 1000, batch=1000):
+                shard.ingest(sd)
+            t += 100
+
+    th = threading.Thread(target=ingester, daemon=True)
+    th.start()
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        svc.query_range(q, START + 3600, 60, START + 5400)
+    dt = time.perf_counter() - t0
+    stop.set()
+    th.join(1)
+    return {"metric": "query_under_ingest_throughput",
+            "value": round(n / dt, 2), "unit": "queries/sec"}
+
+
+def bench_hist_query():
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import histogram_series, histogram_stream
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    keys = histogram_series(20)
+    for sd in histogram_stream(keys, 720, start_ms=START * 1000, batch=2000):
+        shard.ingest(sd)
+    svc = QueryService(ms, "bench", 1, spread=0)
+    q = 'histogram_quantile(0.99, sum(rate(http_req_latency[5m])))'
+    svc.query_range(q, START + 3600, 60, START + 5400)
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        svc.query_range(q, START + 3600, 60, START + 5400)
+    dt = time.perf_counter() - t0
+    return {"metric": "histogram_query_throughput",
+            "value": round(n / dt, 2), "unit": "queries/sec"}
+
+
+def bench_partkey_index():
+    from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex
+    from filodb_tpu.core.memstore.index import PartKeyIndex
+    from filodb_tpu.core.partkey import PartKey
+
+    idx = PartKeyIndex()
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        key = PartKey.create("gauge", {
+            "_metric_": f"metric_{i % 100}", "_ws_": "demo",
+            "_ns_": f"App-{i % 16}", "instance": f"i{i}",
+            "host": f"h{i % 1000}"})
+        idx.add_part_key(i, key, i)
+    add_rate = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    m = 2000
+    for i in range(m):
+        idx.part_ids_from_filters(
+            [ColumnFilter("_metric_", Equals(f"metric_{i % 100}")),
+             ColumnFilter("_ns_", Equals(f"App-{i % 16}"))], 0, 2**62)
+    q_rate = m / (time.perf_counter() - t0)
+    return {"metric": "partkey_index", "add_per_sec": round(add_rate),
+            "equals_query_per_sec": round(q_rate), "unit": "ops/sec"}
+
+
+def bench_gateway():
+    from filodb_tpu.gateway.influx import parse_influx_line
+
+    lines = [f"cpu,host=h{i % 50},app=api,_ws_=demo,_ns_=App-0 "
+             f"value={i}.5 {(START + i) * 1_000_000_000}"
+             for i in range(5000)]
+    t0 = time.perf_counter()
+    for line in lines:
+        parse_influx_line(line)
+    dt = time.perf_counter() - t0
+    return {"metric": "gateway_influx_parse", "value": round(len(lines) / dt),
+            "unit": "lines/sec"}
+
+
+def bench_encoding():
+    from filodb_tpu.memory import codecs
+
+    rng = np.random.default_rng(0)
+    ts = (np.arange(10_000) * 10_000 + START * 1000
+          + rng.integers(-50, 50, 10_000)).astype(np.int64)
+    vals = rng.normal(100, 10, 10_000)
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        e1 = codecs.encode_delta_delta(ts)
+        e2 = codecs.encode_xor_double(vals)
+    enc_rate = n * 20_000 / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        codecs.decode_delta_delta(e1)
+        codecs.decode_xor_double(e2)
+    dec_rate = n * 20_000 / (time.perf_counter() - t0)
+    ratio = (len(e1) + len(e2)) / (ts.nbytes + vals.nbytes)
+    return {"metric": "encoding", "encode_samples_per_sec": round(enc_rate),
+            "decode_samples_per_sec": round(dec_rate),
+            "compression_ratio": round(ratio, 3), "unit": "samples/sec"}
+
+
+ALL = {
+    "ingestion": bench_ingestion,
+    "hist_ingest": bench_hist_ingest,
+    "query_hicard": bench_query_hicard,
+    "query_and_ingest": bench_query_and_ingest,
+    "hist_query": bench_hist_query,
+    "partkey_index": bench_partkey_index,
+    "gateway": bench_gateway,
+    "encoding": bench_encoding,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        _force_cpu()
+    for name, fn in ALL.items():
+        if args.only and name != args.only:
+            continue
+        out = fn()
+        out["benchmark"] = name
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
