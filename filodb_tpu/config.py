@@ -17,6 +17,7 @@ from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
 DEFAULTS = {
     "node_name": "node-0",
     "data_dir": "./filodb-data",
+    "wal_dir": None,
     "http_port": 8080,
     "gateway_port": 0,            # 0 = disabled
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
@@ -41,6 +42,7 @@ DEFAULTS = {
 class ServerConfig:
     node_name: str = "node-0"
     data_dir: str = "./filodb-data"
+    wal_dir: str | None = None  # shared log dir (the "Kafka"); default in data_dir
     http_port: int = 8080
     gateway_port: int = 0
     executor_port: int = 0
@@ -66,6 +68,7 @@ class ServerConfig:
             spreads[name] = d.get("spread", 1)
         return ServerConfig(
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],
+            wal_dir=cfg.get("wal_dir"),
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             datasets=datasets, spreads=spreads)

@@ -1,0 +1,153 @@
+"""Cluster bootstrap: seed discovery and remote-node membership.
+
+Counterpart of reference ``akka-bootstrapper``
+(``akka-bootstrapper/.../AkkaBootstrapper.scala:1-104``; strategies: explicit
+list, Consul, DNS SRV — ``DnsSrvClusterSeedDiscovery.scala``) plus the piece
+Akka gave the reference for free: remote membership. Discovery yields seed
+addresses; a joining server calls ``join`` on a seed's control port; the
+coordinator (the seed's ``FilodbCluster``) tracks the member as a
+``RemoteNodeHandle`` and drives its shard lifecycle over the same TCP channel
+used for plan shipping (start_shard / shard_status / ping).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+from filodb_tpu.coordinator.shardmapper import ShardStatus
+
+log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# seed discovery (reference: ClusterSeedDiscovery strategies)
+
+class SeedDiscovery:
+    def discover(self) -> list[tuple[str, int]]:
+        raise NotImplementedError
+
+
+@dataclass
+class ExplicitListDiscovery(SeedDiscovery):
+    """Reference ``ExplicitListClusterSeedDiscovery``: static seed list."""
+
+    seeds: list[str] = field(default_factory=list)  # "host:port"
+
+    def discover(self):
+        out = []
+        for s in self.seeds:
+            host, port = s.rsplit(":", 1)
+            out.append((host, int(port)))
+        return out
+
+
+@dataclass
+class FileDiscovery(SeedDiscovery):
+    """Shared-file membership registry (the single-host / shared-volume
+    analog of Consul registration)."""
+
+    path: str = ""
+
+    def discover(self):
+        import os
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    host, port = line.rsplit(":", 1)
+                    out.append((host, int(port)))
+        return out
+
+    def register(self, host: str, port: int) -> None:
+        with open(self.path, "a") as f:
+            f.write(f"{host}:{port}\n")
+
+
+@dataclass
+class DnsSrvDiscovery(SeedDiscovery):
+    """Reference ``DnsSrvClusterSeedDiscovery``: resolve SRV records.
+    (Uses best-effort socket resolution; environments without DNS SRV
+    support fall back to empty discovery.)"""
+
+    srv_name: str = ""
+
+    def discover(self):
+        try:
+            import dns.resolver  # noqa: F401  (not in the base image)
+        except ImportError:
+            log.warning("dnspython unavailable; DNS SRV discovery disabled")
+            return []
+        answers = dns.resolver.resolve(self.srv_name, "SRV")
+        return [(str(a.target).rstrip("."), a.port) for a in answers]
+
+
+# ---------------------------------------------------------------------------
+# remote membership
+
+class RemoteNodeHandle:
+    """A cluster member in another process, driven over its control port.
+    Duck-types the in-process ``Node`` API the cluster uses."""
+
+    def __init__(self, name: str, host: str, control_port: int):
+        self.name = name
+        self.host = host
+        self.executor_port = control_port
+        self._client = RemotePlanDispatcher(host, control_port)
+
+    @property
+    def alive(self) -> bool:
+        return self._client.ping()
+
+    def start_shard(self, dataset: str, shard: int, config=None,
+                    shard_log=None, on_status=None) -> None:
+        self._client.call("start_shard", dataset, shard)
+        if on_status:
+            # remote recovery progress is polled via shard_status
+            on_status(shard, ShardStatus.RECOVERY, 0)
+
+    def stop_shard(self, dataset: str, shard: int) -> None:
+        try:
+            self._client.call("stop_shard", dataset, shard)
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+    def shard_status(self, dataset: str) -> list[tuple[int, str]]:
+        return self._client.call("shard_status", dataset)
+
+    def owned_shards(self, dataset: str) -> list[int]:
+        try:
+            return [s for s, _ in self.shard_status(dataset)]
+        except (ConnectionError, OSError, RuntimeError):
+            return []
+
+    def kill(self) -> None:  # coordinator-side bookkeeping only
+        pass
+
+
+def poll_remote_statuses(cluster, dataset: str) -> None:
+    """Pull shard statuses from remote members into the shard manager
+    (stands in for the reference's status events over Akka)."""
+    sm = cluster.shard_managers.get(dataset)
+    if sm is None:
+        return
+    for name, node in list(cluster.nodes.items()):
+        if not isinstance(node, RemoteNodeHandle):
+            continue
+        try:
+            statuses = node.shard_status(dataset)
+        except (ConnectionError, OSError, RuntimeError):
+            continue
+        for shard, status in statuses:
+            if sm.mapper.node_for(shard) != name:
+                continue
+            if status == "active" and sm.mapper.statuses[shard] != \
+                    ShardStatus.ACTIVE:
+                sm.shard_active(shard, name)
+            elif status == "recovery" and sm.mapper.statuses[shard] == \
+                    ShardStatus.ASSIGNED:
+                sm.shard_recovery(shard, name, 0)

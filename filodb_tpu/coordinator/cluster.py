@@ -154,6 +154,7 @@ class FilodbCluster:
     configs: dict[str, IngestionConfig] = field(default_factory=dict)
     logs: dict[tuple[str, int], ReplayLog] = field(default_factory=dict)
     heartbeat_interval_s: float = 0.05
+    on_heartbeat: list = field(default_factory=list)  # callbacks per tick
     _hb_thread: threading.Thread | None = None
     _stop_hb: threading.Event = field(default_factory=threading.Event)
 
@@ -218,6 +219,11 @@ class FilodbCluster:
             for name in dead:
                 log.warning("failure detector: node %s down", name)
                 self.leave(name)
+            for cb in self.on_heartbeat:
+                try:
+                    cb()
+                except Exception:
+                    log.exception("heartbeat callback failed")
 
     def stop(self):
         self._stop_hb.set()
@@ -238,10 +244,11 @@ class FilodbCluster:
             if owner is None:
                 raise RuntimeError(f"shard {shard} unassigned")
             node = cluster.nodes[owner]
-            if node.executor_port is not None:
-                from filodb_tpu.coordinator.remote import RemotePlanDispatcher
-                return RemotePlanDispatcher("127.0.0.1", node.executor_port)
-            return NodeDispatcher(node)
+            if getattr(node, "memstore", None) is not None:
+                return NodeDispatcher(node)  # in-process member
+            from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+            return RemotePlanDispatcher(getattr(node, "host", "127.0.0.1"),
+                                        node.executor_port)
 
         # the facade's local memstore is only used for metadata fan-out;
         # use the first node's

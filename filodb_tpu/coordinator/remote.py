@@ -51,8 +51,12 @@ class PlanExecutorServer:
     """Executes shipped plan subtrees against the local memstore
     (the receive side of ``ActorPlanDispatcher``)."""
 
-    def __init__(self, memstore, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, memstore, host: str = "127.0.0.1", port: int = 0,
+                 extra_handlers: dict | None = None):
         self.memstore = memstore
+        # control-plane extensions: {kind: fn(*payload) -> response tuple}
+        # (join/start_shard/shard_status... registered by the server runtime)
+        self.extra_handlers = extra_handlers or {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -91,6 +95,13 @@ class PlanExecutorServer:
                 return ("ok", result)
             except Exception as e:
                 log.exception("plan execution failed")
+                return ("err", repr(e))
+        handler = self.extra_handlers.get(kind)
+        if handler is not None:
+            try:
+                return ("ok", handler(*msg[1:]))
+            except Exception as e:
+                log.exception("control message %s failed", kind)
                 return ("err", repr(e))
         return ("err", f"unknown message {kind!r}")
 
@@ -157,6 +168,21 @@ class RemotePlanDispatcher(PlanDispatcher):
         except (ConnectionError, OSError):
             self._drop_conn()
             return False
+
+    def call(self, kind: str, *payload):
+        """Send a control message; returns the handler's response payload."""
+        try:
+            sock = self._conn()
+            _send_msg(sock, (kind, *payload))
+            resp = _recv_msg(sock)
+        except (ConnectionError, OSError):
+            self._drop_conn()
+            raise
+        if resp[0] == "ok":
+            return resp[1]
+        if resp[0] == "pong":
+            return None
+        raise RuntimeError(f"control call {kind} failed: {resp[1]}")
 
     def __reduce__(self):
         # dispatchers travel inside shipped plans; reconnect lazily

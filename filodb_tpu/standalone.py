@@ -46,36 +46,99 @@ class FiloServer:
         self.gateway: GatewayServer | None = None
         self.executor: PlanExecutorServer | None = None
 
+    def _wal_path(self, dataset: str, shard: int) -> str:
+        root = self.config.wal_dir or os.path.join(self.config.data_dir,
+                                                   "wal")
+        return os.path.join(root, dataset, f"shard-{shard}.log")
+
+    def _shard_log(self, dataset: str, shard: int) -> FileLog:
+        key = (dataset, shard)
+        if key not in self.logs:
+            self.logs[key] = FileLog(self._wal_path(dataset, shard))
+        return self.logs[key]
+
+    # -- control handlers (member side; reference NodeCoordinatorActor) --
+
+    def _handle_start_shard(self, dataset: str, shard: int):
+        cfg = self.config.datasets[dataset]
+        self.node.start_shard(dataset, shard, cfg,
+                              self._shard_log(dataset, shard))
+        return True
+
+    def _handle_stop_shard(self, dataset: str, shard: int):
+        self.node.stop_shard(dataset, shard)
+        return True
+
+    def _handle_shard_status(self, dataset: str):
+        out = []
+        for (d, s), w in self.node._workers.items():
+            if d == dataset:
+                out.append((s, "active" if w.caught_up.is_set()
+                            else "recovery"))
+        return out
+
+    def _handle_join(self, name: str, host: str, control_port: int):
+        """Coordinator side: a remote member joined (reference
+        NodeClusterActor member-up)."""
+        from filodb_tpu.coordinator.bootstrap import RemoteNodeHandle
+        self.cluster.join(RemoteNodeHandle(name, host, control_port))
+        return True
+
     def start(self) -> "FiloServer":
         cfg = self.config
-        # plan-executor port (remote scatter-gather)
-        self.executor = PlanExecutorServer(self.memstore,
-                                           port=cfg.executor_port).start()
+        # control/executor port: plan shipping + shard lifecycle messages
+        self.executor = PlanExecutorServer(
+            self.memstore, port=cfg.executor_port,
+            extra_handlers={
+                "start_shard": self._handle_start_shard,
+                "stop_shard": self._handle_stop_shard,
+                "shard_status": self._handle_shard_status,
+                "join": self._handle_join,
+            }).start()
         self.node.executor_port = self.executor.port
-        self.cluster.join(self.node)
         services = {}
-        for name, ing_cfg in cfg.datasets.items():
-            logs = {}
-            for shard in range(ing_cfg.num_shards):
-                p = os.path.join(cfg.data_dir, "wal", name,
-                                 f"shard-{shard}.log")
-                logs[shard] = FileLog(p)
-                self.logs[(name, shard)] = logs[shard]
-            self.cluster.setup_dataset(ing_cfg, logs)
-            services[name] = self.cluster.query_service(
-                name, cfg.spreads.get(name, 1))
-        self.cluster.start_failure_detector()
+        if cfg.seeds:
+            # member role: register with the coordinator; shard assignments
+            # arrive as start_shard control messages
+            from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+            joined = False
+            for seed in cfg.seeds:
+                host, port = seed.rsplit(":", 1)
+                try:
+                    RemotePlanDispatcher(host, int(port)).call(
+                        "join", cfg.node_name, "127.0.0.1",
+                        self.executor.port)
+                    joined = True
+                    break
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    log.warning("seed %s unreachable: %s", seed, e)
+            if not joined:
+                raise RuntimeError("could not join any seed")
+        else:
+            # coordinator role: own the cluster singleton
+            self.cluster.join(self.node)
+            from filodb_tpu.coordinator.bootstrap import poll_remote_statuses
+            for name, ing_cfg in cfg.datasets.items():
+                logs = {s: self._shard_log(name, s)
+                        for s in range(ing_cfg.num_shards)}
+                self.cluster.setup_dataset(ing_cfg, logs)
+                services[name] = self.cluster.query_service(
+                    name, cfg.spreads.get(name, 1))
+                self.cluster.on_heartbeat.append(
+                    lambda n=name: poll_remote_statuses(self.cluster, n))
+            self.cluster.start_failure_detector()
         self.http = FiloHttpServer(services, port=cfg.http_port,
-                                   cluster=self.cluster).start()
+                                   cluster=self.cluster
+                                   if not cfg.seeds else None).start()
         if cfg.gateway_port:
             first = next(iter(cfg.datasets.values()))
             sink = ContainerSink(
-                {s: self.logs[(first.dataset, s)]
+                {s: self._shard_log(first.dataset, s)
                  for s in range(first.num_shards)},
                 first.num_shards, cfg.spreads.get(first.dataset, 1))
             self.gateway = GatewayServer(sink, port=cfg.gateway_port).start()
-        log.info("FiloServer up: http=%d executor=%d", self.http.port,
-                 self.executor.port)
+        log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
+                 self.executor.port, "member" if cfg.seeds else "coordinator")
         return self
 
     def shutdown(self):
