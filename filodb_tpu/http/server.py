@@ -81,15 +81,17 @@ def _make_handler(server: FiloHttpServer):
             try:
                 url = urlparse(self.path)
                 qs = parse_qs(url.query)
+                parts = [p for p in url.path.split("/") if p]
                 if self.command == "POST":
                     ln = int(self.headers.get("Content-Length") or 0)
-                    if ln:
-                        body = self.rfile.read(ln).decode()
+                    raw = self.rfile.read(ln) if ln else b""
+                    if parts[-1:] == ["read"]:
+                        return self._remote_read(parts, raw)
+                    if raw:
                         ctype = self.headers.get("Content-Type", "")
                         if "x-www-form-urlencoded" in ctype:
-                            for k, v in parse_qs(body).items():
+                            for k, v in parse_qs(raw.decode()).items():
                                 qs.setdefault(k, v)
-                parts = [p for p in url.path.split("/") if p]
                 self._dispatch(parts, qs)
             except (ParseError, ValueError) as e:
                 self._send(400, promjson.error_json(str(e)))
@@ -159,6 +161,49 @@ def _make_handler(server: FiloHttpServer):
                                                  unquote(rest[1]))
                 return self._send(200, {"status": "success", "data": vals})
             self._send(404, promjson.error_json("unknown endpoint"))
+
+        def _remote_read(self, parts: list[str], body: bytes):
+            """Prometheus remote-read (protobuf; reference remote-storage
+            protocol endpoint in PrometheusApiRoute)."""
+            from filodb_tpu.http import remote_read as rr
+            if len(parts) < 2 or parts[0] != "promql":
+                return self._send(404, promjson.error_json("not found"))
+            svc = server.services.get(parts[1])
+            if svc is None:
+                return self._send(404, promjson.error_json(
+                    f"unknown dataset {parts[1]}"))
+            data = rr.maybe_decompress(body)
+            try:
+                queries = rr.decode_read_request(data)
+            except Exception:
+                return self._send(501 if not rr.HAVE_SNAPPY else 400,
+                                  promjson.error_json(
+                                      "could not decode read request "
+                                      "(snappy unavailable?)"))
+            results = []
+            for q in queries:
+                series = []
+                for shard in svc.memstore.shards_for(svc.dataset):
+                    for pid in shard.lookup_partitions(
+                            q["filters"], q["start_ms"], q["end_ms"]):
+                        part = shard.partition(pid)
+                        if part is None:
+                            continue
+                        ts, vals = part.read_samples(q["start_ms"],
+                                                     q["end_ms"])
+                        import numpy as _np
+                        if len(ts) and not isinstance(vals, _np.ndarray):
+                            continue  # histograms not in remote-read v1
+                        series.append((list(part.part_key.labels), ts, vals))
+                results.append(series)
+            payload = rr.maybe_compress(rr.encode_read_response(results))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-protobuf")
+            self.send_header("Content-Encoding",
+                             "snappy" if rr.HAVE_SNAPPY else "identity")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
 
         # -- cluster admin --
 

@@ -109,3 +109,56 @@ class TestAdminApi:
         with urllib.request.urlopen(url) as r:
             text = r.read().decode()
         assert "rows_ingested_total" in text
+
+
+class TestRemoteRead:
+    def test_round_trip(self, server):
+        from filodb_tpu.http import remote_read as rr
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.core.partkey import METRIC_LABEL
+
+        # build a ReadRequest: Query(start, end, matcher __name__ EQ ...)
+        matcher = (rr._ld(2, b"__name__")
+                   + rr._ld(3, b"http_requests_total"))
+        query = (rr._key(1, 0) + rr._varint(START * 1000)
+                 + rr._key(2, 0) + rr._varint((START + 4000) * 1000)
+                 + rr._ld(3, matcher))
+        req = rr._ld(1, query)
+
+        import urllib.request
+        u = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/promql/timeseries/api/v1/read",
+            data=rr.maybe_compress(req), method="POST")
+        with urllib.request.urlopen(u) as resp:
+            payload = rr.maybe_decompress(resp.read())
+        # decode response: 1 QueryResult with 5 TimeSeries x 400 samples
+        n_series = 0
+        n_samples = 0
+        for field, _, qr in rr._iter_fields(payload):
+            assert field == 1
+            for f2, _, ts_msg in rr._iter_fields(qr):
+                n_series += 1
+                labels = {}
+                for f3, _, v in rr._iter_fields(ts_msg):
+                    if f3 == 1:
+                        kv = dict()
+                        for f4, _, x in rr._iter_fields(v):
+                            kv[f4] = x.decode()
+                        labels[kv[1]] = kv[2]
+                    elif f3 == 2:
+                        n_samples += 1
+                assert labels["__name__"] == "http_requests_total"
+        assert n_series == 5
+        assert n_samples == 5 * 400
+
+    def test_request_decode(self):
+        from filodb_tpu.http import remote_read as rr
+        from filodb_tpu.core.filters import EqualsRegex
+        matcher = (rr._key(1, 0) + rr._varint(2)
+                   + rr._ld(2, b"job") + rr._ld(3, b"api.*"))
+        query = (rr._key(1, 0) + rr._varint(1000)
+                 + rr._key(2, 0) + rr._varint(2000) + rr._ld(3, matcher))
+        out = rr.decode_read_request(rr._ld(1, query))
+        assert out[0]["start_ms"] == 1000 and out[0]["end_ms"] == 2000
+        f = out[0]["filters"][0]
+        assert f.column == "job" and isinstance(f.filter, EqualsRegex)
