@@ -499,6 +499,12 @@ class Parser:
                         "timestamp", (), sel.offset)
                 raise ParseError(f"{name} needs a range-vector argument")
             fn_params = tuple(scalars_front + scalars_back)
+            required = {"quantile_over_time": 1, "holt_winters": 2,
+                        "predict_linear": 1}.get(name, 0)
+            if len(fn_params) != required:
+                raise ParseError(
+                    f"{name} expects {required} scalar parameter(s), "
+                    f"got {len(fn_params)}")
             if isinstance(range_arg, _Subquery):
                 sub_step = range_arg.step or p.step_ms or 60_000
                 return lp.SubqueryWithWindowing(
