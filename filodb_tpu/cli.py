@@ -142,6 +142,27 @@ def cmd_promql(args):
     print(json.dumps(matrix_json(r), indent=2))
 
 
+def cmd_topkcard(args):
+    """Top-k cardinality under a shard-key prefix (reference ``topkcard``):
+    counts persisted part keys grouped by the next shard-key level."""
+    from collections import Counter
+
+    cs, _, _ = _open_stores(args.data_dir)
+    prefix = [p for p in (args.prefix or "").split("/") if p]
+    labels = ("_ws_", "_ns_", "_metric_")
+    counts = Counter()
+    for shard in range(args.num_shards):
+        for rec in cs.scan_part_keys(args.dataset, shard):
+            lm = rec.part_key.label_map
+            path = [lm.get(k, "") for k in labels]
+            if path[: len(prefix)] == prefix:
+                child = (path[len(prefix)] if len(prefix) < len(path)
+                         else path[-1])
+                counts[child] += 1
+    for name, n in counts.most_common(args.k):
+        print(f"{name}\tseries={n}")
+
+
 def cmd_decode_chunk(args):
     """Debug: decode and dump a partition's chunk info + samples (reference
     ``decodeChunkInfo`` / ``decodeVector`` commands)."""
@@ -197,12 +218,16 @@ def main(argv=None):
     p.add_argument("--filter", default=None)
     p.add_argument("--limit", type=int, default=5)
     p.add_argument("--verbose", action="store_true")
+    p = sub.add_parser("topkcard")
+    p.add_argument("--prefix", default="", help="ws or ws/ns")
+    p.add_argument("-k", type=int, default=10)
 
     args = ap.parse_args(argv)
     {"init": cmd_init, "list": cmd_list, "status": cmd_status,
      "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
      "importcsv": cmd_importcsv, "promql": cmd_promql,
-     "decodechunks": cmd_decode_chunk}[args.command](args)
+     "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,
+     }[args.command](args)
 
 
 if __name__ == "__main__":

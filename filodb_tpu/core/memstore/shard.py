@@ -79,6 +79,9 @@ class TimeSeriesShard:
         # cardinality metering + quotas (reference ratelimit/)
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
         self.cardinality = CardinalityTracker(shard_num)
+        # optional streaming downsampler invoked at flush (reference
+        # ShardDownsampler publishing to the downsample dataset)
+        self.downsampler = None
         # on-demand paging cache (reference OnDemandPagingShard)
         from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
         self.odp_cache = DemandPagedChunkCache()
@@ -194,6 +197,8 @@ class TimeSeriesShard:
                     ingestion_time)
                 part.mark_flushed(max(c.id for c in chunks))
                 written += len(chunks)
+                if self.downsampler is not None:
+                    self.downsampler.on_flush(part, chunks)
             if part.part_id in self._dirty_part_keys:
                 dirty_pks.append(PartKeyRecord(
                     part.part_key, self.index.start_time(part.part_id),

@@ -164,3 +164,23 @@ class TestLongTimeRangePlanner:
         # values exist on both sides of the boundary
         assert np.isfinite(r.values[:, 0]).any()
         assert np.isfinite(r.values[:, -1]).any()
+
+
+class TestStreamingDownsampler:
+    def test_on_flush_publishes(self):
+        from filodb_tpu.core.downsample.downsampler import ShardDownsampler
+        ms = TimeSeriesMemStore(InMemoryColumnStore(), InMemoryMetaStore())
+        shard = ms.setup("timeseries", 0, StoreConfig(max_chunk_size=60,
+                                                      groups_per_shard=2))
+        published = []
+        shard.downsampler = ShardDownsampler(
+            resolutions_ms=(RES,),
+            publish=lambda res, cont: published.append((res, len(cont))))
+        keys = machine_metrics_series(3)
+        for sd in gauge_stream(keys, 120, start_ms=START * 1000):
+            shard.ingest(sd)
+        shard.flush_all(ingestion_time=1)
+        assert published
+        total = sum(n for _, n in published)
+        # 120 samples @10s = 20min → 5 periods per series (fencepost)
+        assert total >= 3 * 4

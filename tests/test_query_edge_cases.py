@@ -1,0 +1,158 @@
+"""Query-engine edge cases: NaN/staleness, sparse series, chunk boundaries,
+offsets, instant queries, multi-schema stores.
+
+Mirrors the reference's edge-case coverage in
+``query/src/test/scala/filodb/query/exec`` specs (NaN handling, chunk
+boundary windows, counter correction across chunks).
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
+from filodb_tpu.core.store.config import StoreConfig
+
+START = 1_600_000_000
+
+
+def mk_store(max_chunk=50):
+    ms = TimeSeriesMemStore()
+    ms.setup("timeseries", 0, StoreConfig(max_chunk_size=max_chunk))
+    return ms
+
+
+def ingest(ms, key, samples):
+    c = RecordContainer()
+    for ts, v in samples:
+        c.add(IngestRecord(key, ts, (v,)))
+    ms.ingest("timeseries", 0, SomeData(c, 0))
+
+
+def gauge_key(metric="m", **labels):
+    return PartKey.create("gauge", {"_metric_": metric, "_ws_": "w",
+                                    "_ns_": "n", **labels})
+
+
+class TestNaNStaleness:
+    def test_nan_samples_are_gaps(self):
+        ms = mk_store()
+        key = gauge_key()
+        samples = [((START + i * 10) * 1000,
+                    np.nan if 20 <= i < 40 else float(i))
+                   for i in range(60)]
+        ingest(ms, key, samples)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        # count_over_time excludes NaN (stale) samples
+        r = svc.query_range("count_over_time(m[10m])", START + 595, 60,
+                            START + 595).result
+        assert r.values[0, 0] == 40.0  # 60 - 20 NaN
+        # instant selector: during the NaN gap the last valid sample (i=19)
+        # is still within 5m staleness at i=25
+        r2 = svc.query_range("m", START + 250, 60, START + 250).result
+        assert r2.values[0, 0] == 19.0
+
+    def test_fully_nan_series_dropped(self):
+        ms = mk_store()
+        ingest(ms, gauge_key("allnan"),
+               [((START + i * 10) * 1000, np.nan) for i in range(10)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("allnan", START, 60, START + 100).result
+        assert r.compact().num_series == 0
+
+
+class TestChunkBoundaries:
+    def test_window_spanning_many_chunks(self):
+        # chunk size 50 → 8 chunks; window covers all of them
+        ms = mk_store(max_chunk=50)
+        key = gauge_key()
+        ingest(ms, key, [((START + i * 10) * 1000, float(i))
+                         for i in range(400)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("sum_over_time(m[2h])", START + 3995, 60,
+                            START + 3995).result
+        np.testing.assert_allclose(r.values[0, 0], sum(range(400)))
+
+    def test_counter_reset_at_chunk_boundary(self):
+        ms = mk_store(max_chunk=50)
+        key = PartKey.create("prom-counter", {"_metric_": "c", "_ws_": "w",
+                                              "_ns_": "n"})
+        vals = list(np.arange(50) * 10.0) + list(np.arange(50) * 7.0)
+        c = RecordContainer()
+        for i, v in enumerate(vals):
+            c.add(IngestRecord(key, (START + i * 10) * 1000, (v,)))
+        ms.ingest("timeseries", 0, SomeData(c, 0))
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("increase(c[10m])", START + 895, 60,
+                            START + 895).result
+        # window (295, 895]: samples i=30..89; reset at i=50 (490 -> 0)
+        # corrected increase = (490 - 300) + (39*7 - 0)
+        expect_raw = (490.0 - 300.0) + 39 * 7.0
+        # extrapolation scales it; just sanity-bound the result
+        assert expect_raw * 0.9 < r.values[0, 0] < expect_raw * 1.15
+
+    def test_sparse_vs_dense_batching(self):
+        # series with very different sample counts batch correctly
+        ms = mk_store()
+        ingest(ms, gauge_key(instance="dense"),
+               [((START + i * 10) * 1000, 1.0) for i in range(300)])
+        ingest(ms, gauge_key(instance="sparse"),
+               [((START + i * 600) * 1000, 2.0) for i in range(5)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("sum_over_time(m[50m])", START + 2995, 60,
+                            START + 2995).result
+        by_inst = {k.label_map["instance"]: r.values[i, 0]
+                   for i, k in enumerate(r.keys)}
+        assert by_inst["dense"] == 300.0
+        assert by_inst["sparse"] == 2.0 * 5
+
+
+class TestOffsets:
+    def test_offset_shifts_data(self):
+        ms = mk_store()
+        ingest(ms, gauge_key(), [((START + i * 10) * 1000, float(i))
+                                 for i in range(200)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r_now = svc.query_range("max_over_time(m[5m])", START + 1995, 60,
+                                START + 1995).result
+        r_off = svc.query_range("max_over_time(m[5m] offset 10m)",
+                                START + 2595, 60, START + 2595).result
+        np.testing.assert_allclose(r_off.values, r_now.values)
+
+    def test_offset_instant_selector(self):
+        ms = mk_store()
+        ingest(ms, gauge_key(), [((START + i * 10) * 1000, float(i))
+                                 for i in range(100)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("m offset 5m", START + 800, 60, START + 800)
+        assert r.result.values[0, 0] == 50.0  # sample at +500s
+
+
+class TestMultiSchema:
+    def test_gauge_and_counter_same_query(self):
+        ms = mk_store()
+        ingest(ms, gauge_key("shared_name"),
+               [((START + i * 10) * 1000, 5.0) for i in range(50)])
+        ckey = PartKey.create("prom-counter",
+                              {"_metric_": "shared_name", "_ws_": "w",
+                               "_ns_": "n", "kind": "counter"})
+        c = RecordContainer()
+        for i in range(50):
+            c.add(IngestRecord(ckey, (START + i * 10) * 1000, (float(i),)))
+        ms.ingest("timeseries", 0, SomeData(c, 0))
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("shared_name", START + 400, 60, START + 400)
+        assert r.result.num_series == 2  # both schemas matched
+
+
+class TestInstantQuery:
+    def test_instant_vector(self):
+        ms = mk_store()
+        ingest(ms, gauge_key(), [((START + i * 10) * 1000, float(i))
+                                 for i in range(100)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_instant("sum(m)", START + 500)
+        assert r.result.num_steps == 1
+        assert r.result.values[0, 0] == 50.0

@@ -144,3 +144,19 @@ def _get(port, path, **params):
     url = f"http://127.0.0.1:{port}{path}" + (f"?{qs}" if qs else "")
     with urllib.request.urlopen(url) as r:
         return r.status, json.loads(r.read())
+
+
+class TestTopkCard:
+    def test_topkcard(self, tmp_path, capsys):
+        csv_path = tmp_path / "d.csv"
+        csv_path.write_text("\n".join(
+            f"{(START + i * 10) * 1000},{i},host=h{i % 3},_ws_=demo,_ns_=App-0"
+            for i in range(30)))
+        data_dir = str(tmp_path / "cd")
+        cli_main(["--data-dir", data_dir, "--num-shards", "2", "importcsv",
+                  str(csv_path), "--metric", "card_metric"])
+        capsys.readouterr()
+        cli_main(["--data-dir", data_dir, "--num-shards", "2", "topkcard",
+                  "--prefix", "demo"])
+        out = capsys.readouterr().out
+        assert "App-0" in out and "series=3" in out
