@@ -57,14 +57,18 @@ class SingleClusterPlanner(QueryPlanner):
 
     # ---- shard selection ------------------------------------------------
 
-    def shards_for_filters(self, filters) -> list[int]:
+    def shards_for_filters(self, filters, spread: int | None = None
+                           ) -> list[int]:
         """Prune fan-out using shard-key equality filters
-        (reference ``SingleClusterPlanner.shardsFromFilters``)."""
+        (reference ``SingleClusterPlanner.shardsFromFilters``); per-query
+        spread overrides take precedence (reference QueryActor spread
+        overrides)."""
+        spread = self.spread if spread is None else spread
         eq = {f.column: f.filter.value for f in filters
               if isinstance(f.filter, Equals)}
         if all(lbl in eq for lbl in self.shard_key_labels):
             skh = shard_key_hash({k: eq[k] for k in self.shard_key_labels})
-            return shards_for_shard_key(skh, self.num_shards, self.spread)
+            return shards_for_shard_key(skh, self.num_shards, spread)
         return list(range(self.num_shards))
 
     def _dispatcher(self, shard: int) -> PlanDispatcher | None:
@@ -91,7 +95,8 @@ class SingleClusterPlanner(QueryPlanner):
         chunk_start = raw.range_start - raw.lookback - raw.offset
         chunk_end = raw.range_end - raw.offset
         plans: list[ExecPlan] = []
-        for shard in self.shards_for_filters(raw.filters):
+        spread = q.planner_params.spread if q is not None else None
+        for shard in self.shards_for_filters(raw.filters, spread):
             leaf = SelectRawPartitionsExec(
                 shard=shard, filters=raw.filters, chunk_start=chunk_start,
                 chunk_end=chunk_end, value_column=raw.column,

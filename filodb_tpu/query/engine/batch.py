@@ -54,6 +54,18 @@ class SeriesBatch:
     def is_histogram(self) -> bool:
         return self.vals.ndim == 3
 
+    def device_arrays(self):
+        """(ts, vals, counts) as device arrays, uploaded once per batch —
+        cached batches keep data resident on the TPU across queries."""
+        dev = getattr(self, "_device", None)
+        if dev is None:
+            import jax.numpy as jnp
+
+            dev = (jnp.asarray(self.ts), jnp.asarray(self.vals),
+                   jnp.asarray(self.counts))
+            self._device = dev
+        return dev
+
 
 def build_batch(partitions: list[TimeSeriesPartition], start: int, end: int,
                 value_col: int | None = None, pad_series: bool = True,

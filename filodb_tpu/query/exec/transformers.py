@@ -63,15 +63,13 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         rel_steps = (eval_steps - batch.base_ts).astype(np.int32)
         fn = self.function or "last_sample"
         window = self.window if self.function else 300_000  # staleness lookback
-        ts_j = jnp.asarray(batch.ts)
-        counts_j = jnp.asarray(batch.counts)
+        ts_j, vals_j, counts_j = batch.device_arrays()
         steps_j = jnp.asarray(rel_steps)
         win_j = jnp.asarray(np.int32(window))
 
         if batch.is_histogram:
             # apply the range function per bucket: vmap over B
             import jax
-            vals_j = jnp.asarray(batch.vals)  # [P, S, B]
 
             def per_bucket(vb):
                 return kernels.range_eval(fn, ts_j, vb, counts_j, steps_j,
@@ -82,7 +80,6 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
             m = StepMatrix(self._out_keys(keys), out, steps, batch.les)
             return m
 
-        vals_j = jnp.asarray(batch.vals)
         if fn == "quantile_over_time":
             out = kernels.quantile_over_time(self.params[0], ts_j, vals_j,
                                              counts_j, steps_j, win_j)

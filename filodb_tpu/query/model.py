@@ -138,7 +138,9 @@ class QueryResult:
 class PlannerParams:
     """Reference ``PlannerParams`` (spread, sample limits...)."""
 
-    spread: int = 1
+    # per-query spread override (reference QueryActor spread overrides,
+    # ``QueryActor.scala:56-70``); None = planner default
+    spread: "int | None" = None
     sample_limit: int = 1_000_000
     enforce_sample_limit: bool = True
     shard_overrides: list[int] | None = None
