@@ -225,6 +225,13 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
     args: tuple = ()
 
     def apply(self, data: StepMatrix) -> StepMatrix:
+        if self.function == "hist_to_prom_vectors":
+            # first-class histogram → le-labelled bucket series (reference
+            # HistToPromSeriesMapper)
+            if not data.is_histogram:
+                return data
+            from filodb_tpu.http.promjson import _flatten_histograms
+            return _flatten_histograms(data)
         if self.function in ("histogram_quantile", "histogram_max_quantile"):
             q = float(self.args[0])
             if data.is_histogram:

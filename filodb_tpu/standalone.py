@@ -137,6 +137,11 @@ class FiloServer:
                  for s in range(first.num_shards)},
                 first.num_shards, cfg.spreads.get(first.dataset, 1))
             self.gateway = GatewayServer(sink, port=cfg.gateway_port).start()
+        if os.environ.get("FILODB_PROFILER"):
+            # built-in sampling profiler (reference SimpleProfiler started
+            # from FiloServer.start)
+            from filodb_tpu.utils.profiler import SimpleProfiler
+            self.profiler = SimpleProfiler().start()
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
                  self.executor.port, "member" if cfg.seeds else "coordinator")
         return self

@@ -130,3 +130,55 @@ def _shard_of(cs, key):
         if any(r.part_key == key for r in cs.scan_part_keys("timeseries", s)):
             return s
     raise AssertionError("key not found")
+
+
+class TestProfilerAndSources:
+    def test_simple_profiler_samples(self):
+        import time
+        from filodb_tpu.utils.profiler import SimpleProfiler
+
+        prof = SimpleProfiler(sample_interval_s=0.002).start()
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < 0.15:
+            x += sum(range(1000))
+        report = prof.stop()
+        assert report  # captured at least one hot frame
+
+    def test_csv_stream_source(self, tmp_path):
+        from filodb_tpu.coordinator.sources import csv_stream
+
+        p = tmp_path / "x.csv"
+        p.write_text("\n".join(f"{1000 + i},{i}.5,host=h{i % 2}"
+                               for i in range(25)))
+        out = list(csv_stream(str(p), "csv_metric", batch=10))
+        assert len(out) == 3
+        total = sum(len(sd.container) for sd in out)
+        assert total == 25
+        rec = out[0].container.records[0]
+        assert rec.part_key.metric == "csv_metric"
+
+    def test_influx_file_stream(self, tmp_path):
+        from filodb_tpu.coordinator.sources import influx_file_stream
+
+        p = tmp_path / "x.influx"
+        p.write_text("\n".join(
+            f"m,host=h value={i} {(1000 + i) * 1_000_000}"
+            for i in range(5)))
+        out = list(influx_file_stream(str(p)))
+        assert sum(len(sd.container) for sd in out) == 5
+
+    def test_hist_to_prom_vectors(self):
+        import numpy as np
+        from filodb_tpu.query.exec.transformers import (
+            InstantVectorFunctionMapper,
+        )
+        from filodb_tpu.query.model import RangeVectorKey, StepMatrix
+
+        m = StepMatrix([RangeVectorKey.of({"app": "a"})],
+                       np.arange(6, dtype=float).reshape(1, 2, 3),
+                       np.array([0, 1000]), les=np.array([1.0, 2.0, np.inf]))
+        out = InstantVectorFunctionMapper("hist_to_prom_vectors").apply(m)
+        assert out.num_series == 3
+        les = sorted(k.label_map["le"] for k in out.keys)
+        assert "+Inf" in les
