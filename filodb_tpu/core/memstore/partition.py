@@ -38,10 +38,12 @@ class TimeSeriesPartition:
     """One time series: label key + chunks + active write buffer."""
 
     __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "chunks",
-                 "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard")
+                 "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard",
+                 "device_pages")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: Schema,
-                 max_chunk_size: int = 400, shard: int = 0):
+                 max_chunk_size: int = 400, shard: int = 0,
+                 device_pages: bool = False):
         self.part_id = part_id
         self.part_key = part_key
         self.schema = schema
@@ -52,6 +54,8 @@ class TimeSeriesPartition:
         self._chunk_seq = 0
         self._flushed_id = -1  # highest chunk id already persisted
         self.bucket_les: np.ndarray | None = None
+        # encode device pages at chunk-seal time (decode-on-TPU query path)
+        self.device_pages = device_pages
 
     def _new_buffers(self) -> _Buffers:
         cols = []
@@ -141,6 +145,14 @@ class TimeSeriesPartition:
             else:
                 cols.append(data[: b.n])
         chunk = encode_chunk(self.schema, b.ts[: b.n], cols, self._chunk_seq)
+        if self.device_pages:
+            # ingest-time device-page encoding (no decode round trip)
+            from filodb_tpu.query.engine.device_batch import attach_pages
+            float_cols = {
+                ci + 1: np.asarray(b.cols[ci][: b.n], np.float64)
+                for ci, col in enumerate(self.schema.data.columns[1:])
+                if col.ctype == ColumnType.DOUBLE}
+            attach_pages(chunk, b.ts[: b.n].copy(), float_cols)
         self._chunk_seq = (self._chunk_seq + 1) & 0xFFF
         self.chunks.append(chunk)
         self._buf = self._new_buffers()

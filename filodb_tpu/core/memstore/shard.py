@@ -119,7 +119,7 @@ class TimeSeriesShard:
             if any(s in kstr for s in self.config.trace_part_key_substrings):
                 cls = TracingTimeSeriesPartition
         part = cls(pid, key, schema, self.config.max_chunk_size,
-                   self.shard_num)
+                   self.shard_num, device_pages=self.config.device_pages)
         self.partitions.append(part)
         self._by_key[key] = pid
         self.index.add_part_key(pid, key, first_ts)

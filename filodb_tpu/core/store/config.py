@@ -28,6 +28,8 @@ class StoreConfig:
     trace_part_key_substrings: tuple[str, ...] = ()
     # single-writer discipline check (reference FiloSchedulers.assertThreadName)
     assert_single_writer: bool = False
+    # encode device pages at ingest and run the decode-on-device query path
+    device_pages: bool = False
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,184 @@
+"""Device-decoded series batches: compressed pages in, tensors never leave
+the TPU.
+
+The host ships bit-packed device pages (``memory/device_pages.py``) instead
+of decoded samples; decode (shifts/masks + slope reconstruction) runs
+on-device and feeds the mask-aware kernels directly. This is the north-star
+data path: PCIe/ICI carries compressed pages, HBM holds the decoded tensors
+only transiently inside the fused program.
+
+Layout: per series, chunks contribute whole 128-sample blocks; the last
+block of each chunk is partially filled, so the assembled [P, NB*128] layout
+has interior gaps — handled by ``range_eval_masked`` (gap positions carry
+the previous real timestamp via an in-kernel running max, preserving
+sortedness for the binary search).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from filodb_tpu.memory.device_pages import (
+    BLOCK,
+    WORDS_PER_BLOCK_MAX,
+    DevicePage,
+    encode_f32_page,
+    encode_ts_page,
+)
+
+TS_GAP_MIN = -(2**31) + 2
+
+
+@dataclass
+class DeviceSeriesBatch:
+    """Masked batch whose ts/vals/valid live on device."""
+
+    base_ts: int
+    ts_dev: object       # int32 [P, S]
+    vals_dev: object     # f32 [P, S]
+    valid_dev: object    # bool [P, S]
+    counts: np.ndarray   # int32 [P] total valid (host stats)
+    part_ids: list[int]
+    les = None
+    masked = True
+    is_histogram = False
+
+    @property
+    def num_series(self) -> int:
+        return len(self.part_ids)
+
+    def device_arrays(self):
+        return self.ts_dev, self.vals_dev, self.valid_dev
+
+
+def chunk_device_pages(chunk, schema, value_col: int):
+    """Device pages for (ts, value column) of a chunk, memoized on the chunk
+    (encoded from decoded arrays on first use; ingest-time encoding attaches
+    them up front via ``attach_pages``)."""
+    cache = chunk.__dict__.get("_dev_pages")
+    if cache is None:
+        object.__setattr__(chunk, "_dev_pages", {})
+        cache = chunk.__dict__["_dev_pages"]
+    pages = cache.get(value_col)
+    if pages is None:
+        ts = chunk.decode_column(0)
+        vals = np.asarray(chunk.decode_column(value_col), np.float64)
+        pages = cache[value_col] = (encode_ts_page(ts),
+                                    encode_f32_page(vals))
+    return pages
+
+
+def attach_pages(chunk, ts: np.ndarray, cols: dict[int, np.ndarray]) -> None:
+    """Ingest-time page encoding (no decode round trip)."""
+    object.__setattr__(chunk, "_dev_pages", {
+        col: (encode_ts_page(ts), encode_f32_page(v))
+        for col, v in cols.items()})
+
+
+@partial(jax.jit, static_argnames=())
+def _assemble(rel_bases, ts_slopes, ts_widths, ts_words,
+              v_firsts, v_shifts, v_widths, v_words, blk_counts,
+              range_len):
+    """[P, NB, ...] page arrays → masked (ts, vals, valid) [P, NB*BLOCK]."""
+    from filodb_tpu.memory.device_pages import (
+        _unpack_block_jax,
+    )
+
+    P, NB = rel_bases.shape
+
+    def decode_series(rb, sl, tw, twd, vf, vs, vw, vwd, bc):
+        def one_block(rb_b, sl_b, tw_b, twd_b, vf_b, vs_b, vw_b, vwd_b, bc_b):
+            zz = _unpack_block_jax(twd_b, tw_b)
+            resid = (zz >> 1).astype(jnp.int32) ^ -(zz & 1).astype(jnp.int32)
+            lane = jnp.arange(BLOCK, dtype=jnp.int32)
+            ts = rb_b + sl_b * lane + resid
+            x = _unpack_block_jax(vwd_b, vw_b)
+            xored = jnp.where(vs_b >= 32, jnp.uint32(0),
+                              x << vs_b.astype(jnp.uint32))
+            vals = lax.bitcast_convert_type(xored ^ vf_b, jnp.float32)
+            valid = lane < bc_b
+            ts = jnp.where(valid, ts, TS_GAP_MIN)
+            return ts, vals, valid
+
+        return jax.vmap(one_block)(rb, sl, tw, twd, vf, vs, vw, vwd, bc)
+
+    ts_b, vals_b, valid_b = jax.vmap(decode_series)(
+        rel_bases, ts_slopes, ts_widths, ts_words, v_firsts, v_shifts,
+        v_widths, v_words, blk_counts)
+    S = NB * BLOCK
+    ts = ts_b.reshape(P, S)
+    vals = vals_b.reshape(P, S)
+    valid = valid_b.reshape(P, S)
+    # gaps inherit the previous real timestamp (keeps ts sorted for the
+    # window binary search); leading gaps stay at TS_GAP_MIN
+    ts = lax.cummax(ts, axis=1)
+    # restrict to the query range: [0, range_len] relative
+    valid = valid & (ts >= 0) & (ts <= range_len)
+    return ts, vals, valid
+
+
+def build_device_batch(partitions, start: int, end: int,
+                       value_col: int | None = None) -> DeviceSeriesBatch:
+    """Assemble a device-decoded batch from partitions' chunk pages."""
+    per_series: list[list[tuple[DevicePage, DevicePage, int]]] = []
+    for p in partitions:
+        col = value_col if value_col is not None \
+            else p.schema.data.value_column
+        entries = []
+        for c in p.chunks_in_range(start, end, include_buffer=False):
+            tsp, vp = chunk_device_pages(c, p.schema, col)
+            entries.append((tsp, vp, c.num_rows))
+        b = p._buf
+        if b.n:
+            bts = b.ts[: b.n]
+            if bts[-1] >= start and bts[0] <= end:
+                tsp = encode_ts_page(bts)
+                vp = encode_f32_page(np.asarray(b.cols[col - 1][: b.n],
+                                                np.float64))
+                entries.append((tsp, vp, int(b.n)))
+        per_series.append(entries)
+
+    P = len(per_series)
+    nb_per = [sum(t.num_blocks for t, _, _ in e) for e in per_series]
+    NB = max(max(nb_per, default=1), 1)
+    rel_bases = np.zeros((P, NB), np.int32)
+    ts_slopes = np.zeros((P, NB), np.int32)
+    ts_widths = np.zeros((P, NB), np.int32)
+    ts_words = np.zeros((P, NB, WORDS_PER_BLOCK_MAX), np.uint32)
+    v_firsts = np.zeros((P, NB), np.uint32)
+    v_shifts = np.zeros((P, NB), np.int32)
+    v_widths = np.zeros((P, NB), np.int32)
+    v_words = np.zeros((P, NB, WORDS_PER_BLOCK_MAX), np.uint32)
+    blk_counts = np.zeros((P, NB), np.int32)
+    counts = np.zeros(P, np.int32)
+    for i, entries in enumerate(per_series):
+        bi = 0
+        for tsp, vp, nrows in entries:
+            nb = tsp.num_blocks
+            rel_bases[i, bi : bi + nb] = (tsp.bases - start).astype(np.int32)
+            ts_slopes[i, bi : bi + nb] = tsp.slopes
+            ts_widths[i, bi : bi + nb] = tsp.widths
+            ts_words[i, bi : bi + nb] = tsp.words
+            v_firsts[i, bi : bi + nb] = vp.bases
+            v_shifts[i, bi : bi + nb] = vp.slopes
+            v_widths[i, bi : bi + nb] = vp.widths
+            v_words[i, bi : bi + nb] = vp.words
+            full, rem = divmod(nrows, BLOCK)
+            bc = [BLOCK] * full + ([rem] if rem else [])
+            blk_counts[i, bi : bi + nb] = bc + [0] * (nb - len(bc))
+            counts[i] += nrows
+            bi += nb
+    ts_dev, vals_dev, valid_dev = _assemble(
+        jnp.asarray(rel_bases), jnp.asarray(ts_slopes),
+        jnp.asarray(ts_widths), jnp.asarray(ts_words),
+        jnp.asarray(v_firsts), jnp.asarray(v_shifts),
+        jnp.asarray(v_widths), jnp.asarray(v_words),
+        jnp.asarray(blk_counts), jnp.asarray(np.int32(end - start)))
+    return DeviceSeriesBatch(start, ts_dev, vals_dev, valid_dev, counts,
+                             [p.part_id for p in partitions])

@@ -70,12 +70,25 @@ def _gather(x, idx):
     return jnp.take_along_axis(x, idx, axis=1)
 
 
-def _counter_corrected(v, valid):
+def _prev_valid_value(v, valid, pv):
+    """(prev_valid_value, prev_exists) per position — comparisons against the
+    previous VALID sample, skipping interior gaps."""
+    pv_prev = jnp.concatenate(
+        [jnp.full_like(pv[:, :1], -1), pv[:, :-1]], axis=1)
+    prev_val = jnp.take_along_axis(v, jnp.maximum(pv_prev, 0), axis=1)
+    return prev_val, pv_prev >= 0
+
+
+def _counter_corrected(v, valid, pv=None):
     """Values plus cumulative reset correction (Prometheus counter semantics:
-    on a drop, the previous value is added to all subsequent samples)."""
-    prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-    dropped = (v < prev) & valid & jnp.concatenate(
-        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    on a drop, the previous value is added to all subsequent samples).
+    Comparisons skip gap positions via the prev-valid index map."""
+    if pv is None:
+        S = v.shape[1]
+        sidx = jnp.arange(S, dtype=jnp.int32)[None, :]
+        pv = lax.cummax(jnp.where(valid, sidx, -1), axis=1)
+    prev, prev_ok = _prev_valid_value(v, valid, pv)
+    dropped = (v < prev) & valid & prev_ok
     correction = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
     return v + correction
 
@@ -130,15 +143,38 @@ def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
     steps: int32 [K]; window: int32 scalar ms; extra: scalar parameter
     (predict_linear horizon etc.). Returns float [P,K].
     """
+    return _range_impl(fn, ts, vals, _valid_mask(ts, counts), steps, window,
+                       extra, counter)
+
+
+@partial(jax.jit, static_argnames=("fn", "counter"))
+def range_eval_masked(fn: str, ts, vals, valid, steps, window, extra=0.0,
+                      counter: bool = False):
+    """Mask-aware variant: ``valid`` [P,S] may have interior gaps (device-
+    decoded block-aligned pages). Gap positions must carry a timestamp ≤ the
+    next valid sample's (monotone non-decreasing ts overall)."""
+    return _range_impl(fn, ts, vals, valid, steps, window, extra, counter)
+
+
+def _range_impl(fn: str, ts, vals, valid, steps, window, extra, counter):
     dt = fdtype()
     vals = vals.astype(dt)
-    valid = _valid_mask(ts, counts)
     v = jnp.where(valid, vals, 0.0)
+    S = ts.shape[1]
     lo, hi = window_bounds(ts, steps, window)
-    n = (hi - lo).astype(dt)
-    has1 = hi > lo
-    has2 = hi > lo + 1
+    # valid-sample machinery (positions may be gaps, not just tail padding):
+    # prev-valid index at/before i, next-valid index at/after i
+    sidx = jnp.arange(S, dtype=jnp.int32)[None, :]
+    pv = lax.cummax(jnp.where(valid, sidx, -1), axis=1)
+    nv = lax.cummin(jnp.where(valid, sidx, S), axis=1, reverse=True)
+    vcount = _eprefix(valid.astype(dt))
+    n = _gather(vcount, hi) - _gather(vcount, lo)
+    has1 = n >= 1
+    has2 = n >= 2
     nan = jnp.array(jnp.nan, dt)
+    # first/last VALID sample index within [lo, hi)
+    first_idx = jnp.clip(_gather(nv, jnp.minimum(lo, S - 1)), 0, S - 1)
+    last_idx = jnp.clip(_gather(pv, jnp.maximum(hi - 1, 0)), 0, S - 1)
 
     if fn == "count_over_time":
         return jnp.where(has1, n, nan)
@@ -167,7 +203,7 @@ def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
         sd = jnp.sqrt(var)
         if fn == "stddev_over_time":
             return jnp.where(has1, sd, nan)
-        last = _gather(v, jnp.maximum(hi - 1, 0))
+        last = _gather(v, last_idx)
         return jnp.where(has1, (last - mean) / sd, nan)
 
     if fn in ("min_over_time", "max_over_time"):
@@ -176,33 +212,35 @@ def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
         if fn == "min_over_time":
             masked = jnp.where(valid, vals, jnp.inf)
             table = _build_sparse(masked, jnp.minimum, jnp.inf, levels)
-            return _rmq(table, lo, hi, jnp.minimum, jnp.inf)
-        masked = jnp.where(valid, vals, -jnp.inf)
-        table = _build_sparse(masked, jnp.maximum, -jnp.inf, levels)
-        return _rmq(table, lo, hi, jnp.maximum, -jnp.inf)
+            out = _rmq(table, lo, hi, jnp.minimum, jnp.inf)
+        else:
+            masked = jnp.where(valid, vals, -jnp.inf)
+            table = _build_sparse(masked, jnp.maximum, -jnp.inf, levels)
+            out = _rmq(table, lo, hi, jnp.maximum, -jnp.inf)
+        return jnp.where(has1, out, nan)
 
     if fn in ("last_over_time", "last_sample", "timestamp"):
-        idx = jnp.maximum(hi - 1, 0)
         if fn == "timestamp":
-            t_last = _gather(ts, idx).astype(dt)
+            t_last = _gather(ts, last_idx).astype(dt)
             return jnp.where(has1, t_last / 1000.0, nan)
-        return jnp.where(has1, _gather(v, idx), nan)
+        return jnp.where(has1, _gather(v, last_idx), nan)
 
     if fn in ("changes", "resets"):
-        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-        both = valid & jnp.concatenate(
-            [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+        prev_val, prev_ok = _prev_valid_value(v, valid, pv)
         if fn == "changes":
-            ind = (v != prev) & both
+            ind = (v != prev_val) & valid & prev_ok
         else:
-            ind = (v < prev) & both
+            ind = (v < prev_val) & valid & prev_ok
         cind = _eprefix(ind.astype(dt))
-        cnt = _gather(cind, hi) - _gather(cind, jnp.minimum(lo + 1, hi))
+        # count indicators whose predecessor is also in the window:
+        # positions (first_idx, hi)
+        start = jnp.minimum(first_idx + 1, hi)
+        cnt = _gather(cind, hi) - _gather(cind, start)
         return jnp.where(has1, cnt, nan)
 
     if fn in ("irate", "idelta"):
-        i1 = jnp.maximum(hi - 1, 0)
-        i0 = jnp.maximum(hi - 2, 0)
+        i1 = last_idx
+        i0 = jnp.clip(_gather(pv, jnp.maximum(i1 - 1, 0)), 0, S - 1)
         v1, v0 = _gather(v, i1), _gather(v, i0)
         t1, t0 = _gather(ts, i1).astype(dt), _gather(ts, i0).astype(dt)
         dv = v1 - v0
@@ -222,17 +260,15 @@ def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
 
     if fn in ("rate", "increase", "delta"):
         if counter or fn in ("rate", "increase"):
-            cv = _counter_corrected(jnp.where(valid, vals, 0.0), valid)
+            cv = _counter_corrected(jnp.where(valid, vals, 0.0), valid, pv)
             cv = jnp.where(valid, cv, 0.0)
         else:
             cv = v
-        i_first = jnp.minimum(lo, ts.shape[1] - 1)
-        i_last = jnp.maximum(hi - 1, 0)
-        v_first = _gather(cv, i_first)
-        v_last = _gather(cv, i_last)
-        raw_first = _gather(v, i_first)
-        t_first = _gather(ts, i_first).astype(dt) / 1000.0
-        t_last = _gather(ts, i_last).astype(dt) / 1000.0
+        v_first = _gather(cv, first_idx)
+        v_last = _gather(cv, last_idx)
+        raw_first = _gather(v, first_idx)
+        t_first = _gather(ts, first_idx).astype(dt) / 1000.0
+        t_last = _gather(ts, last_idx).astype(dt) / 1000.0
         result = v_last - v_first
         # Prometheus extrapolatedRate semantics
         range_start = (steps[None, :] - window).astype(dt) / 1000.0
@@ -283,7 +319,7 @@ def _linreg(ts, v, valid, lo, hi, steps, slope_only: bool, horizon_s=0.0):
     Stv_c = Stv - c * Sv
     denom = n * Stt_c - St_c * St_c
     slope = (n * Stv_c - St_c * Sv) / jnp.where(denom == 0, 1.0, denom)
-    has2 = (hi - lo) >= 2
+    has2 = n >= 2  # n counts VALID samples (mask-aware)
     if slope_only:
         return jnp.where(has2 & (denom != 0), slope, jnp.nan)
     intercept = (Sv - slope * St_c) / jnp.maximum(n, 1.0)

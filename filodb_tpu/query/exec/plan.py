@@ -161,8 +161,16 @@ class SelectRawPartitionsExec(ExecPlan):
             if cached is not None and cached[0] == version:
                 _, batch, keys, is_counter = cached
             else:
-                batch = build_batch(sparts, self.chunk_start, self.chunk_end,
-                                    col, extra_chunks=extra_chunks)
+                if self._use_device_path(shard, schema, col):
+                    from filodb_tpu.query.engine.device_batch import (
+                        build_device_batch,
+                    )
+                    batch = build_device_batch(sparts, self.chunk_start,
+                                               self.chunk_end, col)
+                else:
+                    batch = build_batch(sparts, self.chunk_start,
+                                        self.chunk_end, col,
+                                        extra_chunks=extra_chunks)
                 keys = [RangeVectorKey.of(p.part_key.label_map)
                         for p in sparts]
                 is_counter = schema.data.columns[col].is_counter
@@ -195,6 +203,21 @@ class SelectRawPartitionsExec(ExecPlan):
         data = self.do_execute(ctx)
         self._enforce_limits(data, ctx)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
+
+    def _use_device_path(self, shard, schema, col) -> bool:
+        """Decode-on-device path: enabled per store config, for scalar float
+        columns only (histograms and the quantile/holt-winters transformers
+        use the host-decoded path)."""
+        if not getattr(shard.config, "device_pages", False):
+            return False
+        if schema.data.columns[col].ctype != ColumnType.DOUBLE:
+            return False
+        from filodb_tpu.query.exec.transformers import PeriodicSamplesMapper
+        psm = self.transformers[0] if self.transformers else None
+        if isinstance(psm, PeriodicSamplesMapper) and psm.function in (
+                "quantile_over_time", "holt_winters"):
+            return False
+        return True
 
     def _value_col_index(self, schema) -> int:
         if self.value_column:

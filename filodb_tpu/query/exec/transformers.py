@@ -63,9 +63,27 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         rel_steps = (eval_steps - batch.base_ts).astype(np.int32)
         fn = self.function or "last_sample"
         window = self.window if self.function else 300_000  # staleness lookback
-        ts_j, vals_j, counts_j = batch.device_arrays()
         steps_j = jnp.asarray(rel_steps)
         win_j = jnp.asarray(np.int32(window))
+
+        if getattr(batch, "masked", False):
+            # device-decoded masked batch (engine/device_batch.py)
+            ts_j, vals_j, valid_j = batch.device_arrays()
+            if fn == "predict_linear":
+                out = kernels.range_eval_masked(
+                    fn, ts_j, vals_j, valid_j, steps_j, win_j,
+                    extra=float(self.params[0]))
+            else:
+                out = kernels.range_eval_masked(
+                    fn, ts_j, vals_j, valid_j, steps_j, win_j,
+                    counter=self.is_counter)
+            out = np.asarray(out)[: batch.num_series]
+            if fn == "timestamp":
+                out = out + batch.base_ts / 1000.0
+            return StepMatrix(self._out_keys(keys), out.astype(np.float64),
+                              steps)
+
+        ts_j, vals_j, counts_j = batch.device_arrays()
 
         if batch.is_histogram:
             # apply the range function per bucket: vmap over B

@@ -1,0 +1,93 @@
+"""Mask-aware kernel tests: interior gaps (block-aligned device-page layout)
+must produce results identical to the compacted gap-free arrays.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from filodb_tpu.query.engine import kernels
+from filodb_tpu.query.engine.batch import TS_PAD
+
+FNS = ["sum_over_time", "avg_over_time", "count_over_time", "min_over_time",
+       "max_over_time", "stddev_over_time", "last_over_time", "changes",
+       "resets", "rate", "increase", "delta", "irate", "idelta", "deriv",
+       "zscore", "present_over_time"]
+
+
+def make_gappy(n=200, gap_every=50, gap_len=14, seed=0, counter=False):
+    """Dense series → gap-padded layout (gaps carry the previous real ts)."""
+    rng = np.random.default_rng(seed)
+    t = np.cumsum(rng.integers(5_000, 15_000, n)).astype(np.int64)
+    if counter:
+        v = np.cumsum(rng.integers(0, 20, n)).astype(float)
+        r = n // 2
+        v[r:] -= v[r]
+    else:
+        v = rng.normal(50, 10, n)
+    # insert gap runs after every `gap_every` real samples
+    ts_out, vals_out, valid_out = [], [], []
+    for i in range(n):
+        ts_out.append(t[i])
+        vals_out.append(v[i])
+        valid_out.append(True)
+        if (i + 1) % gap_every == 0:
+            for _ in range(gap_len):
+                ts_out.append(t[i])     # gap carries last real ts
+                vals_out.append(0.0)
+                valid_out.append(False)
+    S = len(ts_out)
+    return (t, v,
+            np.array(ts_out, np.int32)[None, :],
+            np.array(vals_out, np.float64)[None, :],
+            np.array(valid_out, bool)[None, :])
+
+
+class TestMaskedEquivalence:
+    @pytest.mark.parametrize("fn", FNS)
+    def test_gaps_match_compact(self, fn):
+        t, v, ts_g, vals_g, valid_g = make_gappy(counter=fn in
+                                                 ("rate", "increase"))
+        steps = np.arange(400_000, 1_800_000, 70_000, dtype=np.int32)
+        window = np.int32(300_000)
+        # compact reference
+        S = 1 << (len(t) - 1).bit_length()
+        ts_c = np.full((1, S), TS_PAD, np.int32)
+        vals_c = np.zeros((1, S), np.float64)
+        ts_c[0, : len(t)] = t
+        vals_c[0, : len(t)] = v
+        counts = np.array([len(t)], np.int32)
+        ref = np.asarray(kernels.range_eval(
+            fn, jnp.asarray(ts_c), jnp.asarray(vals_c), jnp.asarray(counts),
+            jnp.asarray(steps), jnp.asarray(window)))
+        out = np.asarray(kernels.range_eval_masked(
+            fn, jnp.asarray(ts_g), jnp.asarray(vals_g), jnp.asarray(valid_g),
+            jnp.asarray(steps), jnp.asarray(window)))
+        np.testing.assert_allclose(out, ref, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True, err_msg=fn)
+
+    def test_leading_gap_block(self):
+        # an entirely-invalid leading block (e.g. padding) with INT32_MIN ts
+        t = np.arange(1, 51, dtype=np.int64) * 10_000
+        v = np.arange(50, dtype=float)
+        ts_g = np.concatenate([np.full(16, -2**31 + 1, np.int32),
+                               t.astype(np.int32)])[None, :]
+        vals_g = np.concatenate([np.zeros(16), v])[None, :]
+        valid_g = np.concatenate([np.zeros(16, bool),
+                                  np.ones(50, bool)])[None, :]
+        steps = np.array([500_000], np.int32)
+        out = np.asarray(kernels.range_eval_masked(
+            "sum_over_time", jnp.asarray(ts_g), jnp.asarray(vals_g),
+            jnp.asarray(valid_g), jnp.asarray(steps),
+            jnp.asarray(np.int32(500_000))))
+        np.testing.assert_allclose(out[0, 0], v.sum())
+
+    def test_all_invalid_is_nan(self):
+        ts_g = np.full((1, 32), 1000, np.int32)
+        vals_g = np.zeros((1, 32))
+        valid_g = np.zeros((1, 32), bool)
+        out = np.asarray(kernels.range_eval_masked(
+            "avg_over_time", jnp.asarray(ts_g), jnp.asarray(vals_g),
+            jnp.asarray(valid_g), jnp.asarray(np.array([2000], np.int32)),
+            jnp.asarray(np.int32(5000))))
+        assert np.isnan(out).all()
