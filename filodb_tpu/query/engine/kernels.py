@@ -162,19 +162,24 @@ def _range_impl(fn: str, ts, vals, valid, steps, window, extra, counter):
     v = jnp.where(valid, vals, 0.0)
     S = ts.shape[1]
     lo, hi = window_bounds(ts, steps, window)
-    # valid-sample machinery (positions may be gaps, not just tail padding):
-    # prev-valid index at/before i, next-valid index at/after i
-    sidx = jnp.arange(S, dtype=jnp.int32)[None, :]
-    pv = lax.cummax(jnp.where(valid, sidx, -1), axis=1)
-    nv = lax.cummin(jnp.where(valid, sidx, S), axis=1, reverse=True)
     vcount = _eprefix(valid.astype(dt))
     n = _gather(vcount, hi) - _gather(vcount, lo)
     has1 = n >= 1
     has2 = n >= 2
     nan = jnp.array(jnp.nan, dt)
-    # first/last VALID sample index within [lo, hi)
-    first_idx = jnp.clip(_gather(nv, jnp.minimum(lo, S - 1)), 0, S - 1)
-    last_idx = jnp.clip(_gather(pv, jnp.maximum(hi - 1, 0)), 0, S - 1)
+    # valid-sample machinery (positions may be gaps, not just tail padding):
+    # prev/next-valid index maps — only built for functions that gather
+    # first/last samples (fn is static, so this prunes the compiled graph)
+    pv = nv = first_idx = last_idx = None
+    if fn in ("stddev_over_time", "stdvar_over_time", "zscore",
+              "last_over_time", "last_sample", "timestamp", "changes",
+              "resets", "irate", "idelta", "rate", "increase", "delta"):
+        sidx = jnp.arange(S, dtype=jnp.int32)[None, :]
+        pv = lax.cummax(jnp.where(valid, sidx, -1), axis=1)
+        nv = lax.cummin(jnp.where(valid, sidx, S), axis=1, reverse=True)
+        # first/last VALID sample index within [lo, hi)
+        first_idx = jnp.clip(_gather(nv, jnp.minimum(lo, S - 1)), 0, S - 1)
+        last_idx = jnp.clip(_gather(pv, jnp.maximum(hi - 1, 0)), 0, S - 1)
 
     if fn == "count_over_time":
         return jnp.where(has1, n, nan)
