@@ -45,7 +45,13 @@ class RangeVectorKey:
         return RangeVectorKey(tuple((k, v) for k, v in self.labels if k in ns))
 
     def drop_metric(self) -> "RangeVectorKey":
-        return self.without((METRIC_LABEL,))
+        # hot on the query path (every output key of every range function);
+        # memoized per instance
+        cached = self.__dict__.get("_no_metric")
+        if cached is None:
+            cached = self.without((METRIC_LABEL,))
+            object.__setattr__(self, "_no_metric", cached)
+        return cached
 
     def __str__(self) -> str:
         return "{" + ",".join(f"{k}={v}" for k, v in self.labels) + "}"
