@@ -280,6 +280,33 @@ class TimeSeriesShard:
         part = self.partitions[part_id]
         return part.evict_flushed_chunks() if part else 0
 
+    def chunk_bytes(self) -> int:
+        return sum(sum(c.nbytes for c in p.chunks)
+                   for p in self.partitions if p is not None)
+
+    def enforce_memory(self, budget_bytes: int | None = None) -> int:
+        """Evict persisted chunks, oldest-data partitions first, until chunk
+        memory fits the shard budget (reference eviction under memory
+        pressure with time-ordered reclaim, ``BlockManager`` "time-ordered"
+        lists). Returns chunks evicted."""
+        budget = budget_bytes if budget_bytes is not None \
+            else self.config.shard_mem_mb * 1024 * 1024
+        used = self.chunk_bytes()
+        if used <= budget:
+            return 0
+        evicted = 0
+        parts = sorted((p for p in self.partitions if p is not None),
+                       key=lambda p: p.latest_ts)
+        for p in parts:
+            if used <= budget:
+                break
+            before = sum(c.nbytes for c in p.chunks)
+            n = p.evict_flushed_chunks()
+            if n:
+                used -= before - sum(c.nbytes for c in p.chunks)
+                evicted += n
+        return evicted
+
     def mark_part_ended(self, part_id: int, end_time: int) -> None:
         self.index.update_end_time(part_id, end_time)
         self._dirty_part_keys.add(part_id)

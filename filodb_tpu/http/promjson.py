@@ -48,6 +48,14 @@ def _flatten_histograms(m: StepMatrix) -> StepMatrix:
                       else np.zeros((0, m.num_steps)), m.steps_ms)
 
 
+def _stats_json(result: QueryResult) -> dict:
+    s = result.stats
+    return {"seriesScanned": s.series_scanned,
+            "samplesScanned": s.samples_scanned,
+            "resultSeries": s.result_series,
+            "wallTimeMs": round(s.wall_time_s * 1000.0, 3)}
+
+
 def matrix_json(result: QueryResult) -> dict:
     m = result.result
     if m.is_histogram:
@@ -63,7 +71,8 @@ def matrix_json(result: QueryResult) -> dict:
         if vals:
             series.append({"metric": _labels_json(key), "values": vals})
     return {"status": "success",
-            "data": {"resultType": "matrix", "result": series}}
+            "data": {"resultType": "matrix", "result": series},
+            "queryStats": _stats_json(result)}
 
 
 def vector_json(result: QueryResult) -> dict:

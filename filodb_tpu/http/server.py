@@ -213,6 +213,38 @@ def _make_handler(server: FiloHttpServer):
                 return self._send(200, {"status": "success",
                                         "data": list(server.services)})
             dataset = rest[0]
+            if len(rest) == 2 and rest[1] in ("startshards", "stopshards") \
+                    and cluster is not None:
+                # reference ClusterApiRoute start/stop shards commands
+                from filodb_tpu.coordinator.shardmapper import (
+                    ShardEvent,
+                    ShardStatus,
+                )
+                shards = [int(s) for s in
+                          qs.get("shards", [""])[0].split(",") if s]
+                node = qs.get("node", [None])[0]
+                sm = cluster.shard_managers.get(dataset)
+                if sm is None:
+                    return self._send(404, promjson.error_json(
+                        f"unknown dataset {dataset}"))
+                done = []
+                for shard in shards:
+                    if rest[1] == "stopshards":
+                        owner = sm.mapper.node_for(shard)
+                        if owner and owner in cluster.nodes:
+                            cluster.nodes[owner].stop_shard(dataset, shard)
+                            sm._publish(ShardEvent(shard, ShardStatus.STOPPED,
+                                                   None))
+                            done.append(shard)
+                    else:
+                        target = node or next(iter(cluster.nodes), None)
+                        if target:
+                            ev = ShardEvent(shard, ShardStatus.ASSIGNED,
+                                            target)
+                            sm._publish(ev)
+                            cluster._on_event(dataset, ev)
+                            done.append(shard)
+                return self._send(200, {"status": "success", "data": done})
             if len(rest) == 2 and rest[1] == "status":
                 if cluster is not None:
                     data = cluster.shard_statuses(dataset)

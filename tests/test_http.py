@@ -162,3 +162,40 @@ class TestRemoteRead:
         assert out[0]["start_ms"] == 1000 and out[0]["end_ms"] == 2000
         f = out[0]["filters"][0]
         assert f.column == "job" and isinstance(f.filter, EqualsRegex)
+
+
+class TestStartStopShards:
+    def test_stop_and_start_shard(self):
+        import time as _time
+        from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+        from filodb_tpu.core.store.api import (
+            InMemoryColumnStore,
+            InMemoryMetaStore,
+        )
+        from filodb_tpu.core.store.config import IngestionConfig
+        from filodb_tpu.kafka.log import InMemoryLog
+
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        cluster = FilodbCluster()
+        cluster.join(Node("n1", TimeSeriesMemStore(cs, meta)))
+        logs = {s: InMemoryLog() for s in range(2)}
+        cluster.setup_dataset(
+            IngestionConfig("timeseries", 2,
+                            store=StoreConfig(max_chunk_size=50)), logs)
+        assert cluster.wait_active("timeseries", 5)
+        svc = QueryService(cluster.nodes["n1"].memstore, "timeseries", 2, 1)
+        srv = FiloHttpServer({"timeseries": svc}, port=0,
+                             cluster=cluster).start()
+        try:
+            code, body = get(srv, "/api/v1/cluster/timeseries/stopshards",
+                             shards="1")
+            assert code == 200 and body["data"] == [1]
+            assert cluster.nodes["n1"].owned_shards("timeseries") == [0]
+            code, body = get(srv, "/api/v1/cluster/timeseries/startshards",
+                             shards="1", node="n1")
+            assert code == 200 and body["data"] == [1]
+            _time.sleep(0.1)
+            assert cluster.nodes["n1"].owned_shards("timeseries") == [0, 1]
+        finally:
+            srv.stop()
+            cluster.stop()

@@ -226,3 +226,33 @@ class TestShardRouting:
         part = shard.partitions[0]
         ts, vals = part.read_samples(0, 10**15)
         assert (np.diff(vals) < 0).sum() >= 2  # resets present
+
+
+class TestMemoryPressure:
+    def test_enforce_memory_evicts_oldest_first(self):
+        from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("timeseries", 0, small_config(max_chunk_size=50))
+        old = machine_metrics_series(2, metric="old_m")
+        new = machine_metrics_series(2, metric="new_m")
+        for data in gauge_stream(old, 200, start_ms=0):
+            shard.ingest(data)
+        for data in gauge_stream(new, 200, start_ms=10_000_000):
+            shard.ingest(data)
+        shard.flush_all(ingestion_time=1)
+        used = shard.chunk_bytes()
+        assert used > 0
+        evicted = shard.enforce_memory(budget_bytes=used // 2)
+        assert evicted > 0
+        assert shard.chunk_bytes() <= used // 2
+        # oldest partitions were evicted first; newest still resident
+        newest = max((p for p in shard.partitions if p),
+                     key=lambda p: p.latest_ts)
+        assert len(newest.chunks) > 0
+        # evicted data still queryable via ODP
+        from filodb_tpu.coordinator.query_service import QueryService
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range('count_over_time(old_m[40m])', 2395, 60,
+                            2395).result
+        np.testing.assert_array_equal(r.values[:, 0], 200.0)
