@@ -121,6 +121,114 @@ def _combine_time_partials(parts, steps, window):
     return jnp.where(n_tot >= 2, rate, jnp.nan)
 
 
+def _local_simple_partials(ts, vals, counts_mask, steps, window):
+    """Per-device partials for associative over-time functions:
+    [P_l, K, 5] = sum, count, min, max, last (+inf/-inf/0 sentinels)."""
+    dt = fdtype()
+    valid = counts_mask
+    v = jnp.where(valid, vals, 0.0).astype(dt)
+
+    def bounds(tsp):
+        hi = jnp.searchsorted(tsp, steps, side="right")
+        lo = jnp.searchsorted(tsp, steps - window, side="right")
+        return lo, hi
+
+    lo, hi = jax.vmap(bounds)(ts)
+
+    def g(x, idx):
+        return jnp.take_along_axis(x, idx, axis=1)
+
+    def eprefix(x):
+        return jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (1,), x.dtype), jnp.cumsum(x, -1)], -1)
+
+    csum = eprefix(v)
+    cnt = eprefix(valid.astype(dt))
+    s = g(csum, hi) - g(csum, lo)
+    n = g(cnt, hi) - g(cnt, lo)
+    # blocked masked min/max (local S is small per device)
+    S = ts.shape[1]
+    sidx = jnp.arange(S)[None, None, :]
+    in_win = (sidx >= lo[:, :, None]) & (sidx < hi[:, :, None]) \
+        & valid[:, None, :]
+    mn = jnp.min(jnp.where(in_win, vals[:, None, :], jnp.inf), axis=2)
+    mx = jnp.max(jnp.where(in_win, vals[:, None, :], -jnp.inf), axis=2)
+    has = n > 0
+    last = jnp.where(has, g(v, jnp.maximum(hi - 1, 0)), 0.0)
+    t_last = jnp.where(has, g(ts, jnp.maximum(hi - 1, 0)),
+                       jnp.int32(-(2**31 - 1))).astype(dt)
+    return jnp.stack([s, n, mn, mx, last, t_last], axis=-1)
+
+
+_SIMPLE_COMBINE = {
+    "sum_over_time": lambda p: jnp.where(p[..., 1].sum(0) > 0,
+                                         p[..., 0].sum(0), jnp.nan),
+    "count_over_time": lambda p: jnp.where(p[..., 1].sum(0) > 0,
+                                           p[..., 1].sum(0), jnp.nan),
+    "avg_over_time": lambda p: jnp.where(
+        p[..., 1].sum(0) > 0,
+        p[..., 0].sum(0) / jnp.maximum(p[..., 1].sum(0), 1.0), jnp.nan),
+    "min_over_time": lambda p: jnp.where(p[..., 1].sum(0) > 0,
+                                         p[..., 2].min(0), jnp.nan),
+    "max_over_time": lambda p: jnp.where(p[..., 1].sum(0) > 0,
+                                         p[..., 3].max(0), jnp.nan),
+    "last_over_time": lambda p: jnp.where(
+        p[..., 1].sum(0) > 0,
+        jnp.take_along_axis(p[..., 4], jnp.argmax(p[..., 5], axis=0)[None],
+                            axis=0)[0], jnp.nan),
+}
+
+
+def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
+                               agg: str = "sum"):
+    """Distributed ``agg(fn(x[w])) by (g)`` over the (shard, time) mesh for
+    the associative over-time family — same SPMD shape as the rate pipeline:
+    time-block partials all-gathered over ``time``, label groups reduced via
+    segment_sum + ``psum`` over ``shard``."""
+    if fn == "rate":
+        return make_distributed_sum_rate(mesh, num_groups)
+    combine = _SIMPLE_COMBINE[fn]
+
+    def step(ts, vals, valid, group_ids, steps, window):
+        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
+            parts = _local_simple_partials(ts_l, vals_l, valid_l, steps_r,
+                                           window_r)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 6]
+            res = combine(gathered)
+            present = ~jnp.isnan(res)
+            contrib = jnp.where(present, res, 0.0)
+            if agg in ("min", "max"):
+                sentinel = jnp.inf if agg == "min" else -jnp.inf
+                marked = jnp.where(present, res, sentinel)
+                seg = (jax.ops.segment_min if agg == "min"
+                       else jax.ops.segment_max)(marked, gid_l, num_groups)
+                seg = (lax.pmin if agg == "min" else lax.pmax)(seg, "shard")
+                gcnt = lax.psum(jax.ops.segment_sum(
+                    present.astype(contrib.dtype), gid_l, num_groups),
+                    "shard")
+                return jnp.where(gcnt > 0, seg, jnp.nan)
+            gsum = lax.psum(jax.ops.segment_sum(contrib, gid_l, num_groups),
+                            "shard")
+            gcnt = lax.psum(jax.ops.segment_sum(
+                present.astype(contrib.dtype), gid_l, num_groups), "shard")
+            if agg == "avg":
+                return jnp.where(gcnt > 0, gsum / jnp.maximum(gcnt, 1.0),
+                                 jnp.nan)
+            if agg == "count":
+                return jnp.where(gcnt > 0, gcnt, jnp.nan)
+            return jnp.where(gcnt > 0, gsum, jnp.nan)
+
+        return jax.shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P("shard", "time"), P("shard", "time"),
+                      P("shard", "time"), P("shard"), P(None), P()),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(ts, vals, valid, group_ids, steps, window)
+
+    return jax.jit(step)
+
+
 def make_distributed_sum_rate(mesh: Mesh, num_groups: int):
     """Build the jitted distributed ``sum(rate(x[w])) by (g)`` step.
 

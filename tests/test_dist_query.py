@@ -110,3 +110,32 @@ class TestDistributedSumRate:
                             jnp.asarray(valid), jnp.asarray(gid_p),
                             jnp.asarray(steps), jnp.asarray(window)))
         assert np.isnan(out).all()
+
+
+class TestDistributedRangeAggFamily:
+    @pytest.mark.parametrize("fn,agg", [
+        ("sum_over_time", "sum"), ("count_over_time", "sum"),
+        ("avg_over_time", "avg"), ("min_over_time", "min"),
+        ("max_over_time", "max"), ("last_over_time", "sum"),
+    ])
+    def test_matches_single_device(self, mesh, fn, agg):
+        from filodb_tpu.parallel.dist_query import make_distributed_range_agg
+
+        P_, S = 8, 128
+        ts, vals, counts = make_series(P_, S, seed=11, resets=False)
+        gids = np.arange(P_, dtype=np.int32) % 2
+        steps = np.arange(400_000, 1_000_000, 60_000, dtype=np.int32)
+        window = np.int32(300_000)
+        per_series = np.asarray(kernels.range_eval(
+            fn, jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(counts),
+            jnp.asarray(steps), jnp.asarray(window)))
+        expect = np.asarray(aggregate(agg, jnp.asarray(per_series),
+                                      jnp.asarray(gids), 2))
+        ts_p, vals_p, valid, gid_p = pad_for_mesh(ts, vals, counts, gids,
+                                                  mesh)
+        f = make_distributed_range_agg(mesh, fn, 2, agg)
+        out = np.asarray(f(jnp.asarray(ts_p), jnp.asarray(vals_p),
+                           jnp.asarray(valid), jnp.asarray(gid_p),
+                           jnp.asarray(steps), jnp.asarray(window)))
+        np.testing.assert_allclose(out, expect, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True, err_msg=f"{fn}/{agg}")
