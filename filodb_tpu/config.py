@@ -22,6 +22,7 @@ DEFAULTS = {
     "gateway_port": 0,            # 0 = disabled
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
     "seeds": [],                  # bootstrap seed addresses
+    "enable_failover": False,     # singleton failover via member registry
     "datasets": {
         "timeseries": {
             "num_shards": 4,
@@ -47,6 +48,7 @@ class ServerConfig:
     gateway_port: int = 0
     executor_port: int = 0
     seeds: list[str] = field(default_factory=list)
+    enable_failover: bool = False
     datasets: dict[str, IngestionConfig] = field(default_factory=dict)
     spreads: dict[str, int] = field(default_factory=dict)
 
@@ -71,6 +73,7 @@ class ServerConfig:
             wal_dir=cfg.get("wal_dir"),
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
+            enable_failover=cfg.get("enable_failover", False),
             datasets=datasets, spreads=spreads)
 
 

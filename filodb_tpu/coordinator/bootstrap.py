@@ -151,3 +151,64 @@ def poll_remote_statuses(cluster, dataset: str) -> None:
             elif status == "recovery" and sm.mapper.statuses[shard] == \
                     ShardStatus.ASSIGNED:
                 sm.shard_recovery(shard, name, 0)
+
+
+# ---------------------------------------------------------------------------
+# member registry + coordinator failover
+
+class MemberRegistry:
+    """Append-only shared membership file: ``role,name,host,port`` lines.
+    The coordinator role is the LAST coord line whose process still answers
+    pings — the deterministic election substrate for singleton failover
+    (reference: Akka cluster-singleton hand-off,
+    ``ClusterSingletonFailoverSpec``)."""
+
+    def __init__(self, path: str):
+        import os
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def register(self, role: str, name: str, host: str, port: int) -> None:
+        with open(self.path, "a") as f:
+            f.write(f"{role},{name},{host},{port}\n")
+
+    def read(self) -> list[tuple[str, str, str, int]]:
+        import os
+        if not os.path.exists(self.path):
+            return []
+        out = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                role, name, host, port = line.split(",")
+                out.append((role, name, host, int(port)))
+        return out
+
+    def members(self) -> dict[str, tuple[str, str, int]]:
+        """name -> (role, host, port); later lines win."""
+        out = {}
+        for role, name, host, port in self.read():
+            out[name] = (role, host, port)
+        return out
+
+    def current_coordinator(self) -> str | None:
+        coord = None
+        for role, name, _, _ in self.read():
+            if role == "coord":
+                coord = name
+        return coord
+
+
+def alive_members(registry: MemberRegistry,
+                  exclude: str | None = None) -> dict[str, tuple[str, int]]:
+    """Ping every registered member; returns name -> (host, port) of the
+    ones answering."""
+    out = {}
+    for name, (_, host, port) in registry.members().items():
+        if name == exclude:
+            continue
+        if RemotePlanDispatcher(host, port, timeout=1.0).ping():
+            out[name] = (host, port)
+    return out

@@ -105,6 +105,19 @@ class ShardManager:
     def nodes(self) -> list[str]:
         return list(self._nodes)
 
+    # -- adoption / rebalance (singleton failover) --
+
+    def adopt(self, shard: int, node: str, status: ShardStatus) -> None:
+        """Record existing ownership without (re)starting ingestion — used by
+        a freshly-promoted coordinator taking over a running cluster."""
+        if node not in self._nodes:
+            self._nodes.append(node)
+        self.mapper.apply(ShardEvent(shard, status, node))
+
+    def rebalance(self) -> list[ShardEvent]:
+        """Assign any unassigned shards to current members."""
+        return self._assign()
+
     # -- assignment --
 
     def _assign(self) -> list[ShardEvent]:

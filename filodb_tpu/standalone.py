@@ -142,11 +142,122 @@ class FiloServer:
             # from FiloServer.start)
             from filodb_tpu.utils.profiler import SimpleProfiler
             self.profiler = SimpleProfiler().start()
+        if cfg.enable_failover:
+            self._setup_failover()
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
                  self.executor.port, "member" if cfg.seeds else "coordinator")
         return self
 
+    # -- singleton failover (reference ClusterSingletonFailoverSpec) --------
+
+    def _registry(self):
+        from filodb_tpu.coordinator.bootstrap import MemberRegistry
+        root = self.config.wal_dir or os.path.join(self.config.data_dir,
+                                                   "wal")
+        return MemberRegistry(os.path.join(root, "members.txt"))
+
+    def _setup_failover(self):
+        import threading
+        reg = self._registry()
+        role = "member" if self.config.seeds else "coord"
+        reg.register(role, self.config.node_name, "127.0.0.1",
+                     self.executor.port)
+        self.is_coordinator = role == "coord"
+        if role == "member":
+            self._failover_stop = threading.Event()
+            self._failover_thread = threading.Thread(
+                target=self._failover_watch, daemon=True)
+            self._failover_thread.start()
+
+    def _failover_watch(self, interval_s: float = 0.25):
+        from filodb_tpu.coordinator.bootstrap import (
+            alive_members,
+            RemotePlanDispatcher,
+        )
+        reg = self._registry()
+        misses = 0
+        while not self._failover_stop.wait(interval_s):
+            coord = reg.current_coordinator()
+            if coord == self.config.node_name:
+                return  # we promoted
+            members = reg.members()
+            entry = members.get(coord)
+            if entry is not None and RemotePlanDispatcher(
+                    entry[1], entry[2], timeout=1.0).ping():
+                misses = 0
+                continue
+            misses += 1
+            if misses < 3:
+                continue
+            alive = alive_members(reg)
+            alive.pop(coord, None)
+            if alive and min(alive) == self.config.node_name:
+                log.warning("coordinator %s down; promoting self", coord)
+                try:
+                    self._promote(alive)
+                except Exception:
+                    log.exception("promotion failed")
+                return
+            misses = 0  # another member should promote; keep watching
+
+    def _promote(self, alive: dict):
+        """Become the cluster singleton: adopt running members' shards,
+        reassign the dead coordinator's shards, serve queries."""
+        from filodb_tpu.coordinator.bootstrap import (
+            RemoteNodeHandle,
+            poll_remote_statuses,
+        )
+        from filodb_tpu.coordinator.shard_manager import ShardManager
+        from filodb_tpu.coordinator.shardmapper import ShardStatus
+        cfg = self.config
+        self.cluster = FilodbCluster()
+        self.cluster.join(self.node)
+        for name, (host, port) in alive.items():
+            if name != cfg.node_name:
+                self.cluster.nodes[name] = RemoteNodeHandle(name, host, port)
+        for dataset, ing_cfg in cfg.datasets.items():
+            logs = {s: self._shard_log(dataset, s)
+                    for s in range(ing_cfg.num_shards)}
+            for shard, l in logs.items():
+                self.cluster.logs[(dataset, shard)] = l
+            self.cluster.configs[dataset] = ing_cfg
+            # degraded mode: a promoted singleton assigns to the survivors
+            # even below min-num-nodes — availability over balance until
+            # replacement members join
+            sm = ShardManager(dataset, ing_cfg.num_shards,
+                              min(ing_cfg.min_num_nodes,
+                                  len(self.cluster.nodes)))
+            self.cluster.shard_managers[dataset] = sm
+            # adopt what's already running (incl. our own shards)
+            for name, node in self.cluster.nodes.items():
+                if name == cfg.node_name:
+                    statuses = self._handle_shard_status(dataset)
+                else:
+                    try:
+                        statuses = node.shard_status(dataset)
+                    except (ConnectionError, OSError, RuntimeError):
+                        statuses = []
+                for shard, st in statuses:
+                    sm.adopt(shard, name,
+                             ShardStatus.ACTIVE if st == "active"
+                             else ShardStatus.RECOVERY)
+            # the dead coordinator's shards are unassigned: reassign
+            for ev in sm.rebalance():
+                self.cluster._on_event(dataset, ev)
+            svc = self.cluster.query_service(dataset,
+                                             cfg.spreads.get(dataset, 1))
+            self.http.services[dataset] = svc
+            self.cluster.on_heartbeat.append(
+                lambda n=dataset: poll_remote_statuses(self.cluster, n))
+        self.http.cluster = self.cluster
+        self.cluster.start_failure_detector()
+        self._registry().register("coord", cfg.node_name, "127.0.0.1",
+                                  self.executor.port)
+        self.is_coordinator = True
+
     def shutdown(self):
+        if getattr(self, "_failover_stop", None) is not None:
+            self._failover_stop.set()
         if self.http:
             self.http.stop()
         if self.gateway:

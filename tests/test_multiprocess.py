@@ -116,3 +116,109 @@ def test_two_process_cluster(tmp_path):
         except subprocess.TimeoutExpired:
             member.kill()
         coord.shutdown()
+
+
+def test_singleton_failover(tmp_path):
+    """Coordinator process dies → surviving member promotes itself, adopts
+    running shards, recovers the dead coordinator's shards from the shared
+    WAL, and serves queries (reference ClusterSingletonFailoverSpec)."""
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.record import IngestRecord, RecordContainer
+    from filodb_tpu.coordinator.ingestion import route_container
+    from filodb_tpu.kafka.log import FileLog
+
+    wal_dir = str(tmp_path / "wal")
+    coord_port = _free_port()
+    base = {
+        "wal_dir": wal_dir, "http_port": 0, "gateway_port": 0,
+        "enable_failover": True,
+        "datasets": {"timeseries": {
+            "num_shards": 4, "min_num_nodes": 2, "spread": 1,
+            "store": {"max_chunk_size": 100, "groups_per_shard": 2}}},
+    }
+    coord_cfg = dict(base, node_name="a-coord",
+                     data_dir=str(tmp_path / "coord"),
+                     executor_port=coord_port)
+    member_cfg = dict(base, node_name="b-member",
+                      data_dir=str(tmp_path / "member"), executor_port=0,
+                      seeds=[f"127.0.0.1:{coord_port}"])
+
+    # publish data into the shared WAL before anything starts
+    container = RecordContainer()
+    for i in range(200):
+        for inst in range(8):
+            key = PartKey.create("gauge", {
+                "_metric_": "fo_metric", "_ws_": "demo", "_ns_": "App-0",
+                "instance": f"i{inst}"})
+            container.add(IngestRecord(key, (START + i * 10) * 1000,
+                                       (float(i),)))
+    logs = {s: FileLog(f"{wal_dir}/timeseries/shard-{s}.log")
+            for s in range(4)}
+    for shard, cont in route_container(container, 4, 1).items():
+        logs[shard].append(cont)
+    for log_ in logs.values():
+        log_.close()
+
+    coord_path = tmp_path / "coord.json"
+    coord_path.write_text(json.dumps(coord_cfg))
+    member_path = tmp_path / "member.json"
+    member_path.write_text(json.dumps(member_cfg))
+
+    coord_proc = subprocess.Popen(
+        [sys.executable, "-m", "filodb_tpu.standalone", "--config",
+         str(coord_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd="/root/repo",
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    member = None
+    try:
+        # wait for the coordinator's control port
+        deadline = time.monotonic() + 60
+        from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+        while time.monotonic() < deadline:
+            if RemotePlanDispatcher("127.0.0.1", coord_port,
+                                    timeout=0.5).ping():
+                break
+            assert coord_proc.poll() is None
+            time.sleep(0.2)
+        member = FiloServer(ServerConfig.load(str(member_path))).start()
+        # member owns some shards once the coordinator assigns
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if member.node.owned_shards("timeseries"):
+                break
+            time.sleep(0.2)
+        assert member.node.owned_shards("timeseries")
+
+        # kill the coordinator; member must promote and serve everything
+        coord_proc.kill()
+        coord_proc.wait(timeout=10)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if getattr(member, "is_coordinator", False):
+                break
+            time.sleep(0.2)
+        assert member.is_coordinator
+        # all four shards now active on the surviving member
+        deadline = time.monotonic() + 30
+        count = 0
+        while time.monotonic() < deadline:
+            try:
+                body = _get(member.http.port,
+                            "/promql/timeseries/api/v1/query_range",
+                            query='count(fo_metric{_ws_="demo",_ns_="App-0"})',
+                            start=START + 1000, end=START + 1000, step=60)
+            except Exception:
+                time.sleep(0.3)
+                continue
+            res = body["data"]["result"]
+            if res:
+                count = float(res[0]["values"][0][1])
+                if count == 8:
+                    break
+            time.sleep(0.3)
+        assert count == 8.0
+    finally:
+        if coord_proc.poll() is None:
+            coord_proc.kill()
+        if member is not None:
+            member.shutdown()
