@@ -137,6 +137,10 @@ class RemotePlanDispatcher(PlanDispatcher):
                                             timeout=self.timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             pool[key] = sock
+        # pooled sockets are shared across dispatcher instances; apply this
+        # dispatcher's timeout (a prior short-timeout ping must not poison a
+        # later long call)
+        sock.settimeout(self.timeout)
         return sock
 
     def _drop_conn(self):
