@@ -154,8 +154,11 @@ class TimeSeriesPartition:
                 if col.ctype == ColumnType.DOUBLE}
             attach_pages(chunk, b.ts[: b.n].copy(), float_cols)
         self._chunk_seq = (self._chunk_seq + 1) & 0xFFF
-        self.chunks.append(chunk)
+        # swap the buffer BEFORE publishing the chunk: a concurrent reader
+        # (reads chunks first, then the buffer) can momentarily miss the
+        # sealed samples but can never double-count them
         self._buf = self._new_buffers()
+        self.chunks.append(chunk)
         return chunk
 
     # ---- flush -----------------------------------------------------------
@@ -238,10 +241,13 @@ class TimeSeriesPartition:
                 val_parts.append(vals.rows[mask])
             else:
                 val_parts.append(np.asarray(vals)[mask])
-        # append the active write buffer directly (no encode round-trip)
+        # append the active write buffer directly (no encode round-trip);
+        # snapshot the fill count ONCE — a concurrent ingester may append
+        # while we read (readers see a consistent prefix)
         b = self._buf
-        if b.n:
-            bts = b.ts[: b.n]
+        n = b.n
+        if n:
+            bts = b.ts[:n]
             mask = (bts >= start) & (bts <= end)
             if mask.any():
                 ts_parts.append(bts[mask].copy())
@@ -250,11 +256,11 @@ class TimeSeriesPartition:
                 if colspec.ctype == ColumnType.HISTOGRAM:
                     les = (self.bucket_les if self.bucket_les is not None
                            else les)
-                    rows = (data[: b.n] if data is not None
-                            else np.zeros((b.n, 0), np.int64))
+                    rows = (data[:n] if data is not None
+                            else np.zeros((n, 0), np.int64))
                     val_parts.append(rows[mask].copy())
                 else:
-                    val_parts.append(np.asarray(data[: b.n])[mask].copy())
+                    val_parts.append(np.asarray(data[:n])[mask].copy())
         if not ts_parts:
             empty = np.array([], np.int64)
             return empty, (HistogramColumn(np.array([]), np.zeros((0, 0), np.int64))

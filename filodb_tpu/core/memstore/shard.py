@@ -106,7 +106,11 @@ class TimeSeriesShard:
                                 ) -> TimeSeriesPartition:
         pid = self._by_key.get(key)
         if pid is not None:
-            return self.partitions[pid]
+            part = self.partitions[pid]
+            if part is not None:
+                return part
+            # a concurrent purge raced this lookup; fall through to recreate
+            self._by_key.pop(key, None)
         self.cardinality.series_created(key.label_map)  # may raise quota
         schema = self.schemas[key.schema]
         pid = len(self.partitions)
@@ -217,8 +221,18 @@ class TimeSeriesShard:
         return written
 
     def flush_all(self, ingestion_time: int | None = None) -> int:
-        return sum(self.flush_group(g, ingestion_time)
-                   for g in range(self.config.groups_per_shard))
+        """Flush every group; groups run concurrently up to
+        ``flush_task_parallelism`` (reference ``flush-task-parallelism``,
+        ``TimeSeriesMemStore.scala:130-135``). Group flushes touch disjoint
+        partitions, so they parallelize safely."""
+        par = max(self.config.flush_task_parallelism, 1)
+        groups = range(self.config.groups_per_shard)
+        if par == 1:
+            return sum(self.flush_group(g, ingestion_time) for g in groups)
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=par) as ex:
+            return sum(ex.map(
+                lambda g: self.flush_group(g, ingestion_time), groups))
 
     def next_flush_group(self) -> int:
         """Round-robin group scheduling (the reference staggers groups across
