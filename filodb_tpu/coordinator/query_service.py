@@ -61,6 +61,7 @@ class QueryService:
         ctx = ExecContext(self.memstore, self.dataset, qcontext)
         with query_latency.time():
             result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
+            result.result.materialize()  # device → host once, at the boundary
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
         return result

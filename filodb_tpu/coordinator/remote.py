@@ -92,6 +92,7 @@ class PlanExecutorServer:
                 ctx = ExecContext(self.memstore, dataset,
                                   qcontext or QueryContext())
                 result = plan.execute(ctx)
+                result.result.materialize()  # pickle host arrays, not device
                 return ("ok", result)
             except Exception as e:
                 log.exception("plan execution failed")

@@ -143,13 +143,6 @@ class SelectRawPartitionsExec(ExecPlan):
         by_schema: dict[str, list] = {}
         for p in parts:
             by_schema.setdefault(p.schema.name, []).append(p)
-        # on-demand paging: pull cold chunks for partitions whose in-memory
-        # data doesn't reach back to the query start
-        extra_chunks = None
-        if shard.config.demand_paging_enabled:
-            from filodb_tpu.core.memstore.odp import page_partitions
-            extra_chunks = page_partitions(shard, parts, self.chunk_start,
-                                           self.chunk_end, shard.odp_cache)
         outs = []
         version = shard.data_version
         for schema_name, sparts in by_schema.items():
@@ -161,6 +154,15 @@ class SelectRawPartitionsExec(ExecPlan):
             if cached is not None and cached[0] == version:
                 _, batch, keys, is_counter = cached
             else:
+                # on-demand paging: pull cold chunks for partitions whose
+                # in-memory data doesn't reach back to the query start
+                # (skipped on cache hits — resident data didn't change)
+                extra_chunks = None
+                if shard.config.demand_paging_enabled:
+                    from filodb_tpu.core.memstore.odp import page_partitions
+                    extra_chunks = page_partitions(
+                        shard, sparts, self.chunk_start, self.chunk_end,
+                        shard.odp_cache)
                 if self._use_device_path(shard, schema, col):
                     from filodb_tpu.query.engine.device_batch import (
                         build_device_batch,

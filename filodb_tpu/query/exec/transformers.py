@@ -77,11 +77,10 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
                 out = kernels.range_eval_masked(
                     fn, ts_j, vals_j, valid_j, steps_j, win_j,
                     counter=self.is_counter)
-            out = np.asarray(out)[: batch.num_series]
+            out = out[: batch.num_series]  # stays on device (lazy transfer)
             if fn == "timestamp":
                 out = out + batch.base_ts / 1000.0
-            return StepMatrix(self._out_keys(keys), out.astype(np.float64),
-                              steps)
+            return StepMatrix(self._out_keys(keys), out, steps)
 
         ts_j, vals_j, counts_j = batch.device_arrays()
 
@@ -112,9 +111,10 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         else:
             out = kernels.range_eval(fn, ts_j, vals_j, counts_j, steps_j,
                                      win_j, counter=self.is_counter)
-        out = np.asarray(out)[: batch.num_series]
-        if fn == "timestamp" and self.offset == 0:
-            pass  # timestamps already epoch-relative; rebase below
+        # keep the result on device: downstream aggregation consumes it
+        # without a host round trip; the query service materializes the
+        # final result once (StepMatrix tolerates device values)
+        out = out[: batch.num_series]
         if fn == "timestamp":
             # kernel returned relative seconds; rebase to epoch
             out = out + batch.base_ts / 1000.0
@@ -133,6 +133,7 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         P = data.num_series
         if P == 0:
             return StepMatrix([], np.zeros((0, len(steps))), steps)
+        data.materialize()
         # compact per-series NaN samples into padded ts/vals arrays
         inner_ts = data.steps_ms  # [S]
         S = len(inner_ts)

@@ -85,6 +85,7 @@ class StepMatrix:
         """Drop series with no samples at all."""
         if self.num_series == 0:
             return self
+        self.materialize()  # boolean masking needs host arrays
         if self.is_histogram:
             keep = ~np.all(np.isnan(self.values[:, :, -1]), axis=1)
         else:
@@ -99,13 +100,21 @@ class StepMatrix:
         steps = steps_ms if steps_ms is not None else np.array([], np.int64)
         return StepMatrix([], np.zeros((0, len(steps))), steps)
 
+    def materialize(self) -> "StepMatrix":
+        """Force device-resident values to host numpy (API boundary)."""
+        if not isinstance(self.values, np.ndarray):
+            self.values = np.asarray(self.values)
+        return self
+
     @staticmethod
     def concat(parts: list["StepMatrix"]) -> "StepMatrix":
         parts = [p for p in parts if p.num_series > 0]
         if not parts:
             return StepMatrix.empty()
+        if len(parts) == 1:
+            return parts[0]  # keep possibly-device values intact
         keys = [k for p in parts for k in p.keys]
-        values = np.concatenate([p.values for p in parts], axis=0)
+        values = np.concatenate([np.asarray(p.values) for p in parts], axis=0)
         return StepMatrix(keys, values, parts[0].steps_ms, parts[0].les)
 
 
