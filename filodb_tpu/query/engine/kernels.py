@@ -339,10 +339,21 @@ def _linreg(ts, v, valid, lo, hi, steps, slope_only: bool, horizon_s=0.0):
 def quantile_over_time(q, ts, vals, counts, steps, window, block: int = 16):
     """phi-quantile over each window. Masked sort per window, blocked over
     steps to bound the [P, block, S] working set."""
+    return _quantile_impl(q, ts, vals, _valid_mask(ts, counts), steps,
+                          window, block)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def quantile_over_time_masked(q, ts, vals, valid, steps, window,
+                              block: int = 16):
+    return _quantile_impl(q, ts, vals, valid, steps, window, block)
+
+
+def _quantile_impl(q, ts, vals, valid, steps, window, block: int):
     dt = fdtype()
     vals = vals.astype(dt)
-    valid = _valid_mask(ts, counts)
     lo, hi = window_bounds(ts, steps, window)
+    vcount = _eprefix(valid.astype(dt))
     K = steps.shape[0]
     S = ts.shape[1]
     pad_k = (-K) % block
@@ -357,7 +368,8 @@ def quantile_over_time(q, ts, vals, counts, steps, window, block: int = 16):
         in_win = (s_idx >= lo_b[:, :, None]) & (s_idx < hi_b[:, :, None])
         masked = jnp.where(in_win & valid[:, None, :], vals[:, None, :], jnp.inf)
         srt = jnp.sort(masked, axis=-1)
-        n = (hi_b - lo_b).astype(dt)
+        n = (jnp.take_along_axis(vcount, hi_b, axis=1)
+             - jnp.take_along_axis(vcount, lo_b, axis=1)).astype(dt)
         pos = q * jnp.maximum(n - 1.0, 0.0)
         i0 = jnp.floor(pos).astype(jnp.int32)
         frac = pos - i0
@@ -379,9 +391,18 @@ def holt_winters(sf, tf, ts, vals, counts, steps, window):
     Sequential by nature: a scan over samples carrying (level, trend) per
     (series, step) window. O(S) scan with [P, K] state.
     """
+    return _holt_impl(sf, tf, ts, vals, _valid_mask(ts, counts), steps,
+                      window)
+
+
+@jax.jit
+def holt_winters_masked(sf, tf, ts, vals, valid, steps, window):
+    return _holt_impl(sf, tf, ts, vals, valid, steps, window)
+
+
+def _holt_impl(sf, tf, ts, vals, valid, steps, window):
     dt = fdtype()
     vals = vals.astype(dt)
-    valid = _valid_mask(ts, counts)
     lo, hi = window_bounds(ts, steps, window)
     S = ts.shape[1]
     P, K = lo.shape

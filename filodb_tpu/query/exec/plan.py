@@ -208,18 +208,10 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def _use_device_path(self, shard, schema, col) -> bool:
         """Decode-on-device path: enabled per store config, for scalar float
-        columns only (histograms and the quantile/holt-winters transformers
-        use the host-decoded path)."""
+        columns (histogram columns use the host-decoded path)."""
         if not getattr(shard.config, "device_pages", False):
             return False
-        if schema.data.columns[col].ctype != ColumnType.DOUBLE:
-            return False
-        from filodb_tpu.query.exec.transformers import PeriodicSamplesMapper
-        psm = self.transformers[0] if self.transformers else None
-        if isinstance(psm, PeriodicSamplesMapper) and psm.function in (
-                "quantile_over_time", "holt_winters"):
-            return False
-        return True
+        return schema.data.columns[col].ctype == ColumnType.DOUBLE
 
     def _value_col_index(self, schema) -> int:
         if self.value_column:

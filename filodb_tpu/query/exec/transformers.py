@@ -69,7 +69,14 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         if getattr(batch, "masked", False):
             # device-decoded masked batch (engine/device_batch.py)
             ts_j, vals_j, valid_j = batch.device_arrays()
-            if fn == "predict_linear":
+            if fn == "quantile_over_time":
+                out = kernels.quantile_over_time_masked(
+                    self.params[0], ts_j, vals_j, valid_j, steps_j, win_j)
+            elif fn == "holt_winters":
+                out = kernels.holt_winters_masked(
+                    self.params[0], self.params[1], ts_j, vals_j, valid_j,
+                    steps_j, win_j)
+            elif fn == "predict_linear":
                 out = kernels.range_eval_masked(
                     fn, ts_j, vals_j, valid_j, steps_j, win_j,
                     extra=float(self.params[0]))

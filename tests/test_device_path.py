@@ -77,14 +77,16 @@ class TestDevicePathCounters:
         np.testing.assert_allclose(r_d.values, r_h.values, rtol=5e-5,
                                    atol=1e-4, equal_nan=True)
 
-    def test_quantile_falls_back_to_host(self):
+    def test_quantile_and_holt_winters_on_device(self):
         keys = machine_metrics_series(3)
-        _, dev = _pair_of_services(
+        host, dev = _pair_of_services(
             lambda: [gauge_stream(keys, 200, start_ms=START * 1000)])
-        r = dev.query_range('quantile_over_time(0.9, heap_usage[5m])',
-                            START + 900, 300, START + 1800).result
-        assert r.num_series == 3
-        assert np.isfinite(r.values).any()
+        for q in ('quantile_over_time(0.9, heap_usage[5m])',
+                  'holt_winters(heap_usage[10m], 0.5, 0.1)'):
+            r_h = host.query_range(q, START + 900, 300, START + 1800).result
+            r_d = dev.query_range(q, START + 900, 300, START + 1800).result
+            np.testing.assert_allclose(r_d.values, r_h.values, rtol=2e-5,
+                                       atol=1e-4, equal_nan=True, err_msg=q)
 
     def test_write_buffer_included(self):
         # unsealed buffer samples must appear in device-path results
