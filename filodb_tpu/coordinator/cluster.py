@@ -65,7 +65,9 @@ class Node:
     memstore: TimeSeriesMemStore
     alive: bool = True
     executor_port: int | None = None  # set when fronted by PlanExecutorServer
+    flush_tick_s: float | None = None  # override scheduler cadence (tests)
     _workers: dict = field(default_factory=dict)  # (dataset, shard) -> worker
+    _flusher: object = None
 
     def start_shard(self, dataset: str, shard: int, config: IngestionConfig,
                     shard_log: ReplayLog, on_status=None) -> None:
@@ -86,6 +88,9 @@ class Node:
         worker = _IngestWorker(self, s, shard_log, start_offset, on_status)
         self._workers[key] = worker
         worker.start()
+        if self._flusher is None:
+            self._flusher = _FlushScheduler(self, self.flush_tick_s)
+            self._flusher.start()
 
     def stop_shard(self, dataset: str, shard: int) -> None:
         w = self._workers.pop((dataset, shard), None)
@@ -99,9 +104,63 @@ class Node:
         for w in list(self._workers.values()):
             w.stop()
         self._workers.clear()
+        if self._flusher is not None:
+            self._flusher.stop()
+            self._flusher = None
 
     def owned_shards(self, dataset: str) -> list[int]:
         return sorted(s for (d, s) in self._workers if d == dataset)
+
+
+class _FlushScheduler(threading.Thread):
+    """Per-node flush scheduler: walks each owned shard's flush groups
+    round-robin, spacing group flushes so one full cycle spans the store's
+    flush interval (reference time-staggered ``createFlushTasks``,
+    ``TimeSeriesShard.scala:889``); also drives retention purge and
+    memory-pressure eviction."""
+
+    def __init__(self, node: "Node", tick_s: float | None = None):
+        super().__init__(daemon=True, name=f"flush-{node.name}")
+        self.node = node
+        self.tick_s = tick_s
+        self._stop_ev = threading.Event()
+
+    def run(self):
+        while not self._stop_ev.wait(self._next_tick()):
+            if not self.node.alive:
+                return
+            for (dataset, shard_num) in list(self.node._workers):
+                try:
+                    shard = self.node.memstore.get_shard(dataset, shard_num)
+                except KeyError:
+                    continue
+                try:
+                    shard.flush_group(shard.next_flush_group())
+                    shard.enforce_memory()
+                    shard.purge_expired(int(time.time() * 1000))
+                except Exception:
+                    log.exception("scheduled flush failed for %s/%d",
+                                  dataset, shard_num)
+
+    def _next_tick(self) -> float:
+        if self.tick_s is not None:
+            return self.tick_s
+        # spacing = flush_interval / groups, bounded for sane defaults
+        interval = 3_600.0
+        groups = 20
+        for (dataset, shard_num) in list(self.node._workers):
+            try:
+                cfg = self.node.memstore.get_shard(dataset,
+                                                   shard_num).config
+                interval = cfg.flush_interval_ms / 1000.0
+                groups = cfg.groups_per_shard
+                break
+            except KeyError:
+                continue
+        return max(min(interval / max(groups, 1), 300.0), 0.5)
+
+    def stop(self):
+        self._stop_ev.set()
 
 
 class _IngestWorker(threading.Thread):

@@ -76,6 +76,11 @@ class TimeSeriesShard:
         self._dirty_part_keys: set[int] = set()
         self._last_flushed_group = -1
         self._ingested_offset = -1
+        # serializes buffer mutation between the ingest thread and the flush
+        # scheduler (the reference runs buffer switching ON the ingest
+        # scheduler; here a lock keeps flush callable from any thread)
+        import threading as _threading
+        self.write_lock = _threading.Lock()
         # cardinality metering + quotas (reference ratelimit/)
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
         self.cardinality = CardinalityTracker(shard_num)
@@ -155,9 +160,12 @@ class TimeSeriesShard:
                 raise AssertionError(
                     f"shard {self.shard_num} ingested from thread {tid}, "
                     f"owner is {owner}")
-        n = 0
-        offset = data.offset
+        with self.write_lock:
+            return self._ingest_locked(data, data.offset)
+
+    def _ingest_locked(self, data: SomeData, offset: int) -> int:
         from filodb_tpu.core.memstore.cardinality import QuotaExceededError
+        n = 0
         for rec in data.container:
             group = self.group_of(rec.part_key)
             if offset <= self.group_watermarks[group]:
@@ -194,7 +202,8 @@ class TimeSeriesShard:
         for part in self.partitions:
             if part is None or self.group_of(part.part_key) != group:
                 continue
-            chunks = part.make_flush_chunks()
+            with self.write_lock:
+                chunks = part.make_flush_chunks()
             if chunks:
                 self.column_store.write_chunks(
                     self.dataset, self.shard_num, part.part_key, chunks,
@@ -272,16 +281,17 @@ class TimeSeriesShard:
         (reference TTL purge ``TimeSeriesShard.scala:838``)."""
         cutoff = now_ms - self.config.retention_ms
         purged = 0
-        for pid, part in enumerate(self.partitions):
-            if part is None:
-                continue
-            latest = part.latest_ts
-            if latest != -1 and latest < cutoff:
-                self.index.remove_part_key(pid)
-                del self._by_key[part.part_key]
-                self.partitions[pid] = None
-                self.cardinality.series_stopped(part.part_key.label_map)
-                purged += 1
+        with self.write_lock:
+            for pid, part in enumerate(self.partitions):
+                if part is None:
+                    continue
+                latest = part.latest_ts
+                if latest != -1 and latest < cutoff:
+                    self.index.remove_part_key(pid)
+                    del self._by_key[part.part_key]
+                    self.partitions[pid] = None
+                    self.cardinality.series_stopped(part.part_key.label_map)
+                    purged += 1
         if purged:
             self.stats.partitions_purged.inc(purged)
             self.stats.num_partitions.set(len(self._by_key))

@@ -202,3 +202,36 @@ class TestRemoteDispatch:
     def test_ping_dead_server(self):
         disp = RemotePlanDispatcher("127.0.0.1", 1, timeout=0.3)
         assert not disp.ping()
+
+
+class TestFlushScheduler:
+    def test_scheduled_flush_persists_chunks(self):
+        import time as _time
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        cluster = FilodbCluster()
+        node = Node("n1", TimeSeriesMemStore(cs, meta), flush_tick_s=0.05)
+        cluster.join(node)
+        logs = {0: InMemoryLog(), 1: InMemoryLog()}
+        keys = machine_metrics_series(4)
+        _publish(logs, gauge_stream(keys, 120, start_ms=START * 1000), 2)
+        config = IngestionConfig(
+            "timeseries", 2,
+            store=StoreConfig(max_chunk_size=30, groups_per_shard=2))
+        cluster.setup_dataset(config, logs)
+        assert cluster.wait_active("timeseries", 5)
+        # scheduler flushes groups on its own; sealed chunks reach the store
+        deadline = _time.monotonic() + 10
+        total = 0
+        while _time.monotonic() < deadline:
+            total = sum(len(cs.read_chunks("timeseries", s, k, 0, 2**62))
+                        for s in range(2) for k in keys)
+            if total >= 4 * 3:  # 120 samples / 30 per chunk per series
+                break
+            _time.sleep(0.1)
+        assert total >= 4 * 3
+        # checkpoints advanced too
+        cps = {}
+        for s in range(2):
+            cps.update(meta.read_checkpoints("timeseries", s))
+        assert cps
+        cluster.stop()
