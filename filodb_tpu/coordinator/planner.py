@@ -54,18 +54,26 @@ class SingleClusterPlanner(QueryPlanner):
     # leaves read this store instead of the exec context's (downsample plans)
     store: object = None
     dataset_name_override: str | None = None
+    # per-shard-key spread overrides (reference application spread config,
+    # ``QueryActor.scala:56-70``): maps non-metric shard-key values
+    # (e.g. ("demo", "App-big")) to a spread
+    spread_overrides: dict = None
 
     # ---- shard selection ------------------------------------------------
 
     def shards_for_filters(self, filters, spread: int | None = None
                            ) -> list[int]:
         """Prune fan-out using shard-key equality filters
-        (reference ``SingleClusterPlanner.shardsFromFilters``); per-query
-        spread overrides take precedence (reference QueryActor spread
-        overrides)."""
-        spread = self.spread if spread is None else spread
+        (reference ``SingleClusterPlanner.shardsFromFilters``). Spread
+        precedence: per-query override > per-shard-key config override >
+        planner default (reference QueryActor spread overrides)."""
         eq = {f.column: f.filter.value for f in filters
               if isinstance(f.filter, Equals)}
+        if spread is None and self.spread_overrides:
+            key = tuple(eq.get(lbl) for lbl in self.shard_key_labels
+                        if lbl != "_metric_")
+            spread = self.spread_overrides.get(key)
+        spread = self.spread if spread is None else spread
         if all(lbl in eq for lbl in self.shard_key_labels):
             skh = shard_key_hash({k: eq[k] for k in self.shard_key_labels})
             return shards_for_shard_key(skh, self.num_shards, spread)

@@ -309,3 +309,34 @@ def _match_key(truth, key):
         if all(tm.get(lk) == lv for lk, lv in lm.items()):
             return k
     raise KeyError(key)
+
+
+class TestSpreadOverrides:
+    def test_per_key_spread_override(self, counter_svc):
+        svc, keys = counter_svc
+        # override spread for (demo, App-1): fan out to all 4 shards
+        svc.planner.spread_overrides = {("demo", "App-1"): 2}
+        shards = svc.planner.shards_for_filters(
+            [__import__("filodb_tpu.core.filters", fromlist=["ColumnFilter"])
+             .ColumnFilter(lbl, __import__(
+                 "filodb_tpu.core.filters", fromlist=["Equals"]).Equals(v))
+             for lbl, v in (("_ws_", "demo"), ("_ns_", "App-1"),
+                            ("_metric_", "http_requests_total"))])
+        assert len(shards) == 4
+        # queries still correct at the wider spread
+        r = svc.query_range(
+            'sum(rate(http_requests_total{_ws_="demo",_ns_="App-1"}[5m]))',
+            START + 3600, 300, START + 4500)
+        assert r.result.num_series == 1
+        svc.planner.spread_overrides = None
+
+    def test_per_query_spread_beats_config(self, counter_svc):
+        svc, _ = counter_svc
+        from filodb_tpu.query.model import PlannerParams, QueryContext
+        svc.planner.spread_overrides = {("demo", "App-1"): 0}
+        qc = QueryContext(planner_params=PlannerParams(spread=2))
+        r = svc.query_range(
+            'rate(http_requests_total{_ws_="demo",_ns_="App-1"}[5m])',
+            START + 3600, 300, START + 4500, qcontext=qc)
+        assert r.result.num_series == 6
+        svc.planner.spread_overrides = None
