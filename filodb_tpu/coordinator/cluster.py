@@ -213,7 +213,11 @@ class FilodbCluster:
     configs: dict[str, IngestionConfig] = field(default_factory=dict)
     logs: dict[tuple[str, int], ReplayLog] = field(default_factory=dict)
     heartbeat_interval_s: float = 0.05
+    # consecutive missed heartbeats before a node is declared down (the
+    # reference's phi-accrual detector likewise tolerates transient misses)
+    failure_threshold: int = 3
     on_heartbeat: list = field(default_factory=list)  # callbacks per tick
+    _hb_misses: dict = field(default_factory=dict)
     _hb_thread: threading.Thread | None = None
     _stop_hb: threading.Event = field(default_factory=threading.Event)
 
@@ -274,10 +278,17 @@ class FilodbCluster:
 
     def _hb_loop(self):
         while not self._stop_hb.wait(self.heartbeat_interval_s):
-            dead = [n for n, node in self.nodes.items() if not node.alive]
-            for name in dead:
-                log.warning("failure detector: node %s down", name)
-                self.leave(name)
+            for name, node in list(self.nodes.items()):
+                if node.alive:
+                    self._hb_misses[name] = 0
+                    continue
+                misses = self._hb_misses.get(name, 0) + 1
+                self._hb_misses[name] = misses
+                if misses >= self.failure_threshold:
+                    log.warning("failure detector: node %s down "
+                                "(%d missed heartbeats)", name, misses)
+                    self.leave(name)
+                    self._hb_misses.pop(name, None)
             for cb in self.on_heartbeat:
                 try:
                     cb()

@@ -79,9 +79,19 @@ class FiloServer:
 
     def _handle_join(self, name: str, host: str, control_port: int):
         """Coordinator side: a remote member joined (reference
-        NodeClusterActor member-up)."""
+        NodeClusterActor member-up). Shard assignment (which calls back to
+        the member) runs off the handler thread so the join reply isn't held
+        hostage to the member's own startup."""
+        import threading
         from filodb_tpu.coordinator.bootstrap import RemoteNodeHandle
-        self.cluster.join(RemoteNodeHandle(name, host, control_port))
+
+        def do_join():
+            try:
+                self.cluster.join(RemoteNodeHandle(name, host, control_port))
+            except Exception:
+                log.exception("join of %s failed", name)
+
+        threading.Thread(target=do_join, daemon=True).start()
         return True
 
     def start(self) -> "FiloServer":
