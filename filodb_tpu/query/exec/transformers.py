@@ -27,6 +27,9 @@ from filodb_tpu.query.engine.instantfns import apply_binary_op, apply_instant_fn
 from filodb_tpu.query.model import RangeVectorKey, ScalarResult, StepMatrix
 
 
+_GID_CACHE: dict = {}
+
+
 class RangeVectorTransformer:
     def apply(self, data: StepMatrix) -> StepMatrix:  # pragma: no cover
         raise NotImplementedError
@@ -176,16 +179,30 @@ class AggregateMapReduce(RangeVectorTransformer):
             return [k.without(self.without).drop_metric() for k in keys]
         return [RangeVectorKey(()) for _ in keys]
 
-    def apply(self, data: StepMatrix) -> StepMatrix:
-        if data.num_series == 0:
-            return data
-        gkeys = self.group_keys(data.keys)
+    def _group_ids(self, keys):
+        # group-id computations repeat across queries over cached batches
+        # (the keys list object is stable); memoize on list identity. Entries
+        # hold the keys list itself so the id can't be recycled while cached.
+        ck = (id(keys), self.by, self.without)
+        hit = _GID_CACHE.get(ck)
+        if hit is not None and hit[0] is keys:
+            return hit[1], hit[2]
+        gkeys = self.group_keys(keys)
         uniq: dict[RangeVectorKey, int] = {}
         gids = np.empty(len(gkeys), np.int32)
         for i, gk in enumerate(gkeys):
             gids[i] = uniq.setdefault(gk, len(uniq))
         out_keys = list(uniq.keys())
-        G = len(uniq)
+        if len(_GID_CACHE) >= 128:
+            _GID_CACHE.pop(next(iter(_GID_CACHE)))
+        _GID_CACHE[ck] = (keys, gids, out_keys)
+        return gids, out_keys
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if data.num_series == 0:
+            return data
+        gids, out_keys = self._group_ids(data.keys)
+        G = len(out_keys)
         v = jnp.asarray(data.values)
         g = jnp.asarray(gids)
 
