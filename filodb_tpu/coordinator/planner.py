@@ -140,9 +140,11 @@ class SingleClusterPlanner(QueryPlanner):
         parts = []
         for s, e in self._split_ranges(plan.start, plan.step, plan.end):
             mapper = tf.PeriodicSamplesMapper(
-                s, plan.step, e, window=0, function=None, offset=plan.offset)
-            raw = lp.RawSeries(plan.raw.filters, s, e, plan.raw.lookback,
-                               plan.raw.offset, plan.raw.column)
+                s, plan.step, e, window=0, function=None, offset=plan.offset,
+                at_ms=plan.at_ms)
+            raw = (plan.raw if plan.at_ms is not None else
+                   lp.RawSeries(plan.raw.filters, s, e, plan.raw.lookback,
+                                plan.raw.offset, plan.raw.column))
             parts.append(self._concat(self._leaves(raw, q, mapper)))
         if len(parts) == 1:
             return parts[0]
@@ -154,10 +156,11 @@ class SingleClusterPlanner(QueryPlanner):
         for s, e in self._split_ranges(plan.start, plan.step, plan.end):
             mapper = tf.PeriodicSamplesMapper(
                 s, plan.step, e, window=plan.window, function=plan.function,
-                params=plan.params, offset=plan.offset)
-            raw = lp.RawSeries(plan.raw.filters, s, e,
-                               max(plan.raw.lookback, plan.window),
-                               plan.raw.offset, plan.raw.column)
+                params=plan.params, offset=plan.offset, at_ms=plan.at_ms)
+            raw = (plan.raw if plan.at_ms is not None else
+                   lp.RawSeries(plan.raw.filters, s, e,
+                                max(plan.raw.lookback, plan.window),
+                                plan.raw.offset, plan.raw.column))
             parts.append(self._concat(self._leaves(raw, q, mapper)))
         if len(parts) == 1:
             return parts[0]

@@ -284,16 +284,40 @@ class Parser:
                                       self.params.step_ms)
                 d = -d if neg else d
                 if isinstance(e, _Selector):
-                    e = _Selector(e.filters, offset=e.offset + d)
+                    e = _Selector(e.filters, e.offset + d, e.at_ms)
                 elif isinstance(e, _RangeExpr):
-                    e = _RangeExpr(_Selector(e.sel.filters,
-                                             offset=e.sel.offset + d), e.window)
+                    e = _RangeExpr(_Selector(e.sel.filters, e.sel.offset + d,
+                                             e.sel.at_ms), e.window)
                 elif isinstance(e, _Subquery):
-                    e = _Subquery(e.inner, e.window, e.step, e.offset + d)
+                    e = _Subquery(e.inner, e.window, e.step, e.offset + d,
+                                  e.at_ms)
                 else:
                     raise ParseError("offset on non-selector")
+            elif self.accept("OP", "@"):
+                at_ms = self._at_modifier()
+                if isinstance(e, _Selector):
+                    e = _Selector(e.filters, e.offset, at_ms)
+                elif isinstance(e, _RangeExpr):
+                    e = _RangeExpr(_Selector(e.sel.filters, e.sel.offset,
+                                             at_ms), e.window)
+                elif isinstance(e, _Subquery):
+                    e = _Subquery(e.inner, e.window, e.step, e.offset, at_ms)
+                else:
+                    raise ParseError("@ on non-selector")
             else:
                 return e
+
+    def _at_modifier(self) -> int:
+        """Parse the @ timestamp: unix seconds, start(), or end()."""
+        t = self.next()
+        if t.kind == "NUMBER":
+            return int(self._num(t.text) * 1000)
+        if t.kind == "IDENT" and t.text in ("start", "end"):
+            self.expect("OP", "(")
+            self.expect("OP", ")")
+            return (self.params.start_ms if t.text == "start"
+                    else self.params.end_ms)
+        raise ParseError(f"bad @ modifier {t.text!r} at {t.pos}")
 
     def parse_atom(self):
         t = self.peek()
@@ -514,7 +538,7 @@ class Parser:
             raw = self._raw(sel, range_arg.window)
             return lp.PeriodicSeriesWithWindowing(
                 raw, p.start_ms, p.step_ms, p.end_ms, range_arg.window, name,
-                fn_params, sel.offset)
+                fn_params, sel.offset, sel.at_ms)
 
         if name in lp.INSTANT_FUNCTIONS:
             vec = None
@@ -567,13 +591,17 @@ class Parser:
 
     def _raw(self, sel: "_Selector", lookback: int) -> lp.RawSeries:
         p = self.params
+        if sel.at_ms is not None:
+            # @ pins evaluation: the chunk range collapses to that instant
+            return lp.RawSeries(sel.filters, sel.at_ms, sel.at_ms, lookback,
+                                sel.offset)
         return lp.RawSeries(sel.filters, p.start_ms, p.end_ms, lookback,
                             sel.offset)
 
     def _periodicize(self, sel: "_Selector") -> lp.PeriodicSeries:
         p = self.params
         return lp.PeriodicSeries(self._raw(sel, self.lookback), p.start_ms,
-                                 p.step_ms, p.end_ms, sel.offset)
+                                 p.step_ms, p.end_ms, sel.offset, sel.at_ms)
 
     def _binary(self, op, left, right, matching, bool_mode: bool = False):
         on, ignoring, card, include = matching or (None, (), "one-to-one", ())
@@ -634,6 +662,7 @@ class _Str:
 class _Selector:
     filters: tuple[ColumnFilter, ...]
     offset: int = 0
+    at_ms: "int | None" = None
 
 
 @dataclass(frozen=True)
@@ -648,6 +677,7 @@ class _Subquery:
     window: int
     step: int
     offset: int = 0
+    at_ms: "int | None" = None
 
 
 # ---------------------------------------------------------------------------

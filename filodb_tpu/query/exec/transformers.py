@@ -56,13 +56,18 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
     function: str | None = None  # None => instant last-sample semantics
     params: tuple = ()
     offset: int = 0
+    at_ms: "int | None" = None  # @ modifier: pin evaluation time
     is_counter: bool = False
     keep_metric: bool = False
 
     def eval_batch(self, batch: SeriesBatch,
                    keys: list[RangeVectorKey]) -> StepMatrix:
         steps = steps_array(self.start, self.step, self.end)
-        eval_steps = steps - self.offset
+        if self.at_ms is not None:
+            eval_steps = np.full(len(steps), self.at_ms - self.offset,
+                                 np.int64)
+        else:
+            eval_steps = steps - self.offset
         rel_steps = (eval_steps - batch.base_ts).astype(np.int32)
         fn = self.function or "last_sample"
         window = self.window if self.function else 300_000  # staleness lookback

@@ -89,6 +89,7 @@ class PeriodicSeries(LogicalPlan):
     step: int
     end: int
     offset: int = 0
+    at_ms: int | None = None  # @ modifier: fixed evaluation time
 
 
 @dataclass(frozen=True)
@@ -104,6 +105,7 @@ class PeriodicSeriesWithWindowing(LogicalPlan):
     function: str  # one of RANGE_FUNCTIONS
     params: tuple = ()
     offset: int = 0
+    at_ms: int | None = None  # @ modifier: fixed evaluation time
 
 
 @dataclass(frozen=True)

@@ -61,14 +61,19 @@ def _offset_suffix(offset: int) -> str:
     return f" offset {_dur(offset)}" if offset else ""
 
 
+def _at_suffix(at_ms) -> str:
+    return f" @ {at_ms // 1000}" if at_ms is not None else ""
+
+
 def to_promql(plan: lp.LogicalPlan) -> str:
     """Render a LogicalPlan back to PromQL."""
     if isinstance(plan, lp.PeriodicSeries):
         return _selector(plan.raw.filters, plan.raw.column) \
-            + _offset_suffix(plan.offset)
+            + _offset_suffix(plan.offset) + _at_suffix(plan.at_ms)
     if isinstance(plan, lp.PeriodicSeriesWithWindowing):
         sel = _selector(plan.raw.filters, plan.raw.column)
-        rng = f"{sel}[{_dur(plan.window)}]{_offset_suffix(plan.offset)}"
+        rng = (f"{sel}[{_dur(plan.window)}]{_offset_suffix(plan.offset)}"
+               f"{_at_suffix(plan.at_ms)}")
         args = [rng]
         if plan.function == "quantile_over_time":
             args = [str(plan.params[0]), rng]

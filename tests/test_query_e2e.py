@@ -340,3 +340,37 @@ class TestSpreadOverrides:
             START + 3600, 300, START + 4500, qcontext=qc)
         assert r.result.num_series == 6
         svc.planner.spread_overrides = None
+
+
+class TestAtModifier:
+    def test_at_pins_evaluation_time(self, gauge_svc):
+        svc, _ = gauge_svc
+        at = START + 3600
+        r = svc.query_range(f'heap_usage @ {at}', START + 3600, 300,
+                            START + 5400).result
+        # every step carries the value at the pinned instant
+        for k in range(r.num_steps):
+            np.testing.assert_allclose(r.values[:, k], r.values[:, 0],
+                                       rtol=0, equal_nan=True)
+        direct = svc.query_range('heap_usage', at, 60, at).result
+        np.testing.assert_allclose(np.sort(r.values[:, 0]),
+                                   np.sort(direct.values[:, 0]), rtol=1e-9)
+
+    def test_at_start_end(self, gauge_svc):
+        svc, _ = gauge_svc
+        r1 = svc.query_range('heap_usage @ start()', START + 3600, 300,
+                             START + 4500).result
+        r2 = svc.query_range('heap_usage', START + 3600, 60,
+                             START + 3600).result
+        np.testing.assert_allclose(np.sort(r1.values[:, 0]),
+                                   np.sort(r2.values[:, 0]), rtol=1e-9)
+
+    def test_at_with_range_function(self, counter_svc):
+        svc, _ = counter_svc
+        at = START + 4000
+        r = svc.query_range(
+            f'sum(rate(http_requests_total[5m] @ {at}))',
+            START + 3600, 300, START + 5400).result
+        for k in range(r.num_steps):
+            np.testing.assert_allclose(r.values[0, k], r.values[0, 0],
+                                       rtol=0)
