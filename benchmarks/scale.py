@@ -1,0 +1,105 @@
+"""Series-scale benchmark: how many actively-ingesting series one node holds.
+
+The reference claims ~1M+ actively ingesting series per node, memory-bound
+(``README.md:409-413``). This benchmark ingests N series with a few samples
+each, reports per-series memory and sustained ingest rate at that
+cardinality, then runs an indexed query over a 1%-of-N shard-key slice.
+
+    python benchmarks/scale.py [--series 1000000] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import os
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--series", type=int, default=1_000_000)
+    ap.add_argument("--samples", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import METRIC_LABEL, PartKey
+    from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
+    from filodb_tpu.core.store.config import StoreConfig
+
+    ms = TimeSeriesMemStore()
+    # small chunk size bounds the per-series write-buffer footprint, the way
+    # the reference sizes WriteBufferPool appenders for high cardinality
+    shard = ms.setup("scale", 0, StoreConfig(max_chunk_size=64,
+                                             groups_per_shard=64))
+    rss0 = rss_mb()
+    n = args.series
+    t0 = time.perf_counter()
+    batch = 20_000
+    for lo in range(0, n, batch):
+        c = RecordContainer()
+        hi = min(lo + batch, n)
+        for i in range(lo, hi):
+            key = PartKey.create("gauge", {
+                METRIC_LABEL: "scale_metric", "_ws_": "w",
+                "_ns_": f"ns-{i % 100}", "instance": str(i)})
+            c.add(IngestRecord(key, START * 1000, (float(i),)))
+        shard.ingest(SomeData(c, lo // batch))
+    create_dt = time.perf_counter() - t0
+
+    # steady-state: more samples for every series
+    t0 = time.perf_counter()
+    rows = 0
+    for s in range(1, args.samples):
+        for lo in range(0, n, batch):
+            c = RecordContainer()
+            hi = min(lo + batch, n)
+            for i in range(lo, hi):
+                key = PartKey.create("gauge", {
+                    METRIC_LABEL: "scale_metric", "_ws_": "w",
+                    "_ns_": f"ns-{i % 100}", "instance": str(i)})
+                c.add(IngestRecord(key, (START + s * 10) * 1000, (float(i),)))
+            rows += shard.ingest(SomeData(c, s * 1000 + lo // batch))
+    steady_dt = time.perf_counter() - t0
+    gc.collect()
+    rss1 = rss_mb()
+
+    svc = QueryService(ms, "scale", 1, spread=0)
+    t0 = time.perf_counter()
+    r = svc.query_range('count(scale_metric{_ns_="ns-7"})',
+                        START + args.samples * 10, 60,
+                        START + args.samples * 10)
+    q_dt = time.perf_counter() - t0
+    out = {
+        "series": n,
+        "create_series_per_sec": round(n / create_dt),
+        "steady_ingest_samples_per_sec": round(rows / steady_dt)
+        if rows else None,
+        "per_series_bytes": round((rss1 - rss0) * 1024 * 1024 / n),
+        "rss_mb": round(rss1, 1),
+        "slice_query_series": int(r.result.values[0, 0]),
+        "slice_query_sec": round(q_dt, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,7 +26,17 @@ METRIC_LABEL = "_metric_"
 
 
 def murmur3_32(data: bytes, seed: int = 0) -> int:
-    """Stable 32-bit murmur3 (x86 variant)."""
+    """Stable 32-bit murmur3 (x86 variant); C++ fast path when available
+    (bit-exact with the python fallback below)."""
+    from filodb_tpu.memory import native
+
+    h = native.murmur3_32_native(data, seed)
+    if h is not None:
+        return h
+    return _murmur3_32_py(data, seed)
+
+
+def _murmur3_32_py(data: bytes, seed: int = 0) -> int:
     c1, c2 = 0xCC9E2D51, 0x1B873593
     h = seed
     n = len(data)

@@ -63,6 +63,8 @@ def _load():
         lib.nibble_pack.restype = i64
         lib.nibble_unpack.argtypes = [u8p, i64, u64p, i64]
         lib.nibble_unpack.restype = i64
+        lib.murmur3_32.argtypes = [u8p, i64, ctypes.c_uint32]
+        lib.murmur3_32.restype = ctypes.c_uint32
         lib.zigzag_encode_i64.argtypes = [i64p, u64p, i64]
         lib.zigzag_decode_u64.argtypes = [u64p, i64p, i64]
         lib.xor_encode_f64.argtypes = [f64p, u64p, i64]
@@ -96,6 +98,15 @@ def get_lib():
 
 def _as_ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def murmur3_32_native(data: bytes, seed: int = 0) -> int | None:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+        else (ctypes.c_uint8 * 1)()
+    return int(lib.murmur3_32(buf, len(data), seed))
 
 
 def nibble_pack_native(values: np.ndarray) -> bytes | None:

@@ -140,6 +140,44 @@ int64_t nibble_unpack(const uint8_t* in, int64_t in_len, uint64_t* out,
 }
 
 // ---------------------------------------------------------------------------
+// murmur3-32 (x86 variant) — partition-key hashing (reference uses Murmur3
+// for BinaryRecord partition hashes; python-side fallback matches bit-exact)
+
+uint32_t murmur3_32(const uint8_t* data, int64_t n, uint32_t seed) {
+    const uint32_t c1 = 0xCC9E2D51u, c2 = 0x1B873593u;
+    uint32_t h = seed;
+    int64_t rounded = n & ~3LL;
+    for (int64_t i = 0; i < rounded; i += 4) {
+        uint32_t k;
+        std::memcpy(&k, data + i, 4);
+        k *= c1;
+        k = (k << 15) | (k >> 17);
+        k *= c2;
+        h ^= k;
+        h = (h << 13) | (h >> 19);
+        h = h * 5 + 0xE6546B64u;
+    }
+    uint32_t k = 0;
+    int64_t tail = n - rounded;
+    if (tail >= 3) k ^= static_cast<uint32_t>(data[rounded + 2]) << 16;
+    if (tail >= 2) k ^= static_cast<uint32_t>(data[rounded + 1]) << 8;
+    if (tail >= 1) {
+        k ^= data[rounded];
+        k *= c1;
+        k = (k << 15) | (k >> 17);
+        k *= c2;
+        h ^= k;
+    }
+    h ^= static_cast<uint32_t>(n);
+    h ^= h >> 16;
+    h *= 0x85EBCA6Bu;
+    h ^= h >> 13;
+    h *= 0xC2B2AE35u;
+    h ^= h >> 16;
+    return h;
+}
+
+// ---------------------------------------------------------------------------
 // XOR-double prep
 
 void xor_encode_f64(const double* in, uint64_t* out, int64_t n) {
