@@ -34,6 +34,10 @@ DEFAULTS = {
                 "groups_per_shard": 20,
                 "retention_ms": 3 * 24 * 3_600_000,
             },
+            # optional downsampling plane:
+            # "downsample": {"resolutions_ms": [300000, 3600000],
+            #                "schedule_s": 21600,
+            #                "raw_retention_ms": 259200000}
         }
     },
 }
@@ -51,6 +55,7 @@ class ServerConfig:
     enable_failover: bool = False
     datasets: dict[str, IngestionConfig] = field(default_factory=dict)
     spreads: dict[str, int] = field(default_factory=dict)
+    downsample: dict[str, dict] = field(default_factory=dict)
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -61,7 +66,10 @@ class ServerConfig:
             _deep_merge(cfg, user)
         datasets = {}
         spreads = {}
+        downsample = {}
         for name, d in cfg["datasets"].items():
+            if d.get("downsample"):
+                downsample[name] = d["downsample"]
             store = StoreConfig(**{k: v for k, v in d.get("store", {}).items()
                                    if k in StoreConfig.__dataclass_fields__})
             datasets[name] = IngestionConfig(
@@ -74,7 +82,7 @@ class ServerConfig:
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),
-            datasets=datasets, spreads=spreads)
+            datasets=datasets, spreads=spreads, downsample=downsample)
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -154,9 +154,64 @@ class FiloServer:
             self.profiler = SimpleProfiler().start()
         if cfg.enable_failover:
             self._setup_failover()
+        if cfg.downsample and not cfg.seeds:
+            self._setup_downsampling(services)
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
                  self.executor.port, "member" if cfg.seeds else "coordinator")
         return self
+
+    # -- downsampling plane (reference DownsamplerMain scheduled job +
+    #    LongTimeRangePlanner query routing) -------------------------------
+
+    def _setup_downsampling(self, services: dict):
+        import threading
+        import time as _time
+        from filodb_tpu.coordinator.longtime_planner import (
+            LongTimeRangePlanner,
+        )
+        from filodb_tpu.coordinator.planner import SingleClusterPlanner
+        from filodb_tpu.core.downsample import (
+            DownsampledTimeSeriesStore,
+            DownsamplerJob,
+        )
+        cfg = self.config
+        self._ds_threads = []
+        for dataset, ds_cfg in cfg.downsample.items():
+            ing = cfg.datasets[dataset]
+            resolutions = tuple(ds_cfg.get("resolutions_ms",
+                                           (300_000, 3_600_000)))
+            schedule_s = ds_cfg.get("schedule_s", 6 * 3600)
+            raw_retention = ds_cfg.get("raw_retention_ms",
+                                       ing.store.retention_ms)
+            job = DownsamplerJob(self.column_store, dataset,
+                                 ing.num_shards, resolutions)
+            state = {"last_run": 0}
+
+            def runner(job=job, schedule_s=schedule_s, state=state):
+                while True:
+                    now_ms = int(_time.time() * 1000)
+                    try:
+                        job.run(state["last_run"], now_ms)
+                        state["last_run"] = now_ms
+                    except Exception:
+                        log.exception("downsampler job failed")
+                    _time.sleep(schedule_s)
+
+            t = threading.Thread(target=runner, daemon=True,
+                                 name=f"downsampler-{dataset}")
+            t.start()
+            self._ds_threads.append(t)
+            # queries split raw vs downsample at the raw-retention boundary
+            svc = services.get(dataset)
+            if svc is not None:
+                ds_store = DownsampledTimeSeriesStore(
+                    self.column_store, dataset, min(resolutions),
+                    ing.num_shards)
+                ds_planner = SingleClusterPlanner(
+                    dataset, ing.num_shards, cfg.spreads.get(dataset, 1),
+                    store=ds_store)
+                svc.planner = LongTimeRangePlanner(
+                    svc.planner, ds_planner, raw_retention)
 
     # -- singleton failover (reference ClusterSingletonFailoverSpec) --------
 
