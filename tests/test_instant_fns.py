@@ -1,0 +1,131 @@
+"""Instant-function golden tests.
+
+Pins every instant function against numpy/datetime ground truth (reference
+``InstantFunctionSpec`` covers the same surface).
+"""
+
+import datetime as dt
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from filodb_tpu.query.engine.instantfns import apply_binary_op, apply_instant_fn
+
+
+def ev(fn, vals, params=()):
+    return np.asarray(apply_instant_fn(fn, jnp.asarray(vals), params=params))
+
+
+class TestMathFns:
+    VALS = np.array([-2.5, -1.0, 0.0, 0.4, 1.0, 2.7, 100.0])
+
+    @pytest.mark.parametrize("fn,ref", [
+        ("abs", np.abs), ("ceil", np.ceil), ("floor", np.floor),
+        ("exp", np.exp), ("sqrt", np.sqrt), ("sgn", np.sign),
+        ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+        ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+        ("deg", np.degrees), ("rad", np.radians),
+    ])
+    def test_matches_numpy(self, fn, ref):
+        with np.errstate(invalid="ignore"):
+            np.testing.assert_allclose(ev(fn, self.VALS), ref(self.VALS),
+                                       rtol=1e-12, equal_nan=True)
+
+    def test_logs(self):
+        v = np.array([0.5, 1.0, 10.0, 1024.0])
+        np.testing.assert_allclose(ev("ln", v), np.log(v), rtol=1e-12)
+        np.testing.assert_allclose(ev("log2", v), np.log2(v), rtol=1e-12)
+        np.testing.assert_allclose(ev("log10", v), np.log10(v), rtol=1e-12)
+
+    def test_round_with_nearest(self):
+        v = np.array([1.24, 1.26, -0.75])
+        np.testing.assert_allclose(ev("round", v, (0.5,)),
+                                   np.round(v / 0.5) * 0.5, rtol=1e-12)
+        np.testing.assert_allclose(ev("round", v), np.round(v), rtol=1e-12)
+
+    def test_clamps(self):
+        v = np.array([-5.0, 0.0, 5.0, 50.0])
+        np.testing.assert_allclose(ev("clamp", v, (0.0, 10.0)),
+                                   np.clip(v, 0, 10))
+        np.testing.assert_allclose(ev("clamp_min", v, (1.0,)),
+                                   np.maximum(v, 1.0))
+        np.testing.assert_allclose(ev("clamp_max", v, (1.0,)),
+                                   np.minimum(v, 1.0))
+
+
+class TestTimeFns:
+    # epoch seconds spanning leap years, month ends, DOW wraps
+    TIMES = [
+        dt.datetime(1970, 1, 1, 0, 0, tzinfo=dt.timezone.utc),
+        dt.datetime(2000, 2, 29, 23, 59, tzinfo=dt.timezone.utc),
+        dt.datetime(2016, 12, 31, 12, 30, tzinfo=dt.timezone.utc),
+        dt.datetime(2020, 2, 28, 6, 1, tzinfo=dt.timezone.utc),
+        dt.datetime(2021, 3, 1, 0, 0, tzinfo=dt.timezone.utc),
+        dt.datetime(2026, 7, 28, 17, 45, tzinfo=dt.timezone.utc),
+        dt.datetime(2100, 2, 28, 3, 3, tzinfo=dt.timezone.utc),  # not leap
+    ]
+
+    def secs(self):
+        return np.array([t.timestamp() for t in self.TIMES])
+
+    def test_year_month_day(self):
+        s = self.secs()
+        np.testing.assert_array_equal(ev("year", s),
+                                      [t.year for t in self.TIMES])
+        np.testing.assert_array_equal(ev("month", s),
+                                      [t.month for t in self.TIMES])
+        np.testing.assert_array_equal(ev("day_of_month", s),
+                                      [t.day for t in self.TIMES])
+
+    def test_hour_minute(self):
+        s = self.secs()
+        np.testing.assert_array_equal(ev("hour", s),
+                                      [t.hour for t in self.TIMES])
+        np.testing.assert_array_equal(ev("minute", s),
+                                      [t.minute for t in self.TIMES])
+
+    def test_day_of_week(self):
+        s = self.secs()
+        # promql: 0 = Sunday
+        expect = [(t.weekday() + 1) % 7 for t in self.TIMES]
+        np.testing.assert_array_equal(ev("day_of_week", s), expect)
+
+    def test_day_of_year(self):
+        s = self.secs()
+        expect = [t.timetuple().tm_yday for t in self.TIMES]
+        np.testing.assert_array_equal(ev("day_of_year", s), expect)
+
+    def test_days_in_month(self):
+        import calendar
+        s = self.secs()
+        expect = [calendar.monthrange(t.year, t.month)[1]
+                  for t in self.TIMES]
+        np.testing.assert_array_equal(ev("days_in_month", s), expect)
+
+
+class TestBinaryOps:
+    def test_arithmetic(self):
+        a = np.array([10.0, 7.0, -3.0])
+        b = np.array([3.0, 2.0, 2.0])
+        for op, ref in (("+", a + b), ("-", a - b), ("*", a * b),
+                        ("/", a / b), ("^", a ** b),
+                        ("%", np.fmod(a, b)),
+                        ("atan2", np.arctan2(a, b))):
+            out = np.asarray(apply_binary_op(op, jnp.asarray(a),
+                                             jnp.asarray(b)))
+            np.testing.assert_allclose(out, ref, rtol=1e-12, err_msg=op)
+
+    def test_comparison_filtering(self):
+        a = np.array([1.0, 5.0, np.nan])
+        b = np.array([2.0, 2.0, 2.0])
+        out = np.asarray(apply_binary_op(">", jnp.asarray(a),
+                                         jnp.asarray(b)))
+        assert np.isnan(out[0]) and out[1] == 5.0 and np.isnan(out[2])
+
+    def test_comparison_bool(self):
+        a = np.array([1.0, 5.0, np.nan])
+        b = np.array([2.0, 2.0, 2.0])
+        out = np.asarray(apply_binary_op(">", jnp.asarray(a), jnp.asarray(b),
+                                         bool_mode=True))
+        assert out[0] == 0.0 and out[1] == 1.0 and np.isnan(out[2])
