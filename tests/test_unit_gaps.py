@@ -1,0 +1,167 @@
+"""Coverage for under-tested units: Prom JSON rendering, index lifecycle,
+config layering, metrics exposition, aggregation edges, store reopen.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from filodb_tpu.config import ServerConfig
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex
+from filodb_tpu.core.memstore.index import PartKeyIndex
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.http import promjson
+from filodb_tpu.query.model import QueryResult, RangeVectorKey, StepMatrix
+
+
+def mk_result(keys, values, steps):
+    return QueryResult(StepMatrix(keys, np.asarray(values, float),
+                                  np.asarray(steps, np.int64)))
+
+
+class TestPromJson:
+    def test_matrix_drops_nan(self):
+        r = mk_result([RangeVectorKey.of({"_metric_": "m", "a": "1"})],
+                      [[1.0, np.nan, 3.0]], [1000, 2000, 3000])
+        body = promjson.matrix_json(r)
+        series = body["data"]["result"][0]
+        assert series["metric"] == {"__name__": "m", "a": "1"}
+        assert series["values"] == [[1.0, "1.0"], [3.0, "3.0"]]
+        assert body["queryStats"]["resultSeries"] == 0  # stats not populated
+
+    def test_all_nan_series_omitted(self):
+        r = mk_result([RangeVectorKey.of({"a": "1"}),
+                       RangeVectorKey.of({"a": "2"})],
+                      [[np.nan, np.nan], [1.0, 2.0]], [1000, 2000])
+        body = promjson.matrix_json(r)
+        assert len(body["data"]["result"]) == 1
+
+    def test_inf_formatting(self):
+        r = mk_result([RangeVectorKey.of({})], [[np.inf, -np.inf]],
+                      [1000, 2000])
+        vals = promjson.matrix_json(r)["data"]["result"][0]["values"]
+        assert vals[0][1] == "+Inf" and vals[1][1] == "-Inf"
+
+    def test_vector_takes_last_step(self):
+        r = mk_result([RangeVectorKey.of({"x": "y"})], [[1.0, 7.5]],
+                      [1000, 2000])
+        body = promjson.vector_json(r)
+        assert body["data"]["result"][0]["value"] == [2.0, "7.5"]
+
+    def test_histogram_flattening(self):
+        m = StepMatrix([RangeVectorKey.of({"app": "a"})],
+                       np.arange(6, dtype=float).reshape(1, 2, 3),
+                       np.array([1000, 2000], np.int64),
+                       les=np.array([0.5, 1.0, np.inf]))
+        body = promjson.matrix_json(QueryResult(m))
+        les = sorted(s["metric"]["le"] for s in body["data"]["result"])
+        assert les == ["+Inf", "0.5", "1.0"]
+
+    def test_json_serializable(self):
+        r = mk_result([RangeVectorKey.of({"a": "b"})], [[1.5]], [1000])
+        json.dumps(promjson.matrix_json(r))
+        json.dumps(promjson.vector_json(r))
+        json.dumps(promjson.error_json("boom"))
+
+
+class TestIndexLifecycle:
+    def key(self, i):
+        return PartKey.create("gauge", {"_metric_": "m", "i": str(i)})
+
+    def test_remove_then_readd(self):
+        idx = PartKeyIndex()
+        idx.add_part_key(0, self.key(0), 100)
+        idx.remove_part_key(0)
+        assert idx.part_ids_from_filters(
+            [ColumnFilter("i", Equals("0"))], 0, 10**15) == []
+        idx.add_part_key(1, self.key(0), 200)
+        assert idx.part_ids_from_filters(
+            [ColumnFilter("i", Equals("0"))], 0, 10**15) == [1]
+        assert len(idx) == 1
+
+    def test_empty_regex_matches_missing_label(self):
+        idx = PartKeyIndex()
+        idx.add_part_key(0, self.key(0), 100)
+        # absent label matches ^$ regex (prom semantics)
+        out = idx.part_ids_from_filters(
+            [ColumnFilter("nope", EqualsRegex(""))], 0, 10**15)
+        assert out == [0]
+
+    def test_update_end_time_filters(self):
+        idx = PartKeyIndex()
+        idx.add_part_key(0, self.key(0), 100)
+        idx.update_end_time(0, 500)
+        assert idx.part_ids_from_filters([], 600, 700) == []
+        assert idx.part_ids_from_filters([], 400, 700) == [0]
+
+
+class TestConfig:
+    def test_layering(self, tmp_path):
+        p = tmp_path / "c.json"
+        p.write_text(json.dumps({
+            "node_name": "x",
+            "datasets": {"timeseries": {"num_shards": 8,
+                                        "store": {"max_chunk_size": 77}}},
+        }))
+        cfg = ServerConfig.load(str(p))
+        assert cfg.node_name == "x"
+        ds = cfg.datasets["timeseries"]
+        assert ds.num_shards == 8
+        assert ds.store.max_chunk_size == 77
+        # defaults preserved for unset keys
+        assert ds.store.groups_per_shard == 20
+        assert cfg.http_port == 8080
+
+    def test_defaults_without_file(self):
+        cfg = ServerConfig.load(None)
+        assert "timeseries" in cfg.datasets
+        assert cfg.spreads["timeseries"] == 1
+
+
+class TestMetricsExposition:
+    def test_histogram_buckets_render(self):
+        from filodb_tpu.utils import metrics
+        h = metrics.Histogram("test_render_hist", {"who": "me"})
+        h.observe(0.003)
+        h.observe(0.2)
+        text = metrics.render_prometheus()
+        assert 'test_render_hist_bucket{who="me",le="0.005"} 1' in text
+        assert "test_render_hist_count" in text
+
+
+class TestAggregationEdges:
+    def test_group_and_stdvar(self):
+        import jax.numpy as jnp
+        from filodb_tpu.query.engine.aggregations import aggregate
+        v = np.array([[1.0, 4.0], [3.0, np.nan]])
+        g = np.zeros(2, np.int32)
+        grp = np.asarray(aggregate("group", jnp.asarray(v), jnp.asarray(g), 1))
+        np.testing.assert_array_equal(grp[0], [1.0, 1.0])
+        sv = np.asarray(aggregate("stdvar", jnp.asarray(v), jnp.asarray(g), 1))
+        np.testing.assert_allclose(sv[0, 0], np.var([1.0, 3.0]), rtol=1e-12)
+        assert sv[0, 1] == 0.0  # single sample -> zero variance
+
+    def test_count_values_via_transformer(self):
+        from filodb_tpu.query.exec.transformers import AggregateMapReduce
+        m = StepMatrix(
+            [RangeVectorKey.of({"i": str(i)}) for i in range(4)],
+            np.array([[1.0], [1.0], [2.0], [np.nan]]),
+            np.array([1000], np.int64))
+        out = AggregateMapReduce("count_values", ("ver",)).apply(m)
+        got = {k.label_map["ver"]: out.values[i, 0]
+               for i, k in enumerate(out.keys)}
+        assert got == {"1": 2.0, "2": 1.0}
+
+
+class TestLocalStoreReopen:
+    def test_reopen_after_close(self, tmp_path):
+        from filodb_tpu.core.store.localstore import LocalDiskColumnStore
+        from filodb_tpu.core.store.api import PartKeyRecord
+        key = PartKey.create("gauge", {"_metric_": "m"})
+        cs = LocalDiskColumnStore(str(tmp_path))
+        cs.write_part_keys("ds", 0, [PartKeyRecord(key, 1, 2)])
+        cs.close()
+        cs2 = LocalDiskColumnStore(str(tmp_path))
+        assert len(cs2.scan_part_keys("ds", 0)) == 1
+        cs2.close()
