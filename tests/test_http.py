@@ -4,6 +4,7 @@ Mirrors reference ``http/src/test/scala/filodb/http/PrometheusApiRouteSpec``.
 """
 
 import json
+import numpy as np
 import urllib.parse
 import urllib.request
 
@@ -199,3 +200,25 @@ class TestStartStopShards:
         finally:
             srv.stop()
             cluster.stop()
+
+
+class TestFiloClient:
+    def test_client_round_trip(self, server):
+        from filodb_tpu.client import FiloClient, FiloClientError
+
+        c = FiloClient(port=server.port)
+        assert c.health()
+        result = c.query_range('sum(rate(http_requests_total[5m]))',
+                               START + 600, START + 1800, 60)
+        assert len(result) == 1 and result[0]["values"]
+        labels, values, steps = c.query_range_matrix(
+            'rate(http_requests_total[5m])', START + 600, START + 1800, 60)
+        assert values.shape == (5, 21)
+        assert np.isfinite(values).all()
+        assert c.label_values("job") == ["job-0", "job-1", "job-2"]
+        assert "instance" in c.label_names()
+        assert len(c.series("http_requests_total", START, START + 4000)) == 5
+        inst = c.query("http_requests_total", START + 1000)
+        assert len(inst) == 5
+        with pytest.raises(FiloClientError):
+            c.query_range("((bad", START, START + 60, 60)
