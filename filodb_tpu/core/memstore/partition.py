@@ -148,11 +148,17 @@ class TimeSeriesPartition:
         if self.device_pages:
             # ingest-time device-page encoding (no decode round trip)
             from filodb_tpu.query.engine.device_batch import attach_pages
-            float_cols = {
-                ci + 1: np.asarray(b.cols[ci][: b.n], np.float64)
-                for ci, col in enumerate(self.schema.data.columns[1:])
-                if col.ctype == ColumnType.DOUBLE}
-            attach_pages(chunk, b.ts[: b.n].copy(), float_cols)
+            page_cols: dict = {}
+            for ci, col in enumerate(self.schema.data.columns[1:]):
+                if col.ctype == ColumnType.DOUBLE:
+                    page_cols[ci + 1] = np.asarray(b.cols[ci][: b.n],
+                                                   np.float64)
+                elif col.ctype == ColumnType.HISTOGRAM \
+                        and b.cols[ci] is not None:
+                    les = (self.bucket_les if self.bucket_les is not None
+                           else np.zeros(b.cols[ci].shape[1]))
+                    page_cols[ci + 1] = (les, b.cols[ci][: b.n])
+            attach_pages(chunk, b.ts[: b.n].copy(), page_cols)
         self._chunk_seq = (self._chunk_seq + 1) & 0xFFF
         # swap the buffer BEFORE publishing the chunk: a concurrent reader
         # (reads chunks first, then the buffer) can momentarily miss the

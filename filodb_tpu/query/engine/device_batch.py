@@ -41,13 +41,16 @@ class DeviceSeriesBatch:
 
     base_ts: int
     ts_dev: object       # int32 [P, S]
-    vals_dev: object     # f32 [P, S]
+    vals_dev: object     # f32 [P, S] (or [P, S, B] for histograms)
     valid_dev: object    # bool [P, S]
     counts: np.ndarray   # int32 [P] total valid (host stats)
     part_ids: list[int]
-    les = None
+    les: np.ndarray | None = None  # [B] bucket bounds (histogram batches)
     masked = True
-    is_histogram = False
+
+    @property
+    def is_histogram(self) -> bool:
+        return self.les is not None
 
     @property
     def num_series(self) -> int:
@@ -60,7 +63,10 @@ class DeviceSeriesBatch:
 def chunk_device_pages(chunk, schema, value_col: int):
     """Device pages for (ts, value column) of a chunk, memoized on the chunk
     (encoded from decoded arrays on first use; ingest-time encoding attaches
-    them up front via ``attach_pages``)."""
+    them up front via ``attach_pages``). Histogram columns yield
+    ``("hist", les, ts_page, [per-bucket int pages])``."""
+    from filodb_tpu.memory.codecs import HistogramColumn
+
     cache = chunk.__dict__.get("_dev_pages")
     if cache is None:
         object.__setattr__(chunk, "_dev_pages", {})
@@ -68,17 +74,35 @@ def chunk_device_pages(chunk, schema, value_col: int):
     pages = cache.get(value_col)
     if pages is None:
         ts = chunk.decode_column(0)
-        vals = np.asarray(chunk.decode_column(value_col), np.float64)
-        pages = cache[value_col] = (encode_ts_page(ts),
-                                    encode_f32_page(vals))
+        vals = chunk.decode_column(value_col)
+        if isinstance(vals, HistogramColumn):
+            pages = cache[value_col] = _hist_pages(ts, vals.les, vals.rows)
+        else:
+            pages = cache[value_col] = (
+                encode_ts_page(ts),
+                encode_f32_page(np.asarray(vals, np.float64)))
     return pages
 
 
-def attach_pages(chunk, ts: np.ndarray, cols: dict[int, np.ndarray]) -> None:
-    """Ingest-time page encoding (no decode round trip)."""
-    object.__setattr__(chunk, "_dev_pages", {
-        col: (encode_ts_page(ts), encode_f32_page(v))
-        for col, v in cols.items()})
+def _hist_pages(ts, les, rows):
+    # cumulative bucket counts suit the sloped-line int page predictor
+    bucket_pages = [encode_ts_page(rows[:, b].astype(np.int64))
+                    for b in range(rows.shape[1])]
+    return ("hist", np.asarray(les, np.float64), encode_ts_page(ts),
+            bucket_pages)
+
+
+def attach_pages(chunk, ts: np.ndarray, cols: dict[int, object]) -> None:
+    """Ingest-time page encoding (no decode round trip). Values are float
+    arrays, or ``(les, rows)`` tuples for histogram columns."""
+    out = {}
+    for col, v in cols.items():
+        if isinstance(v, tuple):
+            les, rows = v
+            out[col] = _hist_pages(ts, les, np.asarray(rows, np.int64))
+        else:
+            out[col] = (encode_ts_page(ts), encode_f32_page(v))
+    object.__setattr__(chunk, "_dev_pages", out)
 
 
 @partial(jax.jit, static_argnames=())
@@ -126,6 +150,12 @@ def _assemble(rel_bases, ts_slopes, ts_widths, ts_words,
 def build_device_batch(partitions, start: int, end: int,
                        value_col: int | None = None) -> DeviceSeriesBatch:
     """Assemble a device-decoded batch from partitions' chunk pages."""
+    from filodb_tpu.core.schemas import ColumnType
+
+    col0 = value_col if value_col is not None \
+        else partitions[0].schema.data.value_column
+    if partitions[0].schema.data.columns[col0].ctype == ColumnType.HISTOGRAM:
+        return _build_hist_device_batch(partitions, start, end, col0)
     per_series: list[list[tuple[DevicePage, DevicePage, int]]] = []
     for p in partitions:
         col = value_col if value_col is not None \
@@ -182,3 +212,122 @@ def build_device_batch(partitions, start: int, end: int,
         jnp.asarray(blk_counts), jnp.asarray(np.int32(end - start)))
     return DeviceSeriesBatch(start, ts_dev, vals_dev, valid_dev, counts,
                              [p.part_id for p in partitions])
+
+
+# ---------------------------------------------------------------------------
+# histogram batches: per-bucket int pages → [P, S, B] on device
+
+@jax.jit
+def _assemble_hist(rel_bases, ts_slopes, ts_widths, ts_words,
+                   b_bases, b_slopes, b_widths, b_words,
+                   blk_counts, range_len):
+    """ts page arrays [P, NB, ...] + bucket page arrays [P, NB, B, ...] →
+    (ts [P, S], hist [P, S, B], valid [P, S])."""
+    from filodb_tpu.memory.device_pages import _unpack_block_jax
+    from filodb_tpu.query.engine.kernels import fdtype
+
+    P, NB = rel_bases.shape
+    B = b_bases.shape[2]
+    dt = fdtype()
+
+    def dec_int_block(base, slope, w, words):
+        zz = _unpack_block_jax(words, w)
+        resid = (zz >> 1).astype(jnp.int32) ^ -(zz & 1).astype(jnp.int32)
+        lane = jnp.arange(BLOCK, dtype=jnp.int32)
+        return base.astype(dt) + (slope * lane + resid).astype(dt)
+
+    def per_series(rb, sl, tw, twd, bb, bs, bw, bwd, bc):
+        def per_block(rb_b, sl_b, tw_b, twd_b, bb_b, bs_b, bw_b, bwd_b,
+                      bc_b):
+            lane = jnp.arange(BLOCK, dtype=jnp.int32)
+            zz = _unpack_block_jax(twd_b, tw_b)
+            resid = (zz >> 1).astype(jnp.int32) ^ -(zz & 1).astype(jnp.int32)
+            ts = rb_b + sl_b * lane + resid
+            valid = lane < bc_b
+            ts = jnp.where(valid, ts, TS_GAP_MIN)
+            # buckets: vmap the int decode over B
+            rows = jax.vmap(dec_int_block)(bb_b, bs_b, bw_b, bwd_b)  # [B,128]
+            return ts, rows.T, valid  # rows.T: [128, B]
+
+        return jax.vmap(per_block)(rb, sl, tw, twd, bb, bs, bw, bwd, bc)
+
+    ts_b, hist_b, valid_b = jax.vmap(per_series)(
+        rel_bases, ts_slopes, ts_widths, ts_words, b_bases, b_slopes,
+        b_widths, b_words, blk_counts)
+    S = NB * BLOCK
+    ts = lax.cummax(ts_b.reshape(P, S), axis=1)
+    hist = hist_b.reshape(P, S, B)
+    valid = valid_b.reshape(P, S)
+    valid = valid & (ts >= 0) & (ts <= range_len)
+    return ts, hist, valid
+
+
+def _build_hist_device_batch(partitions, start: int, end: int,
+                             col: int) -> DeviceSeriesBatch:
+    per_series = []
+    les_out = None
+    for p in partitions:
+        entries = []
+        for c in p.chunks_in_range(start, end, include_buffer=False):
+            tag = chunk_device_pages(c, p.schema, col)
+            _, les, tsp, bpages = tag
+            if les_out is None or len(les) > len(les_out):
+                les_out = les
+            entries.append((tsp, bpages, c.num_rows))
+        b = p._buf
+        if b.n and b.cols[col - 1] is not None:
+            bts = b.ts[: b.n]
+            if bts[-1] >= start and bts[0] <= end:
+                rows = b.cols[col - 1][: b.n]
+                les = (p.bucket_les if p.bucket_les is not None
+                       else np.zeros(rows.shape[1]))
+                if les_out is None or len(les) > len(les_out):
+                    les_out = np.asarray(les, np.float64)
+                tsp = encode_ts_page(bts)
+                bpages = [encode_ts_page(rows[:, j].astype(np.int64))
+                          for j in range(rows.shape[1])]
+                entries.append((tsp, bpages, int(b.n)))
+        per_series.append(entries)
+
+    P = len(per_series)
+    B = len(les_out) if les_out is not None else 1
+    nb_per = [sum(t.num_blocks for t, _, _ in e) for e in per_series]
+    NB = max(max(nb_per, default=1), 1)
+    rel_bases = np.zeros((P, NB), np.int32)
+    ts_slopes = np.zeros((P, NB), np.int32)
+    ts_widths = np.zeros((P, NB), np.int32)
+    ts_words = np.zeros((P, NB, WORDS_PER_BLOCK_MAX), np.uint32)
+    b_bases = np.zeros((P, NB, B), np.int64)
+    b_slopes = np.zeros((P, NB, B), np.int32)
+    b_widths = np.zeros((P, NB, B), np.int32)
+    b_words = np.zeros((P, NB, B, WORDS_PER_BLOCK_MAX), np.uint32)
+    blk_counts = np.zeros((P, NB), np.int32)
+    counts = np.zeros(P, np.int32)
+    for i, entries in enumerate(per_series):
+        bi = 0
+        for tsp, bpages, nrows in entries:
+            nb = tsp.num_blocks
+            rel_bases[i, bi : bi + nb] = (tsp.bases - start).astype(np.int32)
+            ts_slopes[i, bi : bi + nb] = tsp.slopes
+            ts_widths[i, bi : bi + nb] = tsp.widths
+            ts_words[i, bi : bi + nb] = tsp.words
+            for j, bp in enumerate(bpages[:B]):
+                b_bases[i, bi : bi + nb, j] = bp.bases
+                b_slopes[i, bi : bi + nb, j] = bp.slopes
+                b_widths[i, bi : bi + nb, j] = bp.widths
+                b_words[i, bi : bi + nb, j] = bp.words
+            full, rem = divmod(nrows, BLOCK)
+            bc = [BLOCK] * full + ([rem] if rem else [])
+            blk_counts[i, bi : bi + nb] = bc + [0] * (nb - len(bc))
+            counts[i] += nrows
+            bi += nb
+    ts_dev, hist_dev, valid_dev = _assemble_hist(
+        jnp.asarray(rel_bases), jnp.asarray(ts_slopes),
+        jnp.asarray(ts_widths), jnp.asarray(ts_words),
+        jnp.asarray(b_bases), jnp.asarray(b_slopes),
+        jnp.asarray(b_widths), jnp.asarray(b_words),
+        jnp.asarray(blk_counts), jnp.asarray(np.int32(end - start)))
+    return DeviceSeriesBatch(start, ts_dev, hist_dev, valid_dev, counts,
+                             [p.part_id for p in partitions],
+                             les=les_out if les_out is not None
+                             else np.array([np.inf]))

@@ -211,7 +211,8 @@ class SelectRawPartitionsExec(ExecPlan):
         columns (histogram columns use the host-decoded path)."""
         if not getattr(shard.config, "device_pages", False):
             return False
-        return schema.data.columns[col].ctype == ColumnType.DOUBLE
+        return schema.data.columns[col].ctype in (ColumnType.DOUBLE,
+                                                  ColumnType.HISTOGRAM)
 
     def _value_col_index(self, schema) -> int:
         if self.value_column:

@@ -77,6 +77,18 @@ class PeriodicSamplesMapper(RangeVectorTransformer):
         if getattr(batch, "masked", False):
             # device-decoded masked batch (engine/device_batch.py)
             ts_j, vals_j, valid_j = batch.device_arrays()
+            if batch.is_histogram:
+                import jax
+
+                def per_bucket_m(vb):
+                    return kernels.range_eval_masked(
+                        fn, ts_j, vb, valid_j, steps_j, win_j,
+                        counter=self.is_counter)
+
+                out = jax.vmap(per_bucket_m, in_axes=2, out_axes=2)(vals_j)
+                out = np.asarray(out)[: batch.num_series]
+                return StepMatrix(self._out_keys(keys), out, steps,
+                                  batch.les)
             if fn == "quantile_over_time":
                 out = kernels.quantile_over_time_masked(
                     self.params[0], ts_j, vals_j, valid_j, steps_j, win_j)

@@ -98,3 +98,32 @@ class TestDevicePathCounters:
         r_d = dev.query_range(q, START + 1295, 60, START + 1295).result
         np.testing.assert_array_equal(r_d.values, r_h.values)
         assert r_d.values[0, 0] == 130.0
+
+
+class TestDevicePathHistograms:
+    def test_histogram_quantile_matches_host(self):
+        from filodb_tpu.testing.data import histogram_series, histogram_stream
+
+        keys = histogram_series(3)
+        host, dev = _pair_of_services(
+            lambda: [histogram_stream(keys, 300, start_ms=START * 1000,
+                                      seed=9)])
+        for q in ('histogram_quantile(0.9, rate(http_req_latency[5m]))',
+                  'histogram_quantile(0.5, sum(rate(http_req_latency[5m])))'):
+            r_h = host.query_range(q, START + 1200, 120, START + 2700).result
+            r_d = dev.query_range(q, START + 1200, 120, START + 2700).result
+            assert r_h.num_series == r_d.num_series
+            np.testing.assert_allclose(r_d.values, r_h.values, rtol=5e-5,
+                                       atol=1e-4, equal_nan=True, err_msg=q)
+
+    def test_hist_buffer_included(self):
+        from filodb_tpu.testing.data import histogram_series, histogram_stream
+
+        keys = histogram_series(2)
+        host, dev = _pair_of_services(
+            lambda: [histogram_stream(keys, 130, start_ms=START * 1000)])
+        q = 'histogram_quantile(0.99, rate(http_req_latency[10m]))'
+        r_h = host.query_range(q, START + 1295, 60, START + 1295).result
+        r_d = dev.query_range(q, START + 1295, 60, START + 1295).result
+        np.testing.assert_allclose(r_d.values, r_h.values, rtol=5e-5,
+                                   atol=1e-4, equal_nan=True)
