@@ -246,3 +246,47 @@ def page_to_arrays(page: DevicePage):
     """Device arrays for the decode kernels."""
     return (jnp.asarray(page.bases), jnp.asarray(page.slopes),
             jnp.asarray(page.widths), jnp.asarray(page.words))
+
+
+def _f32_kernel(firsts_ref, shifts_ref, widths_ref, words_ref, out_ref):
+    # one block per grid cell: unpack width-w fields, undo the trailing-zero
+    # shift, XOR against the block-first bit pattern, bitcast to f32
+    w = widths_ref[0]
+    tz = shifts_ref[0]
+    words = words_ref[0, :]
+    i = jax.lax.broadcasted_iota(jnp.uint32, (BLOCK,), 0)
+    bit0 = i * jnp.uint32(w)
+    word_idx = (bit0 >> 5).astype(jnp.int32)
+    bit_off = bit0 & 31
+    lo = words[jnp.clip(word_idx, 0, WORDS_PER_BLOCK_MAX - 1)]
+    hi = words[jnp.clip(word_idx + 1, 0, WORDS_PER_BLOCK_MAX - 1)]
+    mask = jnp.where(w >= 32, jnp.uint32(0xFFFFFFFF),
+                     (jnp.uint32(1) << jnp.uint32(w)) - jnp.uint32(1))
+    val = ((lo >> bit_off)
+           | jnp.where(bit_off > 0, hi << (32 - bit_off), 0).astype(
+               jnp.uint32)) & mask
+    x = jnp.where(w == 0, jnp.uint32(0), val)
+    xored = jnp.where(tz >= 32, jnp.uint32(0), x << jnp.uint32(tz))
+    bits = xored ^ firsts_ref[0]
+    out_ref[0, :] = jax.lax.bitcast_convert_type(bits, jnp.float32)
+
+
+def decode_f32_page_pallas(firsts, shifts, widths, words,
+                           interpret: bool = False):
+    """Pallas grid over blocks: XOR-vs-first float decode on device."""
+    from jax.experimental import pallas as pl
+
+    nb = firsts.shape[0]
+    return pl.pallas_call(
+        _f32_kernel,
+        out_shape=jax.ShapeDtypeStruct((nb, BLOCK), jnp.float32),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b: (b,)),
+            pl.BlockSpec((1,), lambda b: (b,)),
+            pl.BlockSpec((1,), lambda b: (b,)),
+            pl.BlockSpec((1, WORDS_PER_BLOCK_MAX), lambda b: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK), lambda b: (b, 0)),
+        interpret=interpret,
+    )(firsts, shifts, widths, words)

@@ -85,3 +85,18 @@ class TestF32Pages:
         out = np.asarray(decode_f32_page_jax(bases, shifts, widths,
                                              words)).ravel()[:5]
         np.testing.assert_array_equal(out, v)
+
+
+class TestF32Pallas:
+    def test_pallas_matches_jax(self):
+        from filodb_tpu.memory.device_pages import (
+            decode_f32_page_pallas,
+        )
+        rng = np.random.default_rng(4)
+        v = rng.normal(100, 5, 513).astype(np.float32)
+        page = encode_f32_page(v)
+        bases, shifts, widths, words = page_to_arrays(page)
+        a = np.asarray(decode_f32_page_jax(bases, shifts, widths, words))
+        b = np.asarray(decode_f32_page_pallas(bases, shifts, widths, words,
+                                              interpret=True))
+        np.testing.assert_array_equal(a, b)

@@ -374,3 +374,74 @@ class TestAtModifier:
         for k in range(r.num_steps):
             np.testing.assert_allclose(r.values[0, k], r.values[0, 0],
                                        rtol=0)
+
+
+class TestVectorMatching:
+    @pytest.fixture(scope="class")
+    def join_svc(self):
+        """requests (per instance+job) and limits (one per instance)."""
+        from filodb_tpu.core.partkey import PartKey
+        from filodb_tpu.core.record import (
+            IngestRecord,
+            RecordContainer,
+            SomeData,
+        )
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        c = RecordContainer()
+        for i in range(60):
+            ts = (START + i * 10) * 1000
+            for inst in range(3):
+                for job in ("api", "web"):
+                    k = PartKey.create("gauge", {
+                        "_metric_": "used", "_ws_": "w", "_ns_": "n",
+                        "instance": f"i{inst}", "job": job})
+                    c.add(IngestRecord(k, ts, (float(10 * inst + 1),)))
+                k = PartKey.create("gauge", {
+                    "_metric_": "cap", "_ws_": "w", "_ns_": "n",
+                    "instance": f"i{inst}", "zone": f"z{inst % 2}"})
+                c.add(IngestRecord(k, ts, (100.0 * (inst + 1),)))
+        ms.ingest("timeseries", 0, SomeData(c, 0))
+        return QueryService(ms, "timeseries", 1, spread=0)
+
+    def test_group_left_many_to_one(self, join_svc):
+        r = join_svc.query_range(
+            'used / on (instance) group_left cap',
+            START + 400, 60, START + 580).result
+        # 6 "used" series (3 inst x 2 jobs) each matched to its instance cap
+        assert r.num_series == 6
+        for i, k in enumerate(r.keys):
+            inst = int(k.label_map["instance"][1])
+            expect = (10 * inst + 1) / (100.0 * (inst + 1))
+            np.testing.assert_allclose(r.values[i], expect, rtol=1e-9)
+
+    def test_group_left_include_labels(self, join_svc):
+        r = join_svc.query_range(
+            'used * on (instance) group_left (zone) cap',
+            START + 400, 60, START + 400).result
+        # zone copied from the "one" side onto results
+        for k in r.keys:
+            inst = int(k.label_map["instance"][1])
+            assert k.label_map["zone"] == f"z{inst % 2}"
+
+    def test_one_to_one_requires_unique(self, join_svc):
+        from filodb_tpu.query.model import QueryError
+        with pytest.raises(Exception, match="group_left|multiple matches"):
+            join_svc.query_range('used / on (instance) cap',
+                                 START + 400, 60, START + 400)
+
+    def test_ignoring(self, join_svc):
+        r = join_svc.query_range(
+            'used / ignoring (job, zone) group_left cap',
+            START + 400, 60, START + 400).result
+        assert r.num_series == 6
+
+    def test_group_right(self, join_svc):
+        r = join_svc.query_range(
+            'cap / on (instance) group_right used',
+            START + 400, 60, START + 400).result
+        assert r.num_series == 6
+        for i, k in enumerate(r.keys):
+            inst = int(k.label_map["instance"][1])
+            expect = (100.0 * (inst + 1)) / (10 * inst + 1)
+            np.testing.assert_allclose(r.values[i, 0], expect, rtol=1e-9)
