@@ -1,0 +1,65 @@
+"""Pallas TPU kernels for windowed aggregation.
+
+The jit/XLA path (``kernels.py``) is the default engine; these Pallas
+formulations exist for the cases XLA's fusion can't reach — keeping the
+entire window evaluation in VMEM with explicit grids. Shapes follow the VPU
+tiling: the sample axis rides the 128-lane dimension; one grid cell
+processes one series row.
+
+``windowed_sum_pallas`` evaluates ``sum_over_time`` for every step of every
+series with a fori loop over steps and a masked lane reduction per step —
+O(S) lane work per step, all in VMEM (compare the prefix-sum formulation in
+``kernels.range_eval``, which is O(1) gathers per step but materializes
+[P, S+1] prefix arrays in HBM).
+
+Kernels are validated in interpret mode on CPU; device selection between the
+XLA and Pallas paths is a benchmarking decision on real hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def _windowed_sum_kernel(steps_ref, window_ref, ts_ref, vals_ref, out_ref):
+    ts = ts_ref[0, :]
+    vals = vals_ref[0, :]
+    K = out_ref.shape[1]
+    window = window_ref[0]
+
+    def body(k, _):
+        t = steps_ref[k]
+        in_win = (ts > t - window) & (ts <= t)
+        out_ref[0, k] = jnp.sum(jnp.where(in_win, vals, 0.0))
+        return 0
+
+    lax.fori_loop(0, K, body, 0)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def windowed_sum_pallas(ts, vals, steps, window, interpret: bool = False):
+    """sum over (t-w, t] per series per step: ts int32 [P,S] (TS_PAD padded),
+    vals f32 [P,S], steps int32 [K], window int32 → f32 [P,K].
+
+    Invalid (padded) lanes carry TS_PAD > any step, so the window mask
+    excludes them; vals padding must be 0."""
+    P, S = ts.shape
+    K = steps.shape[0]
+    return pl.pallas_call(
+        _windowed_sum_kernel,
+        out_shape=jax.ShapeDtypeStruct((P, K), vals.dtype),
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec((K,), lambda p: (0,)),
+            pl.BlockSpec((1,), lambda p: (0,)),
+            pl.BlockSpec((1, S), lambda p: (p, 0)),
+            pl.BlockSpec((1, S), lambda p: (p, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, K), lambda p: (p, 0)),
+        interpret=interpret,
+    )(steps, window.reshape(1), ts, vals)
