@@ -28,6 +28,8 @@ class QueryService:
     num_shards: int = 1
     spread: int = 0
     time_split_ms: int = 0
+    # instant-selector staleness (reference QueryConfig staleSampleAfterMs)
+    lookback_ms: int = 300_000
     planner: SingleClusterPlanner = field(init=False)
 
     def __post_init__(self):
@@ -41,13 +43,13 @@ class QueryService:
                     end_sec: int, qcontext: QueryContext | None = None
                     ) -> QueryResult:
         params = TimeStepParams(start_sec, step_sec, end_sec)
-        plan = parse_query(promql, params)
+        plan = parse_query(promql, params, self.lookback_ms)
         return self.execute_logical(plan, qcontext)
 
     def query_instant(self, promql: str, time_sec: int,
                       qcontext: QueryContext | None = None) -> QueryResult:
         params = TimeStepParams(time_sec, 0, time_sec)
-        plan = parse_query(promql, params)
+        plan = parse_query(promql, params, self.lookback_ms)
         return self.execute_logical(plan, qcontext)
 
     def execute_logical(self, plan: lp.LogicalPlan,

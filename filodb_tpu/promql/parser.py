@@ -141,11 +141,12 @@ def instant_params(time_sec: int) -> TimeStepParams:
 # parser
 
 class Parser:
-    def __init__(self, text: str, params: TimeStepParams):
+    def __init__(self, text: str, params: TimeStepParams,
+                 lookback_ms: int = DEFAULT_STALENESS_MS):
         self.toks = tokenize(text)
         self.i = 0
         self.params = params
-        self.lookback = DEFAULT_STALENESS_MS
+        self.lookback = lookback_ms
 
     # -- token helpers --
 
@@ -682,10 +683,13 @@ class _Subquery:
 
 # ---------------------------------------------------------------------------
 
-def parse_query(text: str, params: TimeStepParams) -> lp.LogicalPlan:
+def parse_query(text: str, params: TimeStepParams,
+                lookback_ms: int = DEFAULT_STALENESS_MS) -> lp.LogicalPlan:
     """Parse a PromQL query into a LogicalPlan for the given time params
-    (reference ``Parser.queryRangeToLogicalPlan``)."""
-    return Parser(text, params).parse()
+    (reference ``Parser.queryRangeToLogicalPlan``; ``lookback_ms`` is the
+    instant-selector staleness window, reference QueryConfig
+    ``staleSampleAfterMs``)."""
+    return Parser(text, params, lookback_ms).parse()
 
 
 def parse_instant_query(text: str, time_sec: int) -> lp.LogicalPlan:

@@ -133,6 +133,13 @@ class SelectRawPartitionsExec(ExecPlan):
         shard = memstore.get_shard(dataset, self.shard)
         part_ids = shard.lookup_partitions(list(self.filters),
                                            self.chunk_start, self.chunk_end)
+        max_matches = getattr(shard.config, "max_query_matches", 0)
+        if max_matches and len(part_ids) > max_matches:
+            # query-size guardrail (reference
+            # ensureQueriedDataSizeWithinLimitApprox, OnDemandPagingShard)
+            raise QueryLimitExceeded(
+                f"query matches {len(part_ids)} series on shard "
+                f"{self.shard} > limit {max_matches}")
         parts = [shard.partition(pid) for pid in part_ids]
         parts = [p for p in parts if p is not None]
         ctx.stats.series_scanned += len(parts)

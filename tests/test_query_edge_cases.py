@@ -156,3 +156,31 @@ class TestInstantQuery:
         r = svc.query_instant("sum(m)", START + 500)
         assert r.result.num_steps == 1
         assert r.result.values[0, 0] == 50.0
+
+
+class TestQueryGuardrails:
+    def test_max_query_matches(self):
+        from filodb_tpu.query.model import QueryLimitExceeded
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=50,
+                                              max_query_matches=3))
+        for i in range(5):
+            ingest(ms, gauge_key(instance=str(i)),
+                   [((START + j * 10) * 1000, 1.0) for j in range(5)])
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        with pytest.raises(QueryLimitExceeded, match="matches 5 series"):
+            svc.query_range("m", START + 40, 60, START + 40)
+
+    def test_configurable_lookback(self):
+        ms = mk_store()
+        ingest(ms, gauge_key(), [((START + i * 10) * 1000, float(i))
+                                 for i in range(10)])
+        # default 5m lookback finds the stale sample 200s later
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        r = svc.query_range("m", START + 300, 60, START + 300).result
+        assert r.values[0, 0] == 9.0
+        # 60s lookback does not
+        svc_short = QueryService(ms, "timeseries", 1, spread=0,
+                                 lookback_ms=60_000)
+        r2 = svc_short.query_range("m", START + 300, 60, START + 300).result
+        assert r2.compact().num_series == 0
