@@ -294,3 +294,120 @@ def pad_for_mesh(ts, vals, counts, group_ids, mesh: Mesh):
         # padding series join group 0 but contribute nothing (no valid samples)
         pass
     return ts_p, vals_p, valid, gid_p
+
+
+def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
+    """Ring variant of the distributed rate pipeline: instead of
+    all-gathering every time-block's partials, carry state around the time
+    axis with ``lax.ppermute`` (the literal ring-attention communication
+    shape). Each of the dt-1 hops passes the running combine state
+    [P_l, K, 7] to the next time block:
+
+        (n_so_far, t_first, v_first_raw, inc_so_far, has_prev, v_prev, t_last)
+
+    Memory per device stays O(P_l·K) regardless of dt (the all_gather
+    version holds [dt, P_l, K, 6]); latency is dt-1 ICI hops.
+    """
+    dt_size = mesh.shape["time"]
+
+    def step(ts, vals, valid, group_ids, steps, window):
+        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
+            dtt = fdtype()
+            parts = _local_rate_partials(ts_l, vals_l, valid_l, steps_r,
+                                         window_r)
+            n_l, tf_l, vf_l, tl_l, vl_l, inc_l = [parts[..., i]
+                                                  for i in range(6)]
+            has_l = n_l > 0
+            t_idx = lax.axis_index("time")
+
+            # state flowing forward around the ring
+            state = jnp.stack([
+                n_l, tf_l, jnp.where(has_l, vf_l, 0.0), inc_l,
+                has_l.astype(dtt), jnp.where(has_l, vl_l, 0.0), tl_l],
+                axis=-1)
+
+            perm = [(i, i + 1) for i in range(dt_size - 1)]
+
+            def hop(state_in, _):
+                prev = lax.ppermute(state_in, "time", perm)
+                # devices with t_idx == 0 receive zeros (no source): mask the
+                # counts/flags AND re-sentinel the min/max-combined fields so
+                # zeros can't pollute t_first (min) / t_last (max)
+                p_n, p_tf, p_vf, p_inc, p_has, p_vl, p_tl = [
+                    prev[..., i] for i in range(7)]
+                first_block = (t_idx == 0)
+                p_n = jnp.where(first_block, 0.0, p_n)
+                p_has = jnp.where(first_block, 0.0, p_has)
+                no_prev = p_has == 0
+                p_tf = jnp.where(no_prev, jnp.array(2**31 - 1, dtt), p_tf)
+                p_tl = jnp.where(no_prev, jnp.array(-(2**31 - 1), dtt), p_tl)
+                p_inc = jnp.where(first_block, 0.0, p_inc)
+                # combine prev-state with the local block
+                boundary = jnp.where(
+                    has_l & (p_has > 0),
+                    jnp.where(vf_l < p_vl, vf_l, vf_l - p_vl), 0.0)
+                n_c = p_n + n_l
+                inc_c = p_inc + inc_l + boundary
+                tf_c = jnp.minimum(p_tf, tf_l)
+                vf_c = jnp.where(p_has > 0, p_vf,
+                                 jnp.where(has_l, vf_l, 0.0))
+                has_c = jnp.maximum(p_has, has_l.astype(dtt))
+                vl_c = jnp.where(has_l, vl_l, p_vl)
+                tl_c = jnp.maximum(p_tl, tl_l)
+                out = jnp.stack([n_c, tf_c, vf_c, inc_c, has_c, vl_c, tl_c],
+                                axis=-1)
+                return out, None
+
+            state, _ = lax.scan(hop, state, None, length=max(dt_size - 1, 1)
+                                if dt_size > 1 else 0)
+            # after dt-1 hops the LAST time block holds the full combine;
+            # broadcast it back to every block (masked psum: single
+            # contributor)
+            if dt_size > 1:
+                full = lax.psum(
+                    jnp.where(t_idx == dt_size - 1, state, 0.0), "time")
+            else:
+                full = state
+            n_tot, t_first_g, v_first_g, total_inc, _, _, t_last_g = [
+                full[..., i] for i in range(7)]
+
+            # Prometheus extrapolation (same as the gather variant)
+            t_first_s = t_first_g / 1000.0
+            t_last_s = t_last_g / 1000.0
+            range_start = (steps_r[None, :] - window_r).astype(dtt) / 1000.0
+            range_end = steps_r[None, :].astype(dtt) / 1000.0
+            sampled = t_last_s - t_first_s
+            avg_dur = sampled / jnp.maximum(n_tot - 1.0, 1.0)
+            dur_start = t_first_s - range_start
+            dur_end = range_end - t_last_s
+            dur_zero = jnp.where(
+                total_inc > 0,
+                sampled * v_first_g / jnp.maximum(total_inc, 1e-30), jnp.inf)
+            dur_start = jnp.minimum(dur_start, dur_zero)
+            threshold = avg_dur * 1.1
+            extend = sampled
+            extend = extend + jnp.where(dur_start < threshold, dur_start,
+                                        avg_dur / 2)
+            extend = extend + jnp.where(dur_end < threshold, dur_end,
+                                        avg_dur / 2)
+            rate = total_inc * extend / jnp.maximum(sampled, 1e-10) \
+                / (window_r.astype(dtt) / 1000.0)
+            rate = jnp.where(n_tot >= 2, rate, jnp.nan)
+
+            present = ~jnp.isnan(rate)
+            contrib = jnp.where(present, rate, 0.0)
+            gsum = lax.psum(jax.ops.segment_sum(contrib, gid_l, num_groups),
+                            "shard")
+            gcnt = lax.psum(jax.ops.segment_sum(
+                present.astype(contrib.dtype), gid_l, num_groups), "shard")
+            return jnp.where(gcnt > 0, gsum, jnp.nan)
+
+        return jax.shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P("shard", "time"), P("shard", "time"),
+                      P("shard", "time"), P("shard"), P(None), P()),
+            out_specs=P(None, None),
+            check_vma=False,
+        )(ts, vals, valid, group_ids, steps, window)
+
+    return jax.jit(step)

@@ -139,3 +139,63 @@ class TestDistributedRangeAggFamily:
                            jnp.asarray(steps), jnp.asarray(window)))
         np.testing.assert_allclose(out, expect, rtol=1e-9, atol=1e-12,
                                    equal_nan=True, err_msg=f"{fn}/{agg}")
+
+
+class TestRingVariant:
+    def test_ring_matches_gather(self, mesh):
+        from filodb_tpu.parallel.dist_query import (
+            make_distributed_sum_rate_ring,
+        )
+
+        P_, S = 12, 200
+        ts, vals, counts = make_series(P_, S, seed=21)
+        gids = np.arange(P_, dtype=np.int32) % 3
+        steps = np.arange(600_000, 1_500_000, 60_000, dtype=np.int32)
+        window = np.int32(300_000)
+        ts_p, vals_p, valid, gid_p = pad_for_mesh(ts, vals, counts, gids,
+                                                  mesh)
+        gather_fn = make_distributed_sum_rate(mesh, 3)
+        ring_fn = make_distributed_sum_rate_ring(mesh, 3)
+        a = np.asarray(gather_fn(jnp.asarray(ts_p), jnp.asarray(vals_p),
+                                 jnp.asarray(valid), jnp.asarray(gid_p),
+                                 jnp.asarray(steps), jnp.asarray(window)))
+        b = np.asarray(ring_fn(jnp.asarray(ts_p), jnp.asarray(vals_p),
+                               jnp.asarray(valid), jnp.asarray(gid_p),
+                               jnp.asarray(steps), jnp.asarray(window)))
+        np.testing.assert_allclose(b, a, rtol=1e-9, atol=1e-12,
+                                   equal_nan=True)
+
+    def test_ring_extrapolation_sensitive(self, mesh):
+        """First sample arrives late (time-block 0 empty for some series):
+        extrapolation depends on the true global t_first — a zero-polluted
+        ring combine would diverge here."""
+        from filodb_tpu.parallel.dist_query import (
+            make_distributed_sum_rate_ring,
+        )
+
+        P_, S = 8, 128
+        ts = np.full((P_, S), TS_PAD, np.int32)
+        vals = np.zeros((P_, S), np.float64)
+        counts = np.zeros(P_, np.int32)
+        rng = np.random.default_rng(33)
+        for p in range(P_):
+            n = 40  # few samples, all landing in the SECOND time block
+            t0 = 900_000 + p * 1000
+            ts[p, :n] = t0 + np.arange(n) * 10_000
+            vals[p, :n] = np.cumsum(rng.integers(1, 10, n)).astype(float)
+            counts[p] = n
+        gids = np.zeros(P_, np.int32)
+        steps = np.array([1_400_000, 1_500_000], dtype=np.int32)
+        window = np.int32(900_000)  # window start long before first sample
+        rate = np.asarray(kernels.range_eval(
+            "rate", jnp.asarray(ts), jnp.asarray(vals), jnp.asarray(counts),
+            jnp.asarray(steps), jnp.asarray(window)))
+        expect = np.asarray(aggregate("sum", jnp.asarray(rate),
+                                      jnp.asarray(gids), 1))
+        ts_p, vals_p, valid, gid_p = pad_for_mesh(ts, vals, counts, gids,
+                                                  mesh)
+        ring_fn = make_distributed_sum_rate_ring(mesh, 1)
+        out = np.asarray(ring_fn(jnp.asarray(ts_p), jnp.asarray(vals_p),
+                                 jnp.asarray(valid), jnp.asarray(gid_p),
+                                 jnp.asarray(steps), jnp.asarray(window)))
+        np.testing.assert_allclose(out, expect, rtol=1e-9, equal_nan=True)
