@@ -138,6 +138,13 @@ class _FlushScheduler(threading.Thread):
                     shard.flush_group(shard.next_flush_group())
                     shard.enforce_memory()
                     shard.purge_expired(int(time.time() * 1000))
+                    # WAL retention: everything at/below the min checkpoint
+                    # watermark is durably persisted and replay skips it
+                    w = self.node._workers.get((dataset, shard_num))
+                    wm = min(shard.group_watermarks)
+                    if (w is not None and wm >= 0
+                            and hasattr(w.log, "truncate_before")):
+                        w.log.truncate_before(wm + 1)
                 except Exception:
                     log.exception("scheduled flush failed for %s/%d",
                                   dataset, shard_num)

@@ -134,3 +134,82 @@ class FileLog(ReplayLog):
 
     def close(self):
         self._f.close()
+
+
+class SegmentedFileLog(ReplayLog):
+    """Segment-per-N-entries log with retention truncation (the Kafka
+    segment/retention model): appends roll new segment files; whole segments
+    wholly below the cluster's checkpoint watermark are deleted
+    (``truncate_before``), bounding WAL growth without rewrite."""
+
+    def __init__(self, directory: str, segment_entries: int = 4096,
+                 index_every: int = 64):
+        self.dir = directory
+        self.segment_entries = segment_entries
+        self.index_every = index_every
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        self._segments: list[tuple[int, FileLog]] = []  # (first_offset, log)
+        for name in sorted(os.listdir(directory)):
+            if name.startswith("seg-") and name.endswith(".log"):
+                first = int(name[4:-4])
+                self._segments.append(
+                    (first, FileLog(os.path.join(directory, name),
+                                    index_every)))
+        if not self._segments:
+            self._roll(0)
+
+    def _roll(self, first_offset: int) -> None:
+        path = os.path.join(self.dir, f"seg-{first_offset:020d}.log")
+        self._segments.append((first_offset, FileLog(path,
+                                                     self.index_every)))
+
+    def append(self, container: RecordContainer) -> int:
+        with self._lock:
+            first, seg = self._segments[-1]
+            if seg.latest_offset + 1 >= self.segment_entries:
+                first = first + seg.latest_offset + 1
+                self._roll(first)
+                first, seg = self._segments[-1]
+            local = seg.append(container)
+            return first + local
+
+    def read_from(self, offset: int):
+        offset = max(offset, 0)
+        with self._lock:
+            segments = list(self._segments)
+        for first, seg in segments:
+            last = first + seg.latest_offset
+            if last < offset:
+                continue
+            for sd in seg.read_from(max(offset - first, 0)):
+                yield SomeData(sd.container, first + sd.offset)
+
+    @property
+    def latest_offset(self) -> int:
+        first, seg = self._segments[-1]
+        return first + seg.latest_offset
+
+    def truncate_before(self, offset: int) -> int:
+        """Delete whole segments entirely below ``offset``. Returns segments
+        removed. The newest segment is always retained."""
+        removed = 0
+        with self._lock:
+            while len(self._segments) > 1:
+                first, seg = self._segments[0]
+                if first + seg.latest_offset < offset:
+                    seg.close()
+                    os.remove(seg.path)
+                    self._segments.pop(0)
+                    removed += 1
+                else:
+                    break
+        return removed
+
+    @property
+    def earliest_offset(self) -> int:
+        return self._segments[0][0]
+
+    def close(self):
+        for _, seg in self._segments:
+            seg.close()

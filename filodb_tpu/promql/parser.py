@@ -542,6 +542,13 @@ class Parser:
                 fn_params, sel.offset, sel.at_ms)
 
         if name in lp.INSTANT_FUNCTIONS:
+            if not args and name in ("hour", "minute", "month", "year",
+                                     "day_of_month", "day_of_week",
+                                     "day_of_year", "days_in_month"):
+                # promql: zero-arg form defaults to vector(time())
+                t = lp.ScalarTimeBasedPlan("time", p.start_ms,
+                                           p.step_ms or 1000, p.end_ms)
+                return lp.ApplyInstantFunction(lp.VectorPlan(t), name, ())
             vec = None
             fargs: list = []
             for a in args:

@@ -25,7 +25,7 @@ from filodb_tpu.core.store.localstore import (
 )
 from filodb_tpu.gateway.server import ContainerSink, GatewayServer
 from filodb_tpu.http.server import FiloHttpServer
-from filodb_tpu.kafka.log import FileLog
+from filodb_tpu.kafka.log import SegmentedFileLog
 
 log = logging.getLogger(__name__)
 
@@ -41,7 +41,7 @@ class FiloServer:
         self.memstore = TimeSeriesMemStore(self.column_store, self.meta_store)
         self.node = Node(config.node_name, self.memstore)
         self.cluster = FilodbCluster()
-        self.logs: dict[tuple[str, int], FileLog] = {}
+        self.logs: dict[tuple[str, int], SegmentedFileLog] = {}
         self.http: FiloHttpServer | None = None
         self.gateway: GatewayServer | None = None
         self.executor: PlanExecutorServer | None = None
@@ -49,12 +49,12 @@ class FiloServer:
     def _wal_path(self, dataset: str, shard: int) -> str:
         root = self.config.wal_dir or os.path.join(self.config.data_dir,
                                                    "wal")
-        return os.path.join(root, dataset, f"shard-{shard}.log")
+        return os.path.join(root, dataset, f"shard-{shard}")
 
-    def _shard_log(self, dataset: str, shard: int) -> FileLog:
+    def _shard_log(self, dataset: str, shard: int) -> SegmentedFileLog:
         key = (dataset, shard)
         if key not in self.logs:
-            self.logs[key] = FileLog(self._wal_path(dataset, shard))
+            self.logs[key] = SegmentedFileLog(self._wal_path(dataset, shard))
         return self.logs[key]
 
     # -- control handlers (member side; reference NodeCoordinatorActor) --

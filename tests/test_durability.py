@@ -198,3 +198,50 @@ class TestCrashRecovery:
             START + 900).result
         r1, r2 = q(), q()
         np.testing.assert_array_equal(r1.values, r2.values)
+
+
+class TestSegmentedLog:
+    def _fill(self, log, n, keys=None):
+        keys = keys or machine_metrics_series(1)
+        offs = []
+        for sd in gauge_stream(keys, n, batch=1, start_ms=START * 1000):
+            offs.append(log.append(sd.container))
+        return offs
+
+    def test_rolls_segments(self, tmp_path):
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        log = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=10)
+        offs = self._fill(log, 35)
+        assert offs == list(range(35))
+        import os
+        segs = [f for f in os.listdir(tmp_path / "wal")
+                if f.startswith("seg-")]
+        assert len(segs) == 4
+        assert [sd.offset for sd in log.read_from(0)] == list(range(35))
+        assert [sd.offset for sd in log.read_from(17)] == list(range(17, 35))
+
+    def test_truncate_before(self, tmp_path):
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        log = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=10)
+        self._fill(log, 35)
+        removed = log.truncate_before(25)
+        assert removed == 2  # segments [0..9], [10..19] gone; [20..29] kept
+        assert log.earliest_offset == 20
+        assert [sd.offset for sd in log.read_from(0)][0] == 20
+        assert [sd.offset for sd in log.read_from(28)] == list(range(28, 35))
+
+    def test_reopen_preserves_offsets(self, tmp_path):
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        p = str(tmp_path / "wal")
+        log = SegmentedFileLog(p, segment_entries=10)
+        self._fill(log, 25)
+        log.truncate_before(15)
+        log.close()
+        log2 = SegmentedFileLog(p, segment_entries=10)
+        assert log2.latest_offset == 24
+        assert log2.earliest_offset == 10
+        offs = [sd.offset for sd in log2.read_from(0)]
+        assert offs == list(range(10, 25))
+        # appends continue from the global offset
+        more = self._fill(log2, 1)
+        assert more == [25]

@@ -125,7 +125,7 @@ def test_singleton_failover(tmp_path):
     from filodb_tpu.core.partkey import PartKey
     from filodb_tpu.core.record import IngestRecord, RecordContainer
     from filodb_tpu.coordinator.ingestion import route_container
-    from filodb_tpu.kafka.log import FileLog
+    from filodb_tpu.kafka.log import SegmentedFileLog
 
     wal_dir = str(tmp_path / "wal")
     coord_port = _free_port()
@@ -152,7 +152,7 @@ def test_singleton_failover(tmp_path):
                 "instance": f"i{inst}"})
             container.add(IngestRecord(key, (START + i * 10) * 1000,
                                        (float(i),)))
-    logs = {s: FileLog(f"{wal_dir}/timeseries/shard-{s}.log")
+    logs = {s: SegmentedFileLog(f"{wal_dir}/timeseries/shard-{s}")
             for s in range(4)}
     for shard, cont in route_container(container, 4, 1).items():
         logs[shard].append(cont)

@@ -445,3 +445,15 @@ class TestVectorMatching:
             inst = int(k.label_map["instance"][1])
             expect = (100.0 * (inst + 1)) / (10 * inst + 1)
             np.testing.assert_allclose(r.values[i, 0], expect, rtol=1e-9)
+
+
+class TestZeroArgTimeFns:
+    def test_hour_of_query_time(self, gauge_svc):
+        import datetime as dt
+        svc, _ = gauge_svc
+        r = svc.query_range('hour()', START + 3600, 300, START + 4200).result
+        assert r.num_series == 1
+        for k, step_ms in enumerate(r.steps_ms):
+            expect = dt.datetime.fromtimestamp(
+                step_ms / 1000, dt.timezone.utc).hour
+            assert r.values[0, k] == expect
