@@ -15,3 +15,23 @@ Scala/JVM/Akka/Cassandra) designed TPU-first:
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # lazy convenience exports (keep bare import light; jax loads on demand)
+    if name == "FiloClient":
+        from filodb_tpu.client import FiloClient
+        return FiloClient
+    if name == "FiloServer":
+        from filodb_tpu.standalone import FiloServer
+        return FiloServer
+    if name == "ServerConfig":
+        from filodb_tpu.config import ServerConfig
+        return ServerConfig
+    if name == "QueryService":
+        from filodb_tpu.coordinator.query_service import QueryService
+        return QueryService
+    if name == "TimeSeriesMemStore":
+        from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+        return TimeSeriesMemStore
+    raise AttributeError(name)

@@ -142,6 +142,22 @@ def cmd_promql(args):
     print(json.dumps(matrix_json(r), indent=2))
 
 
+def cmd_validate(args):
+    """Validate schema definitions (reference ``validateSchemas`` command)."""
+    from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+
+    for s in DEFAULT_SCHEMAS.all:
+        cols = ", ".join(f"{c.name}:{c.ctype.value}"
+                         + ("(counter)" if c.is_counter else "")
+                         for c in s.data.columns)
+        ds = f" -> {s.data.downsample_schema}" if s.data.downsample_schema \
+            else ""
+        print(f"{s.name} (id={s.schema_id}): {cols}{ds}")
+        if s.data.downsamplers:
+            print(f"  downsamplers: {', '.join(s.data.downsamplers)}")
+    print(f"{len(DEFAULT_SCHEMAS.all)} schemas OK (no id clashes)")
+
+
 def cmd_topkcard(args):
     """Top-k cardinality under a shard-key prefix (reference ``topkcard``):
     counts persisted part keys grouped by the next shard-key level."""
@@ -221,12 +237,14 @@ def main(argv=None):
     p = sub.add_parser("topkcard")
     p.add_argument("--prefix", default="", help="ws or ws/ns")
     p.add_argument("-k", type=int, default=10)
+    sub.add_parser("validate")
 
     args = ap.parse_args(argv)
     {"init": cmd_init, "list": cmd_list, "status": cmd_status,
      "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
      "importcsv": cmd_importcsv, "promql": cmd_promql,
      "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,
+     "validate": cmd_validate,
      }[args.command](args)
 
 
