@@ -35,6 +35,13 @@ from filodb_tpu.memory.device_pages import (
 TS_GAP_MIN = -(2**31) + 2
 
 
+def _pow2(n: int, floor: int = 1) -> int:
+    v = floor
+    while v < n:
+        v *= 2
+    return v
+
+
 @dataclass
 class DeviceSeriesBatch:
     """Masked batch whose ts/vals/valid live on device."""
@@ -174,9 +181,11 @@ def build_device_batch(partitions, start: int, end: int,
                 entries.append((tsp, vp, int(b.n)))
         per_series.append(entries)
 
-    P = len(per_series)
+    # bucket shapes to powers of two so the jitted assemble/eval kernels
+    # reuse compilation across queries (mirrors engine/batch.py)
+    P = _pow2(len(per_series), 4)
     nb_per = [sum(t.num_blocks for t, _, _ in e) for e in per_series]
-    NB = max(max(nb_per, default=1), 1)
+    NB = _pow2(max(max(nb_per, default=1), 1))
     rel_bases = np.zeros((P, NB), np.int32)
     ts_slopes = np.zeros((P, NB), np.int32)
     ts_widths = np.zeros((P, NB), np.int32)
@@ -289,10 +298,10 @@ def _build_hist_device_batch(partitions, start: int, end: int,
                 entries.append((tsp, bpages, int(b.n)))
         per_series.append(entries)
 
-    P = len(per_series)
+    P = _pow2(len(per_series), 4)
     B = len(les_out) if les_out is not None else 1
     nb_per = [sum(t.num_blocks for t, _, _ in e) for e in per_series]
-    NB = max(max(nb_per, default=1), 1)
+    NB = _pow2(max(max(nb_per, default=1), 1))
     rel_bases = np.zeros((P, NB), np.int32)
     ts_slopes = np.zeros((P, NB), np.int32)
     ts_widths = np.zeros((P, NB), np.int32)
