@@ -1,0 +1,99 @@
+"""Quickstart: boot a server, ingest via the Influx gateway, query PromQL.
+
+    python examples/quickstart.py [--cpu]
+"""
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.client import FiloClient
+    from filodb_tpu.config import ServerConfig
+    from filodb_tpu.standalone import FiloServer
+
+    tmp = tempfile.mkdtemp(prefix="filodb-quickstart-")
+    cfg_path = os.path.join(tmp, "server.json")
+    with open(cfg_path, "w") as f:
+        json.dump({
+            "node_name": "quickstart",
+            "data_dir": os.path.join(tmp, "data"),
+            "http_port": 0,
+            "gateway_port": free_port(),
+            "datasets": {"timeseries": {
+                "num_shards": 2, "spread": 1,
+                "store": {"max_chunk_size": 120, "groups_per_shard": 4}}},
+        }, f)
+
+    print("booting server...")
+    server = FiloServer(ServerConfig.load(cfg_path)).start()
+    try:
+        now = int(time.time())
+        start = now - 600
+        print(f"feeding 10 minutes of Influx-line data for 4 hosts...")
+        with socket.create_connection(("127.0.0.1",
+                                       server.gateway.port)) as s:
+            for i in range(60):
+                ts_ns = (start + i * 10) * 1_000_000_000
+                for host in range(4):
+                    s.sendall(
+                        f"cpu_usage,host=h{host},_ws_=demo,_ns_=quick "
+                        f"value={50 + host * 10 + (i % 7)} {ts_ns}\n"
+                        .encode())
+                    s.sendall(
+                        f"http_requests,host=h{host},_ws_=demo,_ns_=quick "
+                        f"counter={i * (host + 1) * 3} {ts_ns}\n".encode())
+        server.gateway.sink.flush()
+        time.sleep(0.5)  # let the ingest workers drain the WAL
+
+        client = FiloClient(port=server.http.port)
+        print("\n--- avg cpu by host over the window ---")
+        for series in client.query_range(
+                "avg_over_time(cpu_usage[2m])", start + 120, now, 120):
+            host = series["metric"]["host"]
+            last = series["values"][-1][1]
+            print(f"  host={host}: avg_over_time={last}")
+
+        print("\n--- request rate (sum) ---")
+        for series in client.query_range(
+                "sum(rate(http_requests[2m]))", start + 120, now, 60):
+            print(f"  {len(series['values'])} steps, "
+                  f"last={series['values'][-1][1]} req/s")
+
+        print("\n--- top-2 hottest hosts right now ---")
+        for series in client.query("topk(2, cpu_usage)", now):
+            print(f"  host={series['metric']['host']} "
+                  f"value={series['value'][1]}")
+
+        print("\n--- labels ---")
+        print(" ", client.label_names())
+        print("\n--- cluster ---")
+        for st in client.cluster_status():
+            print(f"  shard {st['shard']}: {st['status']} on {st['node']}")
+        print("\nquickstart OK")
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

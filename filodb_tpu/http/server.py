@@ -154,11 +154,14 @@ def _make_handler(server: FiloHttpServer):
                                     for k, v in lm.items()})
                 return self._send(200, {"status": "success", "data": out})
             if rest == ["labels"]:
-                names = svc.memstore.label_names(svc.dataset)
+                names = [("__name__" if n == "_metric_" else n)
+                         for n in svc.memstore.label_names(svc.dataset)]
                 return self._send(200, {"status": "success", "data": names})
             if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
-                vals = svc.memstore.label_values(svc.dataset,
-                                                 unquote(rest[1]))
+                label = unquote(rest[1])
+                if label == "__name__":
+                    label = "_metric_"
+                vals = svc.memstore.label_values(svc.dataset, label)
                 return self._send(200, {"status": "success", "data": vals})
             self._send(404, promjson.error_json("unknown endpoint"))
 

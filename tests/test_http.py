@@ -222,3 +222,14 @@ class TestFiloClient:
         assert len(inst) == 5
         with pytest.raises(FiloClientError):
             c.query_range("((bad", START, START + 60, 60)
+
+
+class TestNameLabelMapping:
+    def test_labels_shows_dunder_name(self, server):
+        code, body = get(server, "/promql/timeseries/api/v1/labels")
+        assert "__name__" in body["data"] and "_metric_" not in body["data"]
+
+    def test_name_values(self, server):
+        code, body = get(server,
+                         "/promql/timeseries/api/v1/label/__name__/values")
+        assert body["data"] == ["http_requests_total"]
