@@ -74,7 +74,8 @@ class ServerConfig:
                                    if k in StoreConfig.__dataclass_fields__})
             datasets[name] = IngestionConfig(
                 dataset=name, num_shards=d.get("num_shards", 4),
-                min_num_nodes=d.get("min_num_nodes", 1), store=store)
+                min_num_nodes=d.get("min_num_nodes", 1), store=store,
+                downsample=d.get("downsample"))
             spreads[name] = d.get("spread", 1)
         return ServerConfig(
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],

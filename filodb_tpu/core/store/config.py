@@ -40,3 +40,6 @@ class IngestionConfig:
     source_factory: str = "in-proc"             # reference sourcefactory class
     source_config: dict = field(default_factory=dict)
     store: StoreConfig = field(default_factory=StoreConfig)
+    # downsampling plane config: {"resolutions_ms": [...], "streaming": bool,
+    # "schedule_s": N, "raw_retention_ms": M} (reference downsample config)
+    downsample: dict | None = None

@@ -204,14 +204,29 @@ class FiloServer:
             # queries split raw vs downsample at the raw-retention boundary
             svc = services.get(dataset)
             if svc is not None:
-                ds_store = DownsampledTimeSeriesStore(
-                    self.column_store, dataset, min(resolutions),
-                    ing.num_shards)
-                ds_planner = SingleClusterPlanner(
-                    dataset, ing.num_shards, cfg.spreads.get(dataset, 1),
-                    store=ds_store)
+                from filodb_tpu.core.downsample.downsampler import (
+                    ds_dataset_name,
+                )
+                raw_planner = svc.planner
+                dispatcher = getattr(raw_planner, "dispatcher_for_shard",
+                                     None)
+                if ds_cfg.get("streaming"):
+                    # streaming rollups live in co-sharded memstore datasets
+                    ds_planner = SingleClusterPlanner(
+                        dataset, ing.num_shards,
+                        cfg.spreads.get(dataset, 1),
+                        dispatcher_for_shard=dispatcher,
+                        dataset_name_override=ds_dataset_name(
+                            dataset, min(resolutions)))
+                else:
+                    ds_store = DownsampledTimeSeriesStore(
+                        self.column_store, dataset, min(resolutions),
+                        ing.num_shards)
+                    ds_planner = SingleClusterPlanner(
+                        dataset, ing.num_shards,
+                        cfg.spreads.get(dataset, 1), store=ds_store)
                 svc.planner = LongTimeRangePlanner(
-                    svc.planner, ds_planner, raw_retention)
+                    raw_planner, ds_planner, raw_retention)
 
     # -- singleton failover (reference ClusterSingletonFailoverSpec) --------
 
