@@ -206,7 +206,8 @@ class TestStreamingPipeline:
                 logs[shard].append(cont)
         config = IngestionConfig(
             "timeseries", 2,
-            store=StoreConfig(max_chunk_size=60, groups_per_shard=2),
+            store=StoreConfig(max_chunk_size=60, groups_per_shard=2,
+                              retention_ms=10**15),  # synthetic 2020 data
             downsample={"streaming": True, "resolutions_ms": [RES]})
         cluster.setup_dataset(config, logs)
         assert cluster.wait_active("timeseries", 10)
