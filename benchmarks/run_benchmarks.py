@@ -135,6 +135,70 @@ def bench_query_and_ingest():
             "value": round(n / dt, 2), "unit": "queries/sec"}
 
 
+def bench_hist_flat_vs_first_class():
+    """First-class histogram columns vs prom-flat bucket-per-series — the
+    reference's headline histogram claim (README.md:437: "up to two orders
+    of magnitude")."""
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import histogram_series, histogram_stream
+
+    n_series, n_samples, nb = 20, 480, 10
+
+    # first-class
+    ms1 = TimeSeriesMemStore()
+    ms1.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    for sd in histogram_stream(histogram_series(n_series), n_samples,
+                               start_ms=START * 1000, batch=2000):
+        ms1.get_shard("bench", 0).ingest(sd)
+    svc1 = QueryService(ms1, "bench", 1, spread=0)
+    q1 = 'histogram_quantile(0.99, sum(rate(http_req_latency[5m])))'
+
+    # prom-flat: same data as bucket-per-series counters
+    ms2 = TimeSeriesMemStore()
+    ms2.setup("bench", 0, StoreConfig(max_chunk_size=400))
+    rng = np.random.default_rng(0)
+    c = RecordContainer()
+    for s in range(n_series):
+        cum = np.zeros(nb)
+        for i in range(n_samples):
+            cum += np.cumsum(rng.integers(0, 5, nb))
+            for b in range(nb):
+                k = PartKey.create("prom-counter", {
+                    "_metric_": "lat_bucket", "_ws_": "demo", "_ns_": "App-0",
+                    "instance": f"i{s}", "le": str(float(b + 1))})
+                c.add(IngestRecord(k, (START + i * 10) * 1000,
+                                   (float(cum[b]),)))
+            if len(c) >= 5000:
+                ms2.get_shard("bench", 0).ingest(SomeData(c, i))
+                c = RecordContainer()
+    if len(c):
+        ms2.get_shard("bench", 0).ingest(SomeData(c, 0))
+    svc2 = QueryService(ms2, "bench", 1, spread=0)
+    q2 = ('histogram_quantile(0.99, sum(rate(lat_bucket[5m])) '
+          'by (le, instance))')
+
+    args1 = (START + 1800, 60, START + 3600)
+    svc1.query_range(q1, *args1)
+    svc2.query_range(q2, *args1)
+    n = 15
+    t0 = time.perf_counter()
+    for _ in range(n):
+        svc1.query_range(q1, *args1)
+    first_class = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        svc2.query_range(q2, *args1)
+    flat = n / (time.perf_counter() - t0)
+    return {"metric": "hist_first_class_vs_flat",
+            "first_class_qps": round(first_class, 2),
+            "prom_flat_qps": round(flat, 2),
+            "speedup": round(first_class / flat, 2), "unit": "queries/sec"}
+
+
 def bench_hist_query():
     from filodb_tpu.coordinator.query_service import QueryService
     from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
@@ -228,6 +292,7 @@ ALL = {
     "query_hicard": bench_query_hicard,
     "query_and_ingest": bench_query_and_ingest,
     "hist_query": bench_hist_query,
+    "hist_flat_vs_fc": bench_hist_flat_vs_first_class,
     "partkey_index": bench_partkey_index,
     "gateway": bench_gateway,
     "encoding": bench_encoding,

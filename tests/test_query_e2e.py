@@ -457,3 +457,53 @@ class TestZeroArgTimeFns:
             expect = dt.datetime.fromtimestamp(
                 step_ms / 1000, dt.timezone.utc).hour
             assert r.values[0, k] == expect
+
+
+class TestPromFlatBuckets:
+    """bucket-per-series histograms (metric_bucket{le=...}) — the layout the
+    reference compares first-class histograms against."""
+
+    @pytest.fixture(scope="class")
+    def flat_svc(self):
+        from filodb_tpu.core.partkey import PartKey
+        from filodb_tpu.core.record import (
+            IngestRecord,
+            RecordContainer,
+            SomeData,
+        )
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        les = [0.1, 0.5, 1.0, float("inf")]
+        rng = np.random.default_rng(6)
+        c = RecordContainer()
+        for app in ("a", "b"):
+            cum = np.zeros(len(les))
+            for i in range(240):
+                cum += np.cumsum(rng.integers(0, 4, len(les)))
+                for le, v in zip(les, cum):
+                    le_str = "+Inf" if le == float("inf") else str(le)
+                    k = PartKey.create("prom-counter", {
+                        "_metric_": "lat_bucket", "_ws_": "w", "_ns_": "n",
+                        "app": app, "le": le_str})
+                    c.add(IngestRecord(k, (START + i * 10) * 1000,
+                                       (float(v),)))
+        ms.ingest("timeseries", 0, SomeData(c, 0))
+        return QueryService(ms, "timeseries", 1, spread=0)
+
+    def test_flat_histogram_quantile(self, flat_svc):
+        r = flat_svc.query_range(
+            'histogram_quantile(0.9, sum(rate(lat_bucket[5m])) by (le, app))',
+            START + 600, 120, START + 2300).result
+        assert r.num_series == 2  # one per app
+        vals = r.values[np.isfinite(r.values)]
+        assert len(vals) and (vals > 0).all() and (vals <= 1.0).all()
+
+    def test_flat_quantile_ordering(self, flat_svc):
+        lo = flat_svc.query_range(
+            'histogram_quantile(0.5, sum(rate(lat_bucket[5m])) by (le))',
+            START + 600, 300, START + 2300).result
+        hi = flat_svc.query_range(
+            'histogram_quantile(0.99, sum(rate(lat_bucket[5m])) by (le))',
+            START + 600, 300, START + 2300).result
+        m = np.isfinite(lo.values) & np.isfinite(hi.values)
+        assert (hi.values[m] >= lo.values[m]).all()
