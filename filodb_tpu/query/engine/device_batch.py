@@ -154,21 +154,38 @@ def _assemble(rel_bases, ts_slopes, ts_widths, ts_words,
     return ts, vals, valid
 
 
+def _query_chunks(p, start, end, extra_chunks):
+    """In-memory chunks + ODP-paged chunks, deduped and time-ordered."""
+    chunks = p.chunks_in_range(start, end, include_buffer=False)
+    extra = (extra_chunks or {}).get(p.part_id)
+    if extra:
+        have = {c.id for c in chunks}
+        for c in extra:
+            if c.id not in have and c.end_time >= start \
+                    and c.start_time <= end:
+                chunks.append(c)
+        chunks.sort(key=lambda c: c.id)
+    return chunks
+
+
 def build_device_batch(partitions, start: int, end: int,
-                       value_col: int | None = None) -> DeviceSeriesBatch:
-    """Assemble a device-decoded batch from partitions' chunk pages."""
+                       value_col: int | None = None,
+                       extra_chunks: dict | None = None) -> DeviceSeriesBatch:
+    """Assemble a device-decoded batch from partitions' chunk pages
+    (including ODP-paged cold chunks)."""
     from filodb_tpu.core.schemas import ColumnType
 
     col0 = value_col if value_col is not None \
         else partitions[0].schema.data.value_column
     if partitions[0].schema.data.columns[col0].ctype == ColumnType.HISTOGRAM:
-        return _build_hist_device_batch(partitions, start, end, col0)
+        return _build_hist_device_batch(partitions, start, end, col0,
+                                        extra_chunks)
     per_series: list[list[tuple[DevicePage, DevicePage, int]]] = []
     for p in partitions:
         col = value_col if value_col is not None \
             else p.schema.data.value_column
         entries = []
-        for c in p.chunks_in_range(start, end, include_buffer=False):
+        for c in _query_chunks(p, start, end, extra_chunks):
             tsp, vp = chunk_device_pages(c, p.schema, col)
             entries.append((tsp, vp, c.num_rows))
         b = p._buf
@@ -272,12 +289,14 @@ def _assemble_hist(rel_bases, ts_slopes, ts_widths, ts_words,
 
 
 def _build_hist_device_batch(partitions, start: int, end: int,
-                             col: int) -> DeviceSeriesBatch:
+                             col: int,
+                             extra_chunks: dict | None = None
+                             ) -> DeviceSeriesBatch:
     per_series = []
     les_out = None
     for p in partitions:
         entries = []
-        for c in p.chunks_in_range(start, end, include_buffer=False):
+        for c in _query_chunks(p, start, end, extra_chunks):
             tag = chunk_device_pages(c, p.schema, col)
             _, les, tsp, bpages = tag
             if les_out is None or len(les) > len(les_out):

@@ -175,7 +175,8 @@ class SelectRawPartitionsExec(ExecPlan):
                         build_device_batch,
                     )
                     batch = build_device_batch(sparts, self.chunk_start,
-                                               self.chunk_end, col)
+                                               self.chunk_end, col,
+                                               extra_chunks=extra_chunks)
                 else:
                     batch = build_batch(sparts, self.chunk_start,
                                         self.chunk_end, col,

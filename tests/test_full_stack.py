@@ -1,0 +1,136 @@
+"""Full-stack capstone: one server with EVERYTHING on — device-page decode,
+streaming downsampling, gateway ingestion, WAL persistence + segmented
+retention, query via the client API — then a restart recovery.
+
+The closest single-test analog of running the whole reference stack
+(FiloServer + Kafka + Cassandra + downsampler) end to end.
+"""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.client import FiloClient
+from filodb_tpu.config import ServerConfig
+from filodb_tpu.standalone import FiloServer
+
+START = 1_600_000_000
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def cfg_path(tmp_path):
+    p = tmp_path / "server.json"
+    p.write_text(json.dumps({
+        "node_name": "full-stack",
+        "data_dir": str(tmp_path / "data"),
+        "http_port": 0,
+        "gateway_port": _free_port(),
+        "datasets": {"timeseries": {
+            "num_shards": 2, "spread": 1,
+            "store": {"max_chunk_size": 60, "groups_per_shard": 2,
+                      "flush_interval_ms": 400, "device_pages": True,
+                      "retention_ms": 10**15},
+            "downsample": {"streaming": True, "resolutions_ms": [300000],
+                           "schedule_s": 3600,
+                           "raw_retention_ms": 10**15}}},
+    }))
+    return str(p)
+
+
+def test_everything_on(cfg_path, tmp_path):
+    srv = FiloServer(ServerConfig.load(cfg_path)).start()
+    try:
+        client = FiloClient(port=srv.http.port)
+        assert client.health()
+
+        # 1. ingest 40 min of gauges + counters for 6 hosts via the gateway
+        with socket.create_connection(("127.0.0.1",
+                                       srv.gateway.port)) as s:
+            for i in range(240):
+                ts_ns = (START + i * 10) * 1_000_000_000
+                for h in range(6):
+                    s.sendall(
+                        f"cpu,host=h{h},_ws_=demo,_ns_=full "
+                        f"value={40 + h + (i % 5)} {ts_ns}\n".encode())
+                    s.sendall(
+                        f"reqs,host=h{h},_ws_=demo,_ns_=full "
+                        f"counter={i * (h + 2)} {ts_ns}\n".encode())
+        srv.gateway.sink.flush()
+
+        # 2. wait until ingested, then query through the device-page path
+        deadline = time.monotonic() + 20
+        ok = False
+        while time.monotonic() < deadline:
+            res = client.query_range("count(cpu)", START + 2390,
+                                     START + 2390, 60)
+            if res and float(res[0]["values"][0][1]) == 6:
+                ok = True
+                break
+            time.sleep(0.2)
+        assert ok, "gauges not fully ingested"
+
+        labels, values, steps = client.query_range_matrix(
+            "sum(rate(reqs[5m]))", START + 600, START + 2300, 60)
+        assert values.shape[0] == 1
+        finite = values[np.isfinite(values)]
+        # sum of per-host slopes: sum((h+2)/10) = 2.7/sec
+        np.testing.assert_allclose(np.median(finite), 2.7, rtol=0.05)
+
+        # 3. streaming downsample rollups materialized and flushed
+        flush_deadline = time.monotonic() + 20
+        ds_ok = False
+        while time.monotonic() < flush_deadline:
+            try:
+                n = sum(srv.memstore.get_shard("timeseries_ds_5m", s)
+                        .num_partitions for s in range(2))
+                if n >= 6:
+                    ds_ok = True
+                    break
+            except KeyError:
+                pass
+            time.sleep(0.3)
+        assert ds_ok, "streaming rollups missing"
+
+        # 4. chunks + checkpoints persisted (flush scheduler ran)
+        persist_deadline = time.monotonic() + 25
+        persisted = 0
+        while time.monotonic() < persist_deadline:
+            persisted = sum(
+                len(srv.column_store.scan_part_keys("timeseries", s))
+                for s in range(2))
+            if persisted >= 12:
+                break
+            time.sleep(0.3)
+        assert persisted >= 12  # 6 cpu + 6 reqs series
+
+        topk = client.query("topk(2, cpu)", START + 2390)
+        assert len(topk) == 2
+    finally:
+        srv.shutdown()
+
+    # 5. restart on the same data dir: WAL replay + index bootstrap restore
+    srv2 = FiloServer(ServerConfig.load(cfg_path)).start()
+    try:
+        client = FiloClient(port=srv2.http.port)
+        deadline = time.monotonic() + 20
+        n = 0
+        while time.monotonic() < deadline:
+            res = client.query_range("count_over_time(cpu[40m])",
+                                     START + 2395, START + 2395, 60)
+            if res:
+                n = sum(float(s["values"][0][1]) for s in res)
+                if n == 6 * 240:
+                    break
+            time.sleep(0.3)
+        assert n == 6 * 240, f"recovery incomplete: {n}"
+    finally:
+        srv2.shutdown()
