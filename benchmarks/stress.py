@@ -30,6 +30,7 @@ def main(argv=None):
     ap.add_argument("--seconds", type=float, default=20.0)
     ap.add_argument("--series", type=int, default=2000)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--device-pages", action="store_true")
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
@@ -47,7 +48,8 @@ def main(argv=None):
 
     ms = TimeSeriesMemStore(InMemoryColumnStore(), InMemoryMetaStore())
     shard = ms.setup("stress", 0, StoreConfig(
-        max_chunk_size=200, groups_per_shard=8, flush_task_parallelism=4))
+        max_chunk_size=200, groups_per_shard=8, flush_task_parallelism=4,
+        device_pages=args.device_pages))
     svc = QueryService(ms, "stress", 1, spread=0)
     stop = threading.Event()
     errors: list[str] = []
