@@ -1,6 +1,12 @@
 """Device-decoded series batches: compressed pages in, tensors never leave
 the TPU.
 
+No reference counterpart — this is the TPU-native replacement for the
+reference's decode-at-read of NibblePack chunks from block memory
+(``memory/src/main/scala/filodb.memory/format/vectors/``), per BASELINE.json's
+north star ("ships off-heap BinaryVector chunk pages to a TPU sidecar...
+decoded on device").
+
 The host ships bit-packed device pages (``memory/device_pages.py``) instead
 of decoded samples; decode (shifts/masks + slope reconstruction) runs
 on-device and feeds the mask-aware kernels directly. This is the north-star

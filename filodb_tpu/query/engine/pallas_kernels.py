@@ -1,5 +1,9 @@
 """Pallas TPU kernels for windowed aggregation.
 
+No reference counterpart — the reference's hot loop is per-sample JVM
+iteration (``query/.../PeriodicSamplesMapper.scala``); this is its
+explicitly-scheduled TPU form.
+
 The jit/XLA path (``kernels.py``) is the default engine; these Pallas
 formulations exist for the cases XLA's fusion can't reach — keeping the
 entire window evaluation in VMEM with explicit grids. Shapes follow the VPU
