@@ -55,7 +55,13 @@ class FiloHttpServer:
 
 
 def _parse_time(s: str) -> float:
-    return float(s)
+    """Unix seconds (float) or RFC3339 (Grafana sends either)."""
+    try:
+        return float(s)
+    except ValueError:
+        import datetime as dt
+        return dt.datetime.fromisoformat(s.replace("Z", "+00:00")) \
+            .timestamp()
 
 
 def _make_handler(server: FiloHttpServer):

@@ -233,3 +233,16 @@ class TestNameLabelMapping:
         code, body = get(server,
                          "/promql/timeseries/api/v1/label/__name__/values")
         assert body["data"] == ["http_requests_total"]
+
+
+class TestTimeFormats:
+    def test_rfc3339_times(self, server):
+        import datetime as dt
+        start = dt.datetime.fromtimestamp(START + 600, dt.timezone.utc)
+        end = dt.datetime.fromtimestamp(START + 1200, dt.timezone.utc)
+        code, body = get(server, "/promql/timeseries/api/v1/query_range",
+                         query="http_requests_total",
+                         start=start.isoformat().replace("+00:00", "Z"),
+                         end=end.isoformat().replace("+00:00", "Z"), step=60)
+        assert code == 200
+        assert len(body["data"]["result"]) == 5
