@@ -1,0 +1,128 @@
+"""Serving benchmark: concurrent HTTP clients against a live server.
+
+End-to-end throughput including HTTP, JSON rendering, planner, kernels —
+the number a dashboard fleet actually experiences (the reference's JMH
+benches stop at the query engine; this covers the full serving stack).
+
+    python benchmarks/serving.py [--clients 8] [--seconds 15] [--cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=15.0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from filodb_tpu.client import FiloClient
+    from filodb_tpu.config import ServerConfig
+    from filodb_tpu.coordinator.ingestion import route_container
+    from filodb_tpu.standalone import FiloServer
+    from filodb_tpu.testing.data import counter_series, counter_stream
+
+    tmp = tempfile.mkdtemp(prefix="filodb-serving-")
+    cfg = os.path.join(tmp, "s.json")
+    with open(cfg, "w") as f:
+        json.dump({
+            "node_name": "bench", "data_dir": os.path.join(tmp, "d"),
+            "http_port": 0, "gateway_port": 0,
+            "datasets": {"timeseries": {
+                "num_shards": 4, "spread": 1,
+                "store": {"max_chunk_size": 400, "groups_per_shard": 4,
+                          "retention_ms": 10**15}}},
+        }, f)
+    server = FiloServer(ServerConfig.load(cfg)).start()
+    try:
+        keys = counter_series(100, metric="heap_usage", ns="App-2")
+        for sd in counter_stream(keys, 720, start_ms=START * 1000, seed=1):
+            for shard, cont in route_container(sd.container, 4, 1).items():
+                server.logs[("timeseries", shard)].append(cont)
+        # wait for ingest workers
+        c0 = FiloClient(port=server.http.port)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            r = c0.query("count(heap_usage)", START + 7100)
+            if r and float(r[0]["value"][1]) == 100:
+                break
+            time.sleep(0.2)
+
+        queries = [
+            ("range", 'sum(rate(heap_usage{_ws_="demo",_ns_="App-2"}[5m]))',
+             START + 3600, START + 5400, 60),
+            ("range", 'rate(heap_usage[5m])', START + 3600, START + 5400,
+             300),
+            ("range", 'topk(5, rate(heap_usage[5m]))', START + 3600,
+             START + 4500, 300),
+            ("instant", 'sum by (job) (rate(heap_usage[5m]))',
+             START + 5000, 0, 0),
+        ]
+        # warm all query shapes
+        for kind, q, a, b, step in queries:
+            if kind == "range":
+                c0.query_range(q, a, b, step)
+            else:
+                c0.query(q, a)
+
+        stop = threading.Event()
+        counts = [0] * args.clients
+        lats: list[list[float]] = [[] for _ in range(args.clients)]
+
+        def worker(i):
+            client = FiloClient(port=server.http.port)
+            rng = np.random.default_rng(i)
+            while not stop.is_set():
+                kind, q, a, b, step = queries[rng.integers(len(queries))]
+                t0 = time.perf_counter()
+                if kind == "range":
+                    client.query_range(q, a, b, step)
+                else:
+                    client.query(q, a)
+                lats[i].append(time.perf_counter() - t0)
+                counts[i] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+                   for i in range(args.clients)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(args.seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        wall = time.perf_counter() - t_start
+        all_lats = np.array([x for lt in lats for x in lt])
+        print(json.dumps({
+            "metric": "http_serving_throughput",
+            "value": round(sum(counts) / wall, 2),
+            "unit": "queries/sec",
+            "clients": args.clients,
+            "p50_ms": round(float(np.percentile(all_lats, 50)) * 1000, 2),
+            "p99_ms": round(float(np.percentile(all_lats, 99)) * 1000, 2),
+        }))
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
