@@ -285,10 +285,11 @@ class Parser:
                                       self.params.step_ms)
                 d = -d if neg else d
                 if isinstance(e, _Selector):
-                    e = _Selector(e.filters, e.offset + d, e.at_ms)
+                    e = _Selector(e.filters, e.offset + d, e.at_ms, e.column)
                 elif isinstance(e, _RangeExpr):
                     e = _RangeExpr(_Selector(e.sel.filters, e.sel.offset + d,
-                                             e.sel.at_ms), e.window)
+                                             e.sel.at_ms, e.sel.column),
+                                   e.window)
                 elif isinstance(e, _Subquery):
                     e = _Subquery(e.inner, e.window, e.step, e.offset + d,
                                   e.at_ms)
@@ -297,10 +298,10 @@ class Parser:
             elif self.accept("OP", "@"):
                 at_ms = self._at_modifier()
                 if isinstance(e, _Selector):
-                    e = _Selector(e.filters, e.offset, at_ms)
+                    e = _Selector(e.filters, e.offset, at_ms, e.column)
                 elif isinstance(e, _RangeExpr):
                     e = _RangeExpr(_Selector(e.sel.filters, e.sel.offset,
-                                             at_ms), e.window)
+                                             at_ms, e.sel.column), e.window)
                 elif isinstance(e, _Subquery):
                     e = _Subquery(e.inner, e.window, e.step, e.offset, at_ms)
                 else:
@@ -366,6 +367,11 @@ class Parser:
 
     def _selector(self, metric: str | None):
         filters: list[ColumnFilter] = []
+        column = None
+        if metric is not None and "::" in metric:
+            # filodb extension: metric::column selects a value column
+            # (e.g. ds rollup columns min/max/sum/count/avg)
+            metric, column = metric.split("::", 1)
         if metric is not None:
             filters.append(ColumnFilter(METRIC_LABEL, Equals(metric)))
         if self.accept("OP", "{"):
@@ -391,7 +397,7 @@ class Parser:
                     break
         if not filters:
             raise ParseError("empty selector")
-        return _Selector(tuple(filters))
+        return _Selector(tuple(filters), column=column)
 
     # -- vector matching clauses --
 
@@ -602,9 +608,9 @@ class Parser:
         if sel.at_ms is not None:
             # @ pins evaluation: the chunk range collapses to that instant
             return lp.RawSeries(sel.filters, sel.at_ms, sel.at_ms, lookback,
-                                sel.offset)
+                                sel.offset, sel.column)
         return lp.RawSeries(sel.filters, p.start_ms, p.end_ms, lookback,
-                            sel.offset)
+                            sel.offset, sel.column)
 
     def _periodicize(self, sel: "_Selector") -> lp.PeriodicSeries:
         p = self.params
@@ -671,6 +677,7 @@ class _Selector:
     filters: tuple[ColumnFilter, ...]
     offset: int = 0
     at_ms: "int | None" = None
+    column: "str | None" = None
 
 
 @dataclass(frozen=True)

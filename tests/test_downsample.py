@@ -280,3 +280,25 @@ class TestCounterDownsample:
         m = np.isfinite(r.values) & np.isfinite(raw.values)
         ratio = r.values[m] / raw.values[m]
         assert 0.5 < np.median(ratio) < 2.0
+
+
+class TestColumnSelection:
+    def test_double_colon_column(self):
+        """filodb extension metric::column reads a specific value column
+        (reference ``promFilterToPartKeyBR``-era ::col syntax)."""
+        ms, cs, keys = build_raw(num_shards=1, n_samples=300)
+        DownsamplerJob(cs, "timeseries", 1, resolutions_ms=(RES,)).run(0, 2**62)
+        ds_store = DownsampledTimeSeriesStore(cs, "timeseries", RES, 1)
+        planner = SingleClusterPlanner("timeseries", 1, spread=0,
+                                       store=ds_store)
+        ctx = ExecContext(ms, "timeseries")
+        out = {}
+        for col in ("min", "max"):
+            plan = parse_query(f"heap_usage::{col}",
+                               TimeStepParams(START + 1500, 300, START + 2400))
+            r = planner.materialize(plan).execute(ctx).result
+            assert r.num_series == 6
+            out[col] = r.values
+        m = np.isfinite(out["min"]) & np.isfinite(out["max"])
+        assert (out["max"][m] >= out["min"][m]).all()
+        assert (out["max"][m] > out["min"][m]).any()
