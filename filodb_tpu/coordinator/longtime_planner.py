@@ -28,12 +28,21 @@ _DS_FN_MAP = {
     "max_over_time": ("max", "max_over_time"),
     "sum_over_time": ("sum", "sum_over_time"),
     "count_over_time": ("count", "sum_over_time"),
-    "avg_over_time": ("avg", "avg_over_time"),  # approximate (unweighted)
 }
 
 
 def rewrite_for_downsample(plan: lp.LogicalPlan) -> lp.LogicalPlan:
     if isinstance(plan, lp.PeriodicSeriesWithWindowing):
+        if plan.function == "avg_over_time" and plan.raw.column is None:
+            # EXACT average over rollups: Σ(sum col) / Σ(count col)
+            # (reference dAvgAc: average carries its count)
+            num = dataclasses.replace(
+                plan, raw=dataclasses.replace(plan.raw, column="sum"),
+                function="sum_over_time")
+            den = dataclasses.replace(
+                plan, raw=dataclasses.replace(plan.raw, column="count"),
+                function="sum_over_time")
+            return lp.BinaryJoin(num, "/", den)
         m = _DS_FN_MAP.get(plan.function)
         if m is not None and plan.raw.column is None:
             col, fn = m

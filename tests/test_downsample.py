@@ -302,3 +302,44 @@ class TestColumnSelection:
         m = np.isfinite(out["min"]) & np.isfinite(out["max"])
         assert (out["max"][m] >= out["min"][m]).all()
         assert (out["max"][m] > out["min"][m]).any()
+
+
+class TestExactDsAvg:
+    def test_avg_over_time_sum_count_semantics(self):
+        """avg_over_time over rollups = Σsum/Σcount (reference dAvgAc
+        semantics) — matches the raw average up to the inherent rollup
+        boundary effect (a raw sample exactly on the left window edge
+        belongs to the period but not the left-exclusive window)."""
+        ms, cs, keys = build_raw(num_shards=1, n_samples=600)
+        DownsamplerJob(cs, "timeseries", 1, resolutions_ms=(RES,)).run(0, 2**62)
+        ds_store = DownsampledTimeSeriesStore(cs, "timeseries", RES, 1)
+        planner = SingleClusterPlanner("timeseries", 1, spread=0,
+                                       store=ds_store)
+        ctx = ExecContext(ms, "timeseries")
+        # window = 2 whole 5m periods, step lands on period boundaries
+        bucket0 = (START * 1000 // RES) * RES
+        start_s = (bucket0 + 4 * RES) // 1000
+        plan = parse_query("avg_over_time(heap_usage[10m])",
+                           TimeStepParams(start_s, RES // 1000,
+                                          start_s + 2 * RES // 1000))
+        ep = planner.materialize(rewrite_for_downsample_import()(plan))
+        r = ep.execute(ctx).result
+        assert r.num_series == 6
+        # ground truth from raw samples
+        from filodb_tpu.coordinator.query_service import QueryService
+        raw = QueryService(ms, "timeseries", 1, spread=0).query_range(
+            "avg_over_time(heap_usage[10m])", start_s, RES // 1000,
+            start_s + 2 * RES // 1000).result
+        def by_inst(mat):
+            return {k.label_map["instance"]: mat.values[i]
+                    for i, k in enumerate(mat.keys)}
+        got, want = by_inst(r), by_inst(raw)
+        for inst in want:
+            m = np.isfinite(want[inst]) & np.isfinite(got[inst])
+            np.testing.assert_allclose(got[inst][m], want[inst][m],
+                                       rtol=5e-3, err_msg=inst)
+
+
+def rewrite_for_downsample_import():
+    from filodb_tpu.coordinator.longtime_planner import rewrite_for_downsample
+    return rewrite_for_downsample
