@@ -543,9 +543,17 @@ class Parser:
                     fn_params, range_arg.window, sub_step, range_arg.offset)
             sel = range_arg.sel
             raw = self._raw(sel, range_arg.window)
-            return lp.PeriodicSeriesWithWindowing(
-                raw, p.start_ms, p.step_ms, p.end_ms, range_arg.window, name,
+            psww = lp.PeriodicSeriesWithWindowing(
+                raw, p.start_ms, p.step_ms, p.end_ms, range_arg.window,
+                "present_over_time" if name == "absent_over_time" else name,
                 fn_params, sel.offset, sel.at_ms)
+            if name == "absent_over_time":
+                # promql: 1 when NO matching series has samples in the window
+                # (combine across series, like absent())
+                return lp.ApplyAbsentFunction(
+                    psww, sel.filters, p.start_ms, p.step_ms or 1000,
+                    p.end_ms)
+            return psww
 
         if name in lp.INSTANT_FUNCTIONS:
             if not args and name in ("hour", "minute", "month", "year",

@@ -507,3 +507,21 @@ class TestPromFlatBuckets:
             START + 600, 300, START + 2300).result
         m = np.isfinite(lo.values) & np.isfinite(hi.values)
         assert (hi.values[m] >= lo.values[m]).all()
+
+
+class TestAbsentOverTime:
+    def test_absent_over_time_semantics(self, gauge_svc):
+        svc, _ = gauge_svc
+        # present metric → empty result
+        r = svc.query_range('absent_over_time(heap_usage[5m])',
+                            START + 3600, 300, START + 3900).result
+        assert r.num_series == 0
+        # missing metric → single all-ones series
+        r = svc.query_range('absent_over_time(no_such_metric[5m])',
+                            START + 3600, 300, START + 3900).result
+        assert r.num_series == 1
+        assert (r.values == 1.0).all()
+        # present data but window entirely before it → absent
+        r = svc.query_range('absent_over_time(heap_usage[5m])',
+                            START - 7200, 300, START - 6900).result
+        assert r.num_series == 1
