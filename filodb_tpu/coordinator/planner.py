@@ -268,7 +268,12 @@ class SingleClusterPlanner(QueryPlanner):
                                        step=plan.step or 1000, end=plan.end)
 
     def _mat_ScalarVaryingDoublePlan(self, plan, q) -> ExecPlan:
-        return ScalarVaryingExec(inner=self._walk(plan.vector, q))
+        from filodb_tpu.coordinator.longtime_planner import _plan_times
+        times = _plan_times(plan.vector)
+        start, step, end = (times[0], max(times[1], 1), times[2]) if times \
+            else (0, 1000, 0)
+        return ScalarVaryingExec(inner=self._walk(plan.vector, q),
+                                 start=start, step=step, end=end)
 
     def _mat_ScalarBinaryOperation(self, plan, q) -> ExecPlan:
         def conv(x):

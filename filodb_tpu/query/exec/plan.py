@@ -384,12 +384,18 @@ class ScalarVaryingExec(ExecPlan):
     """scalar(vector): per-step scalar; NaN unless exactly one series."""
 
     inner: ExecPlan | None = None
+    start: int = 0
+    step: int = 1000
+    end: int = 0
 
     def execute_scalar(self, ctx) -> ScalarResult:
         data = self.inner.dispatcher.dispatch(self.inner, ctx).result
         if data.num_series == 0:
-            # no series: need steps; empty matrix may carry steps
-            return ScalarResult(np.full(data.num_steps, np.nan), data.steps_ms)
+            # no matching series: still emit NaN per step (an empty inner
+            # matrix may carry no steps at all)
+            steps = (data.steps_ms if data.num_steps
+                     else steps_array(self.start, self.step, self.end))
+            return ScalarResult(np.full(len(steps), np.nan), steps)
         present = ~np.isnan(data.values)
         cnt = present.sum(axis=0)
         vals = np.where(cnt == 1, np.nansum(data.values, axis=0), np.nan)

@@ -525,3 +525,17 @@ class TestAbsentOverTime:
         r = svc.query_range('absent_over_time(heap_usage[5m])',
                             START - 7200, 300, START - 6900).result
         assert r.num_series == 1
+
+
+class TestScalarOfEmpty:
+    def test_scalar_of_missing_metric(self, gauge_svc):
+        svc, _ = gauge_svc
+        # scalar() over a selector matching nothing: vector arithmetic
+        # proceeds with NaN per step (promql semantics)
+        r = svc.query_range('heap_usage * scalar(no_such_metric)',
+                            START + 3600, 300, START + 3900).result
+        assert r.compact().num_series == 0  # all NaN
+        r2 = svc.query_range('scalar(no_such_metric)',
+                             START + 3600, 300, START + 3900).result
+        assert r2.num_series == 1
+        assert np.isnan(r2.values).all()
