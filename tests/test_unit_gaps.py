@@ -165,3 +165,71 @@ class TestLocalStoreReopen:
         cs2 = LocalDiskColumnStore(str(tmp_path))
         assert len(cs2.scan_part_keys("ds", 0)) == 1
         cs2.close()
+
+
+class TestMemberRegistry:
+    def test_roles_and_coordinator(self, tmp_path):
+        from filodb_tpu.coordinator.bootstrap import MemberRegistry
+        reg = MemberRegistry(str(tmp_path / "members.txt"))
+        reg.register("coord", "a", "127.0.0.1", 1000)
+        reg.register("member", "b", "127.0.0.1", 1001)
+        assert reg.current_coordinator() == "a"
+        # promotion appends a new coord line; latest wins
+        reg.register("coord", "b", "127.0.0.1", 1001)
+        assert reg.current_coordinator() == "b"
+        members = reg.members()
+        assert members["b"][0] == "coord"
+        assert members["a"] == ("coord", "127.0.0.1", 1000)
+
+
+class TestTornWAL:
+    def test_torn_tail_ignored_on_recovery(self, tmp_path):
+        from filodb_tpu.kafka.log import FileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        p = str(tmp_path / "wal.log")
+        log = FileLog(p)
+        keys = machine_metrics_series(1)
+        for sd in gauge_stream(keys, 10, batch=1, start_ms=0):
+            log.append(sd.container)
+        log.close()
+        # simulate a torn write: garbage length header + partial payload
+        with open(p, "ab") as f:
+            f.write((99999).to_bytes(4, "little") + b"partial-garbage")
+        log2 = FileLog(p)
+        assert log2.latest_offset == 9  # torn tail dropped
+        assert len(list(log2.read_from(0))) == 10
+        # appends continue cleanly after the torn tail
+        for sd in gauge_stream(keys, 1, batch=1, start_ms=10**9):
+            log2.append(sd.container)
+        assert log2.latest_offset == 10
+
+
+class TestRemoteProtocol:
+    def test_unknown_control_message(self):
+        from filodb_tpu.coordinator.remote import (
+            PlanExecutorServer,
+            RemotePlanDispatcher,
+        )
+        srv = PlanExecutorServer(None).start()
+        try:
+            d = RemotePlanDispatcher("127.0.0.1", srv.port)
+            with pytest.raises(RuntimeError, match="unknown message"):
+                d.call("no_such_op", 1, 2)
+            assert d.ping()  # connection still healthy after the error
+        finally:
+            srv.stop()
+
+
+class TestLogicalParserFilters:
+    def test_in_filter_renders_as_regex(self):
+        from filodb_tpu.core.filters import ColumnFilter, In
+        from filodb_tpu.core.partkey import METRIC_LABEL
+        from filodb_tpu.core.filters import Equals
+        from filodb_tpu.query import logical as lp
+        from filodb_tpu.query.logical_parser import to_promql
+        raw = lp.RawSeries(
+            (ColumnFilter(METRIC_LABEL, Equals("m")),
+             ColumnFilter("host", In(frozenset(["a", "b"])))),
+            0, 1000)
+        plan = lp.PeriodicSeries(raw, 0, 1000, 10_000)
+        assert to_promql(plan) == 'm{host=~"a|b"}'
