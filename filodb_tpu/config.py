@@ -18,6 +18,7 @@ DEFAULTS = {
     "node_name": "node-0",
     "data_dir": "./filodb-data",
     "wal_dir": None,
+    "wal_fsync": False,           # fsync every WAL append (power-failure safe)
     "http_port": 8080,
     "gateway_port": 0,            # 0 = disabled
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
@@ -48,6 +49,7 @@ class ServerConfig:
     node_name: str = "node-0"
     data_dir: str = "./filodb-data"
     wal_dir: str | None = None  # shared log dir (the "Kafka"); default in data_dir
+    wal_fsync: bool = False     # fsync every WAL append (power-failure safe)
     http_port: int = 8080
     gateway_port: int = 0
     executor_port: int = 0
@@ -80,6 +82,7 @@ class ServerConfig:
         return ServerConfig(
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],
             wal_dir=cfg.get("wal_dir"),
+            wal_fsync=cfg.get("wal_fsync", False),
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),

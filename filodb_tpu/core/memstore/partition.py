@@ -39,7 +39,7 @@ class TimeSeriesPartition:
 
     __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "chunks",
                  "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard",
-                 "device_pages")
+                 "device_pages", "_dedup_floor")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: Schema,
                  max_chunk_size: int = 400, shard: int = 0,
@@ -56,6 +56,11 @@ class TimeSeriesPartition:
         self.bucket_les: np.ndarray | None = None
         # encode device pages at chunk-seal time (decode-on-TPU query path)
         self.device_pages = device_pages
+        # out-of-order floor seeded at recovery with the max persisted chunk
+        # timestamp, so WAL replay of rows already flushed before a crash is
+        # dropped instead of double-written (evicted chunks keep protecting
+        # against re-ingest the same way)
+        self._dedup_floor = -1
 
     def _new_buffers(self) -> _Buffers:
         cols = []
@@ -77,10 +82,15 @@ class TimeSeriesPartition:
     @property
     def latest_ts(self) -> int:
         if self._buf.n:
-            return int(self._buf.ts[self._buf.n - 1])
+            return max(int(self._buf.ts[self._buf.n - 1]), self._dedup_floor)
         if self.chunks:
-            return self.chunks[-1].end_time
-        return -1
+            return max(self.chunks[-1].end_time, self._dedup_floor)
+        return self._dedup_floor
+
+    def seed_dedup_floor(self, ts: int) -> None:
+        """Raise the out-of-order floor (recovery: max persisted ts)."""
+        if ts > self._dedup_floor:
+            self._dedup_floor = ts
 
     @property
     def earliest_ts(self) -> int:
@@ -214,6 +224,10 @@ class TimeSeriesPartition:
         """Drop already-persisted chunks from memory (they remain readable via
         on-demand paging). Reference: block reclaim / partition eviction."""
         before = len(self.chunks)
+        evicted = [c for c in self.chunks if c.id <= self._flushed_id]
+        if evicted:
+            # keep rejecting re-ingest of timestamps the evicted chunks held
+            self.seed_dedup_floor(max(c.end_time for c in evicted))
         self.chunks = [c for c in self.chunks if c.id > self._flushed_id]
         return before - len(self.chunks)
 

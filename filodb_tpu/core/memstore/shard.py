@@ -95,6 +95,11 @@ class TimeSeriesShard:
         # the reference keeping chunks hot in block memory across queries)
         self.batch_cache: dict = {}
         self.batch_cache_cap = 64
+        # max persisted chunk ts per part key, loaded at recovery; every
+        # partition created afterwards (index scan OR replay — a crash can
+        # land between write_chunks and write_part_keys, so replay may be
+        # what re-creates the partition) seeds its dedup floor from here
+        self._persisted_floors: dict[PartKey, int] = {}
 
     @property
     def data_version(self) -> int:
@@ -129,6 +134,9 @@ class TimeSeriesShard:
                 cls = TracingTimeSeriesPartition
         part = cls(pid, key, schema, self.config.max_chunk_size,
                    self.shard_num, device_pages=self.config.device_pages)
+        floor = self._persisted_floors.get(key)
+        if floor is not None:
+            part.seed_dedup_floor(floor)
         self.partitions.append(part)
         self._by_key[key] = pid
         self.index.add_part_key(pid, key, first_ts)
@@ -199,6 +207,15 @@ class TimeSeriesShard:
             ingestion_time = int(_time.time() * 1000)
         written = 0
         dirty_pks: list[PartKeyRecord] = []
+        # Capture the checkpoint offset BEFORE snapshotting any buffers:
+        # rows at or below this offset are guaranteed to be in the buffers
+        # we are about to seal. Rows ingested mid-flush (offset > captured)
+        # may or may not make this flush; they stay above the watermark and
+        # are replayed on recovery (idempotent: duplicate timestamps are
+        # dropped as out-of-order). The reference captures the flush
+        # watermark at buffer-switch time for the same reason.
+        with self.write_lock:
+            checkpoint_offset = self._ingested_offset
         for part in self.partitions:
             if part is None or self.group_of(part.part_key) != group:
                 continue
@@ -222,9 +239,9 @@ class TimeSeriesShard:
                                               dirty_pks)
         # checkpoint: everything at or below this offset for this group is safe
         self.meta_store.write_checkpoint(self.dataset, self.shard_num, group,
-                                         self._ingested_offset)
+                                         checkpoint_offset)
         self.group_watermarks[group] = max(self.group_watermarks[group],
-                                           self._ingested_offset)
+                                           checkpoint_offset)
         self.stats.chunks_flushed.inc(written)
         self.stats.flushes_done.inc()
         return written
@@ -263,11 +280,19 @@ class TimeSeriesShard:
 
     def recover_index(self) -> int:
         """Rebuild the tag index from persisted part keys (reference
-        ``IndexBootstrapper.bootstrapIndexRaw``). Returns #keys restored."""
+        ``IndexBootstrapper.bootstrapIndexRaw``). Returns #keys restored.
+
+        Each recovered partition's out-of-order floor is seeded with the max
+        persisted chunk timestamp so WAL replay of rows that were flushed
+        just before the crash (ingested mid-flush, above the checkpoint) is
+        deduplicated instead of double-written."""
+        self._persisted_floors = self.column_store.max_persisted_ts(
+            self.dataset, self.shard_num)
         n = 0
         for rec in self.column_store.scan_part_keys(self.dataset, self.shard_num):
             if rec.part_key in self._by_key:
                 continue
+            # get_or_create_partition seeds the dedup floor
             part = self.get_or_create_partition(rec.part_key, rec.start_time)
             self.index.update_end_time(part.part_id, rec.end_time)
             self._dirty_part_keys.discard(part.part_id)

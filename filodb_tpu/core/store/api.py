@@ -61,6 +61,14 @@ class ColumnStore:
         """Remove part keys + their chunks (cardinality buster)."""
         raise NotImplementedError
 
+    def max_persisted_ts(self, dataset: str, shard: int
+                         ) -> dict[PartKey, int]:
+        """Max persisted chunk end_time per part key. Recovery seeds each
+        partition's out-of-order floor from this so WAL replay of rows that
+        were already flushed (ingested mid-flush, above the checkpoint) is
+        deduplicated instead of double-written."""
+        return {}
+
 
 class MetaStore:
     """Cluster metadata + ingestion checkpoints."""
@@ -155,6 +163,11 @@ class InMemoryColumnStore(ColumnStore):
         for pk in part_keys:
             d.pop(pk, None)
             c.pop(pk, None)
+
+    def max_persisted_ts(self, dataset, shard):
+        return {pk: max(c.end_time for _, c in entries)
+                for pk, entries in self._chunks[(dataset, shard)].items()
+                if entries}
 
 
 class InMemoryMetaStore(MetaStore):

@@ -168,6 +168,13 @@ class LocalDiskColumnStore(ColumnStore):
                           "partition=?", (blob,))
             c.commit()
 
+    def max_persisted_ts(self, dataset, shard):
+        c = self._db.conn(dataset, shard)
+        rows = c.execute(
+            "SELECT partition, MAX(end_time) FROM chunks GROUP BY partition"
+        ).fetchall()
+        return {_pk_from_blob(b): int(mx) for b, mx in rows}
+
     def close(self):
         self._db.close()
 

@@ -143,7 +143,12 @@ def _make_handler(server: FiloHttpServer):
                 return self._send(200, promjson.matrix_json(r))
             if rest == ["query"]:
                 query = qs["query"][0]
-                t = int(_parse_time(qs.get("time", ["0"])[0]))
+                if "time" in qs:
+                    t = int(_parse_time(qs["time"][0]))
+                else:
+                    # Prometheus defaults instant queries to server time
+                    import time as _time
+                    t = int(_time.time())
                 r = svc.query_instant(query, t)
                 return self._send(200, promjson.vector_json(r))
             if rest == ["series"]:

@@ -30,6 +30,15 @@ class ReplayLog:
     def latest_offset(self) -> int:
         raise NotImplementedError
 
+    def align_after(self, offset: int) -> None:
+        """Ensure the next append is assigned an offset strictly greater
+        than ``offset``. Recovery calls this with the max group checkpoint:
+        a torn tail may have destroyed records whose offsets were already
+        checkpointed, and reusing those offsets would make the watermark
+        skip-check silently drop new acknowledged rows. Default no-op —
+        in-process logs die with the process, so the collision cannot
+        arise; ``SegmentedFileLog`` rolls a fresh segment past the offset.
+        """
 
 class InMemoryLog(ReplayLog):
     def __init__(self):
@@ -56,13 +65,19 @@ class FileLog(ReplayLog):
 
     Layout per entry: u32 length | container bytes. A side index file holds
     (offset, file_pos) every ``index_every`` entries for seek-on-replay.
+
+    Durability: by default acknowledged appends survive *process* crashes
+    (buffered write + flush) but not OS/power failure; pass ``fsync=True``
+    to fsync every append (the reference delegates this to Kafka acks).
     """
 
     MAGIC = b"FLOG1"
 
-    def __init__(self, path: str, index_every: int = 64):
+    def __init__(self, path: str, index_every: int = 64,
+                 fsync: bool = False):
         self.path = path
         self.index_every = index_every
+        self.fsync = fsync
         self._lock = threading.Lock()
         self._count = 0
         self._index: list[tuple[int, int]] = []  # (offset, pos)
@@ -72,6 +87,18 @@ class FileLog(ReplayLog):
         else:
             with open(path, "wb") as f:
                 f.write(self.MAGIC)
+                if fsync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            if fsync:
+                # the directory entry of a fresh segment must also be durable
+                # or the whole file (incl. later fsync'd appends) can vanish
+                # on power failure
+                dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
         self._f = open(path, "ab")
 
     def _recover_scan(self):
@@ -84,11 +111,17 @@ class FileLog(ReplayLog):
                 f.seek(pos)
                 (ln,) = struct.unpack("<I", f.read(4))
                 if pos + 4 + ln > size:
-                    break  # truncated tail (torn write): ignore
+                    break  # truncated tail (torn write)
                 if self._count % self.index_every == 0:
                     self._index.append((self._count, pos))
                 pos += 4 + ln
                 self._count += 1
+        if pos < size:
+            # Torn tail: records appended after reopening in append mode
+            # would land after the garbage bytes and be unreadable, so cut
+            # the file back to the last complete record.
+            with open(self.path, "r+b") as f:
+                f.truncate(pos)
 
     def append(self, container: RecordContainer) -> int:
         payload = container.serialize()
@@ -99,6 +132,8 @@ class FileLog(ReplayLog):
             self._f.write(struct.pack("<I", len(payload)))
             self._f.write(payload)
             self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
             off = self._count
             self._count += 1
             return off
@@ -143,10 +178,11 @@ class SegmentedFileLog(ReplayLog):
     (``truncate_before``), bounding WAL growth without rewrite."""
 
     def __init__(self, directory: str, segment_entries: int = 4096,
-                 index_every: int = 64):
+                 index_every: int = 64, fsync: bool = False):
         self.dir = directory
         self.segment_entries = segment_entries
         self.index_every = index_every
+        self.fsync = fsync
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         self._segments: list[tuple[int, FileLog]] = []  # (first_offset, log)
@@ -155,14 +191,14 @@ class SegmentedFileLog(ReplayLog):
                 first = int(name[4:-4])
                 self._segments.append(
                     (first, FileLog(os.path.join(directory, name),
-                                    index_every)))
+                                    index_every, fsync=fsync)))
         if not self._segments:
             self._roll(0)
 
     def _roll(self, first_offset: int) -> None:
         path = os.path.join(self.dir, f"seg-{first_offset:020d}.log")
-        self._segments.append((first_offset, FileLog(path,
-                                                     self.index_every)))
+        self._segments.append((first_offset, FileLog(path, self.index_every,
+                                                     fsync=self.fsync)))
 
     def append(self, container: RecordContainer) -> int:
         with self._lock:
@@ -189,6 +225,15 @@ class SegmentedFileLog(ReplayLog):
     def latest_offset(self) -> int:
         first, seg = self._segments[-1]
         return first + seg.latest_offset
+
+    def align_after(self, offset: int) -> None:
+        with self._lock:
+            first, seg = self._segments[-1]
+            if first + seg.latest_offset >= offset:
+                return
+            if first > offset and seg.latest_offset < 0:
+                return  # empty segment already starts past the offset
+            self._roll(offset + 1)
 
     def truncate_before(self, offset: int) -> int:
         """Delete whole segments entirely below ``offset``. Returns segments

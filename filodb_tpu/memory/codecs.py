@@ -38,8 +38,9 @@ CODEC_DELTA_DELTA = 1
 CODEC_DELTA_DELTA_CONST = 2
 CODEC_XOR_DOUBLE = 3
 CODEC_HIST_2D_DELTA = 4
-CODEC_DICT_STRING = 5
+CODEC_DICT_STRING = 5          # legacy: NUL-separated dictionary (decode only)
 CODEC_RAW_DOUBLE = 6
+CODEC_DICT_STRING_LP = 7       # u32-length-prefixed dictionary entries
 
 
 def encode_delta_delta(values: np.ndarray) -> bytes:
@@ -131,15 +132,23 @@ def decode_hist_2d_delta(data: bytes) -> HistogramColumn:
 
 
 def encode_dict_string(values: list[str]) -> bytes:
-    """Dictionary-encode a string column: unique blob table + int codes."""
+    """Dictionary-encode a string column: unique blob table + int codes.
+    Dictionary entries are u32-length-prefixed (not NUL-separated) so values
+    containing ``\\x00`` round-trip."""
     uniq: dict[str, int] = {}
     codes = np.empty(len(values), dtype=np.int64)
     for i, s in enumerate(values):
         codes[i] = uniq.setdefault(s, len(uniq))
-    blob = b"\x00".join(s.encode("utf-8") for s in uniq)
+    parts = []
+    for s in uniq:
+        b = s.encode("utf-8")
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    blob = b"".join(parts)
     packed_codes = nibble_pack(codes.astype(np.uint64))
     return (
-        struct.pack("<BIII", CODEC_DICT_STRING, len(values), len(uniq), len(blob))
+        struct.pack("<BIII", CODEC_DICT_STRING_LP, len(values), len(uniq),
+                    len(blob))
         + blob
         + packed_codes
     )
@@ -147,11 +156,24 @@ def encode_dict_string(values: list[str]) -> bytes:
 
 def decode_dict_string(data: bytes) -> list[str]:
     codec, n, nuniq, bloblen = struct.unpack_from("<BIII", data, 0)
-    assert codec == CODEC_DICT_STRING, f"bad codec {codec}"
+    assert codec in (CODEC_DICT_STRING, CODEC_DICT_STRING_LP), \
+        f"bad codec {codec}"
     off = struct.calcsize("<BIII")
-    blob = data[off : off + bloblen]
-    table = [s.decode("utf-8") for s in blob.split(b"\x00")] if nuniq else []
-    codes = nibble_unpack(data[off + bloblen :], n)
+    end = off + bloblen
+    table: list[str] = []
+    if codec == CODEC_DICT_STRING:
+        # legacy on-disk chunks: NUL-separated dictionary (cannot hold NULs)
+        blob = data[off:end]
+        table = [s.decode("utf-8") for s in blob.split(b"\x00")] if nuniq \
+            else []
+    else:
+        while off < end:
+            (ln,) = struct.unpack_from("<I", data, off)
+            off += 4
+            table.append(data[off : off + ln].decode("utf-8"))
+            off += ln
+    assert len(table) == nuniq, f"dict table {len(table)} != {nuniq}"
+    codes = nibble_unpack(data[end:], n)
     return [table[int(c)] for c in codes]
 
 
@@ -187,7 +209,7 @@ def decode_any(data: bytes) -> np.ndarray | list[str]:
         return decode_xor_double(data)
     if codec == CODEC_HIST_2D_DELTA:
         return decode_hist_2d_delta(data)
-    if codec == CODEC_DICT_STRING:
+    if codec in (CODEC_DICT_STRING, CODEC_DICT_STRING_LP):
         return decode_dict_string(data)
     if codec == CODEC_RAW_DOUBLE:
         return decode_raw_double(data)

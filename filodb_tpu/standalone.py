@@ -54,7 +54,9 @@ class FiloServer:
     def _shard_log(self, dataset: str, shard: int) -> SegmentedFileLog:
         key = (dataset, shard)
         if key not in self.logs:
-            self.logs[key] = SegmentedFileLog(self._wal_path(dataset, shard))
+            self.logs[key] = SegmentedFileLog(
+                self._wal_path(dataset, shard),
+                fsync=self.config.wal_fsync)
         return self.logs[key]
 
     # -- control handlers (member side; reference NodeCoordinatorActor) --
@@ -356,6 +358,16 @@ def main(argv=None):
     ap.add_argument("--config", help="server config JSON", default=None)
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # Honor JAX_PLATFORMS even when a sitecustomize has overridden
+    # jax_platforms at interpreter boot (e.g. to a tunneled TPU backend):
+    # the operator's env choice wins.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            import jax
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # pragma: no cover - jax always importable here
+            log.warning("could not apply JAX_PLATFORMS=%s", plat)
     server = FiloServer(ServerConfig.load(args.config)).start()
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))

@@ -175,6 +175,25 @@ class TestDictString:
         vals = ["héllo", "wörld", "héllo"]
         assert decode_dict_string(encode_dict_string(vals)) == vals
 
+    def test_nul_bytes_in_values(self):
+        # entries are length-prefixed, so embedded NULs must round-trip
+        vals = ["a\x00b", "", "\x00", "a\x00b", "plain"]
+        assert decode_dict_string(encode_dict_string(vals)) == vals
+
+    def test_legacy_nul_separated_format_still_decodes(self):
+        # chunks persisted before the length-prefix change carry codec id 5
+        # with a NUL-joined dictionary; they must keep decoding
+        import struct
+        from filodb_tpu.memory.codecs import CODEC_DICT_STRING, nibble_pack
+        vals = ["a", "b", "a"]
+        blob = b"\x00".join(s.encode() for s in ("a", "b"))
+        codes = nibble_pack(np.array([0, 1, 0], dtype=np.uint64))
+        legacy = struct.pack("<BIII", CODEC_DICT_STRING, 3, 2, len(blob)) \
+            + blob + codes
+        assert decode_dict_string(legacy) == vals
+        from filodb_tpu.memory.codecs import decode_any
+        assert decode_any(legacy) == vals
+
 
 class TestDispatch:
     def test_decode_any(self):

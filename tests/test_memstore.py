@@ -137,6 +137,140 @@ class TestFlushAndRecovery:
         assert len(cps) == 4
         assert min(cps.values()) == shard.latest_offset
 
+    def test_checkpoint_captured_before_buffer_snapshot(self):
+        # Rows ingested WHILE a flush is in progress must stay above the
+        # group watermark (they live only in unsealed buffers); the
+        # checkpoint must be the offset captured before snapshotting, not
+        # the post-flush ingested offset.
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("timeseries", 0, small_config(groups_per_shard=1))
+        keys = machine_metrics_series(2)
+        stream = list(gauge_stream(keys, 50, batch=1))
+        for data in stream[:40]:
+            shard.ingest(data)
+        pre_flush_offset = shard.latest_offset
+
+        late = stream[40:]
+        orig_write = cs.write_chunks
+
+        def write_and_ingest_mid_flush(*a, **kw):
+            # simulate concurrent ingest racing the flush I/O
+            while late:
+                shard._ingest_locked(late[0], late[0].offset)
+                late.pop(0)
+            return orig_write(*a, **kw)
+
+        cs.write_chunks = write_and_ingest_mid_flush
+        shard.flush_group(0)
+        cps = meta.read_checkpoints("timeseries", 0)
+        assert cps[0] == pre_flush_offset
+        assert shard.group_watermarks[0] == pre_flush_offset
+        assert shard.latest_offset > pre_flush_offset
+
+    def test_no_duplicates_when_mid_flush_rows_replay_after_crash(self):
+        # Rows ingested mid-flush can be BOTH persisted (their partition's
+        # buffer snapshot ran after they landed) and above the checkpoint.
+        # After a crash, replay must not double-ingest them: recovery seeds
+        # each partition's out-of-order floor from the max persisted ts.
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("timeseries", 0, small_config(groups_per_shard=1))
+        keys = machine_metrics_series(2)
+        stream = list(gauge_stream(keys, 50, batch=1))
+        for data in stream[:40]:
+            shard.ingest(data)
+
+        late = stream[40:]
+        orig_write = cs.write_chunks
+
+        def write_and_ingest_mid_flush(*a, **kw):
+            while late:
+                shard._ingest_locked(late[0], late[0].offset)
+                late.pop(0)
+            return orig_write(*a, **kw)
+
+        cs.write_chunks = write_and_ingest_mid_flush
+        # During this flush the hook fires at the FIRST partition's chunk
+        # write, so the SECOND partition's buffer seal (which happens later
+        # in the group loop) includes its late rows: those rows end up
+        # persisted AND above the group checkpoint. Crash follows — no
+        # further flush advances the checkpoint.
+        shard.flush_group(0)
+        cs.write_chunks = orig_write
+
+        # crash + restart: fresh memstore on the same stores
+        ms2 = TimeSeriesMemStore(cs, meta)
+        shard2 = ms2.setup("timeseries", 0, small_config(groups_per_shard=1))
+        shard2.recover_index()
+        shard2.setup_watermarks_for_recovery()
+        for data in stream:
+            shard2.ingest(data)
+        shard2.flush_all()
+        # every persisted timestamp for every series must be unique
+        for key in keys:
+            chunks = cs.read_chunks("timeseries", 0, key, 0, 10**15)
+            all_ts = [t for c in chunks for t in c.decode_column(0)]
+            assert len(all_ts) == len(set(all_ts)), \
+                f"duplicate persisted samples for {key}"
+
+    def test_floor_applies_to_partitions_recreated_by_replay(self):
+        # Crash between write_chunks and write_part_keys: the part-key
+        # record is missing, so recover_index doesn't restore the partition
+        # — replay re-creates it and must still get the persisted-ts floor.
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("timeseries", 0, small_config(groups_per_shard=1))
+        keys = machine_metrics_series(1)
+        stream = list(gauge_stream(keys, 30, batch=1))
+        for data in stream:
+            shard.ingest(data)
+        orig_wpk = cs.write_part_keys
+        # crash after write_chunks but before write_part_keys (and therefore
+        # before the checkpoint, which flush_group writes after part keys)
+        def crash(*a, **kw):
+            raise RuntimeError("simulated crash")
+
+        cs.write_part_keys = crash
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="simulated crash"):
+            shard.flush_group(0)
+        cs.write_part_keys = orig_wpk
+
+        ms2 = TimeSeriesMemStore(cs, meta)
+        shard2 = ms2.setup("timeseries", 0, small_config(groups_per_shard=1))
+        assert shard2.recover_index() == 0  # no part-key record survived
+        # replay the WAL, then live tail rows arrive before the next flush
+        tail = list(gauge_stream(keys, 10, batch=1,
+                                 start_ms=30 * 60_000,
+                                 start_offset=len(stream)))
+        for data in stream + tail:
+            shard2.ingest(data)
+        shard2.flush_all()
+        chunks = cs.read_chunks("timeseries", 0, keys[0], 0, 10**15)
+        all_ts = sorted(t for c in chunks for t in c.decode_column(0))
+        # no duplicates AND no silent loss: without the replay-seeded floor
+        # the re-built buffer re-seals under the crashed flush's partial
+        # chunk id and the store's id-dedup drops the tail samples
+        assert len(all_ts) == len(set(all_ts)), "duplicate persisted samples"
+        assert len(set(all_ts)) == 40, f"lost samples: {len(set(all_ts))}/40"
+
+    def test_evicted_chunks_keep_dedup_floor(self):
+        key = machine_metrics_series(1)[0]
+        p = TimeSeriesPartition(0, key, DEFAULT_SCHEMAS["gauge"],
+                                max_chunk_size=10)
+        for i in range(20):
+            p.ingest(i * 1000, (float(i),))
+        p.mark_flushed(max(c.id for c in p.chunks))
+        # 20 ingests at chunk size 10 auto-sealed two chunks; buffer is empty
+        evicted = p.evict_flushed_chunks()
+        assert evicted == 2
+        # timestamps covered by the evicted chunks must still be rejected
+        assert not p.ingest(5_000, (99.0,))
+        assert not p.ingest(9_000, (99.0,))
+        # fresh timestamps keep flowing
+        assert p.ingest(30_000, (30.0,))
+
     def test_recovery_skips_below_watermark(self):
         cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
         ms = TimeSeriesMemStore(cs, meta)

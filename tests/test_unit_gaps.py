@@ -202,6 +202,75 @@ class TestTornWAL:
         for sd in gauge_stream(keys, 1, batch=1, start_ms=10**9):
             log2.append(sd.container)
         assert log2.latest_offset == 10
+        # the torn bytes were truncated, so the full log (including the
+        # post-recovery append) reads back cleanly
+        entries = list(log2.read_from(0))
+        assert len(entries) == 11
+        assert entries[-1].offset == 10
+        last_recs = list(entries[-1].container)
+        assert last_recs[0].timestamp >= 10**9
+        log2.close()
+        # and the file survives a further reopen
+        log3 = FileLog(p)
+        assert len(list(log3.read_from(0))) == 11
+        log3.close()
+
+
+class TestAlignAfter:
+    def test_offsets_never_reused_after_checkpointed_torn_tail(self, tmp_path):
+        # A torn tail can destroy records whose offsets were already
+        # checkpointed; align_after must push the next append past them.
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        keys = machine_metrics_series(1)
+        log = SegmentedFileLog(str(tmp_path / "wal"))
+        for sd in gauge_stream(keys, 5, batch=1):
+            log.append(sd.container)
+        assert log.latest_offset == 4
+        # checkpoint said offset 6 was acked (records 5,6 torn away)
+        log.align_after(6)
+        sd = next(gauge_stream(keys, 1, batch=1, start_ms=10**9))
+        assert log.append(sd.container) == 7
+        offsets = [e.offset for e in log.read_from(0)]
+        assert offsets == [0, 1, 2, 3, 4, 7]
+        log.close()
+        # survives reopen: segment numbering carries the gap
+        log2 = SegmentedFileLog(str(tmp_path / "wal"))
+        assert [e.offset for e in log2.read_from(0)] == [0, 1, 2, 3, 4, 7]
+        assert log2.latest_offset == 7
+        log2.close()
+
+    def test_align_after_noop_when_already_past(self, tmp_path):
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        keys = machine_metrics_series(1)
+        log = SegmentedFileLog(str(tmp_path / "wal"))
+        for sd in gauge_stream(keys, 5, batch=1):
+            log.append(sd.container)
+        log.align_after(2)  # behind the tip: nothing changes
+        assert log.latest_offset == 4
+        assert len(log._segments) == 1
+        log.close()
+
+
+class TestWalFsync:
+    def test_fsync_knob_plumbed(self, tmp_path):
+        import json
+        from filodb_tpu.config import ServerConfig
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        p = tmp_path / "server.json"
+        p.write_text(json.dumps({"wal_fsync": True,
+                                 "data_dir": str(tmp_path / "d")}))
+        cfg = ServerConfig.load(str(p))
+        assert cfg.wal_fsync is True
+        log = SegmentedFileLog(str(tmp_path / "wal"), fsync=cfg.wal_fsync)
+        assert log._segments[0][1].fsync is True
+        keys = machine_metrics_series(1)
+        for sd in gauge_stream(keys, 3, batch=1):
+            log.append(sd.container)
+        assert len(list(log.read_from(0))) == 3
+        log.close()
 
 
 class TestRemoteProtocol:
