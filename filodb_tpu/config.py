@@ -29,6 +29,9 @@ DEFAULTS = {
             "num_shards": 4,
             "min_num_nodes": 1,
             "spread": 1,
+            # "engine": "mesh" lowers supported aggregations onto the
+            # (shard × time) device mesh on single-node deployments
+            "engine": "exec",
             "store": {
                 "flush_interval_ms": 3_600_000,
                 "max_chunk_size": 400,
@@ -58,6 +61,7 @@ class ServerConfig:
     datasets: dict[str, IngestionConfig] = field(default_factory=dict)
     spreads: dict[str, int] = field(default_factory=dict)
     downsample: dict[str, dict] = field(default_factory=dict)
+    engines: dict[str, str] = field(default_factory=dict)  # dataset → engine
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -69,6 +73,7 @@ class ServerConfig:
         datasets = {}
         spreads = {}
         downsample = {}
+        engines = {}
         for name, d in cfg["datasets"].items():
             if d.get("downsample"):
                 downsample[name] = d["downsample"]
@@ -79,6 +84,7 @@ class ServerConfig:
                 min_num_nodes=d.get("min_num_nodes", 1), store=store,
                 downsample=d.get("downsample"))
             spreads[name] = d.get("spread", 1)
+            engines[name] = d.get("engine", "exec")
         return ServerConfig(
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],
             wal_dir=cfg.get("wal_dir"),
@@ -86,7 +92,8 @@ class ServerConfig:
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),
-            datasets=datasets, spreads=spreads, downsample=downsample)
+            datasets=datasets, spreads=spreads, downsample=downsample,
+            engines=engines)
 
 
 def _deep_merge(base: dict, over: dict) -> None:

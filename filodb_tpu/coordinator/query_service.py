@@ -30,12 +30,21 @@ class QueryService:
     time_split_ms: int = 0
     # instant-selector staleness (reference QueryConfig staleSampleAfterMs)
     lookback_ms: int = 300_000
+    # "exec" = scatter-gather exec-plan tree (the reference's distribution);
+    # "mesh" = lower supported agg(range_fn(sel[w])) by (...) plans onto the
+    # (shard × time) device mesh, falling back to exec for everything else
+    engine: str = "exec"
+    mesh: object = None  # jax Mesh override for engine="mesh"
     planner: SingleClusterPlanner = field(init=False)
 
     def __post_init__(self):
         self.planner = SingleClusterPlanner(
             self.dataset, self.num_shards, self.spread,
             time_split_ms=self.time_split_ms)
+        self.mesh_engine = None
+        if self.engine == "mesh":
+            from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
+            self.mesh_engine = MeshQueryEngine(mesh=self.mesh)
 
     # ---- promql entry points --------------------------------------------
 
@@ -59,6 +68,20 @@ class QueryService:
         if isinstance(plan, (lp.LabelValues, lp.LabelNames,
                              lp.SeriesKeysByFilters)):
             return self._metadata(plan, qcontext)
+        if self.mesh_engine is not None and self._mesh_eligible() \
+                and self.mesh_engine.supports(plan):
+            from filodb_tpu.query.model import QueryStats
+            stats = QueryStats()
+            with query_latency.time():
+                data = self.mesh_engine.execute(self.memstore, self.dataset,
+                                                plan, stats)
+            if data is not None:  # None = shape the kernels don't cover
+                # same resource guard as the exec path
+                from filodb_tpu.query.exec.plan import ExecPlan
+                ExecPlan._enforce_limits(data, qcontext)
+                stats.wall_time_s = time.perf_counter() - t0
+                stats.result_series = data.num_series
+                return QueryResult(data, stats, qcontext.query_id)
         exec_plan = self.planner.materialize(plan, qcontext)
         ctx = ExecContext(self.memstore, self.dataset, qcontext)
         with query_latency.time():
@@ -67,6 +90,13 @@ class QueryService:
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
         return result
+
+    def _mesh_eligible(self) -> bool:
+        """The mesh fans ALL series into one device program, so every shard
+        of the dataset must be resident in this process's memstore; a
+        coordinator facade over remote members sees partial data and must
+        use the scatter-gather path."""
+        return len(self.memstore.shards_for(self.dataset)) >= self.num_shards
 
     # ---- metadata -------------------------------------------------------
 

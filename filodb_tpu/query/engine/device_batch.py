@@ -204,8 +204,20 @@ def build_device_batch(partitions, start: int, end: int,
                 entries.append((tsp, vp, int(b.n)))
         per_series.append(entries)
 
-    # bucket shapes to powers of two so the jitted assemble/eval kernels
-    # reuse compilation across queries (mirrors engine/batch.py)
+    packed, counts = pack_series_pages(per_series, start)
+    ts_dev, vals_dev, valid_dev = _assemble(
+        *(jnp.asarray(a) for a in packed),
+        jnp.asarray(np.int32(end - start)))
+    return DeviceSeriesBatch(start, ts_dev, vals_dev, valid_dev, counts,
+                             [p.part_id for p in partitions])
+
+
+def pack_series_pages(per_series, start: int):
+    """Pack per-series (ts_page, val_page, nrows) entries into the dense
+    [P, NB, ...] arrays ``_assemble`` decodes on device. Shapes bucket to
+    powers of two so the jitted assemble/eval kernels reuse compilation
+    across queries (mirrors engine/batch.py). Returns (packed_arrays,
+    counts) with packed_arrays ordered as _assemble's parameters."""
     P = _pow2(len(per_series), 4)
     nb_per = [sum(t.num_blocks for t, _, _ in e) for e in per_series]
     NB = _pow2(max(max(nb_per, default=1), 1))
@@ -236,14 +248,9 @@ def build_device_batch(partitions, start: int, end: int,
             blk_counts[i, bi : bi + nb] = bc + [0] * (nb - len(bc))
             counts[i] += nrows
             bi += nb
-    ts_dev, vals_dev, valid_dev = _assemble(
-        jnp.asarray(rel_bases), jnp.asarray(ts_slopes),
-        jnp.asarray(ts_widths), jnp.asarray(ts_words),
-        jnp.asarray(v_firsts), jnp.asarray(v_shifts),
-        jnp.asarray(v_widths), jnp.asarray(v_words),
-        jnp.asarray(blk_counts), jnp.asarray(np.int32(end - start)))
-    return DeviceSeriesBatch(start, ts_dev, vals_dev, valid_dev, counts,
-                             [p.part_id for p in partitions])
+    packed = (rel_bases, ts_slopes, ts_widths, ts_words, v_firsts, v_shifts,
+              v_widths, v_words, blk_counts)
+    return packed, counts
 
 
 # ---------------------------------------------------------------------------

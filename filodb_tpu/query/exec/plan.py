@@ -78,7 +78,7 @@ class ExecPlan:
             if hasattr(t, "bind"):
                 t.bind(ctx)
             data = t.apply(data)
-        self._enforce_limits(data, ctx)
+        self._enforce_limits(data, ctx.qcontext)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:
@@ -89,8 +89,8 @@ class ExecPlan:
         return self
 
     @staticmethod
-    def _enforce_limits(data: StepMatrix, ctx: ExecContext) -> None:
-        pp = ctx.qcontext.planner_params
+    def _enforce_limits(data: StepMatrix, qcontext) -> None:
+        pp = qcontext.planner_params
         if pp.enforce_sample_limit:
             samples = data.num_series * data.num_steps
             if samples > pp.sample_limit:
@@ -211,7 +211,7 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def execute(self, ctx: ExecContext) -> QueryResult:
         data = self.do_execute(ctx)
-        self._enforce_limits(data, ctx)
+        self._enforce_limits(data, ctx.qcontext)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
 
     def _use_device_path(self, shard, schema, col) -> bool:

@@ -135,7 +135,8 @@ class FiloServer:
                         for s in range(ing_cfg.num_shards)}
                 self.cluster.setup_dataset(ing_cfg, logs)
                 services[name] = self.cluster.query_service(
-                    name, cfg.spreads.get(name, 1))
+                    name, cfg.spreads.get(name, 1),
+                    engine=cfg.engines.get(name, "exec"))
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
             self.cluster.start_failure_detector()
@@ -326,8 +327,9 @@ class FiloServer:
             # the dead coordinator's shards are unassigned: reassign
             for ev in sm.rebalance():
                 self.cluster._on_event(dataset, ev)
-            svc = self.cluster.query_service(dataset,
-                                             cfg.spreads.get(dataset, 1))
+            svc = self.cluster.query_service(
+                dataset, cfg.spreads.get(dataset, 1),
+                engine=cfg.engines.get(dataset, "exec"))
             self.http.services[dataset] = svc
             self.cluster.on_heartbeat.append(
                 lambda n=dataset: poll_remote_statuses(self.cluster, n))

@@ -1,0 +1,182 @@
+"""Mesh query engine parity: PromQL → planner → (shard × time) device mesh.
+
+The mesh path (``parallel/mesh_engine.py``) must return byte-comparable
+results to the scatter-gather exec path for every supported plan shape, on
+the virtual 8-device CPU mesh (conftest sets
+``--xla_force_host_platform_device_count=8``). Reference boundary replaced:
+``query/src/main/scala/filodb/query/exec/ExecPlan.scala:41`` scatter-gather.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    machine_metrics_series,
+)
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+
+
+def build_store(kind="counter", n_series=24, n_samples=240):
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    if kind == "counter":
+        keys = counter_series(n_series, metric="http_requests_total")
+        stream = counter_stream(keys, n_samples, start_ms=START * 1000,
+                                interval_ms=10_000, seed=3)
+    else:
+        keys = machine_metrics_series(n_series, metric="gauge_metric")
+        stream = gauge_stream(keys, n_samples, start_ms=START * 1000,
+                              interval_ms=10_000, seed=3)
+    ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+def services(ms):
+    exec_svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+    mesh_svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                            engine="mesh")
+    return exec_svc, mesh_svc
+
+
+def assert_same(r_exec, r_mesh):
+    e, m = r_exec.result, r_mesh.result
+    assert sorted(map(str, e.keys)) == sorted(map(str, m.keys))
+    np.testing.assert_array_equal(e.steps_ms, m.steps_ms)
+    order_e = np.argsort([str(k) for k in e.keys])
+    order_m = np.argsort([str(k) for k in m.keys])
+    np.testing.assert_allclose(e.values[order_e], m.values[order_m],
+                               rtol=1e-6, atol=1e-9, equal_nan=True)
+
+
+class TestMeshParity:
+    @pytest.fixture(scope="class")
+    def counter_store(self):
+        return build_store("counter")
+
+    @pytest.fixture(scope="class")
+    def gauge_store(self):
+        return build_store("gauge")
+
+    def q(self, svc, query):
+        return svc.query_range(query, START + 600, 60, START + 1800)
+
+    def test_sum_rate_global(self, counter_store):
+        e, m = services(counter_store)
+        query = 'sum(rate(http_requests_total[5m]))'
+        assert_same(self.q(e, query), self.q(m, query))
+
+    def test_sum_rate_by_labels(self, counter_store):
+        e, m = services(counter_store)
+        query = 'sum(rate(http_requests_total[5m])) by (_ns_)'
+        assert_same(self.q(e, query), self.q(m, query))
+
+    def test_sum_rate_with_filters(self, counter_store):
+        e, m = services(counter_store)
+        query = 'sum(rate(http_requests_total{_ns_="App-0"}[2m])) by (instance)'
+        assert_same(self.q(e, query), self.q(m, query))
+
+    @pytest.mark.parametrize("fn", ["sum_over_time", "count_over_time",
+                                    "avg_over_time", "min_over_time",
+                                    "max_over_time", "last_over_time"])
+    @pytest.mark.parametrize("agg", ["sum", "avg", "count", "min", "max"])
+    def test_agg_fn_matrix(self, gauge_store, fn, agg):
+        e, m = services(gauge_store)
+        query = f'{agg}({fn}(gauge_metric[3m])) by (_ns_)'
+        assert_same(self.q(e, query), self.q(m, query))
+
+    def test_by_metric_label_groups_on_nothing(self, counter_store):
+        # exec drops the metric label from range-fn output keys before
+        # grouping; by (_metric_) must therefore collapse to one group
+        e, m = services(counter_store)
+        query = 'sum(rate(http_requests_total[5m])) by (_metric_)'
+        re, rm = self.q(e, query), self.q(m, query)
+        assert_same(re, rm)
+        assert rm.result.num_series == 1
+        assert rm.result.keys[0].labels == ()
+
+    def test_sample_limit_enforced_on_mesh_path(self, counter_store):
+        from filodb_tpu.query.model import (
+            PlannerParams,
+            QueryContext,
+            QueryLimitExceeded,
+        )
+        _, m = services(counter_store)
+        qctx = QueryContext(planner_params=PlannerParams(
+            enforce_sample_limit=True, sample_limit=3))
+        with pytest.raises(QueryLimitExceeded):
+            m.query_range('sum(rate(http_requests_total[5m])) by (instance)',
+                          START + 600, 60, START + 1800, qcontext=qctx)
+
+    def test_instant_query(self, counter_store):
+        e, m = services(counter_store)
+        query = 'sum(rate(http_requests_total[5m])) by (_ns_)'
+        re = e.query_instant(query, START + 1200)
+        rm = m.query_instant(query, START + 1200)
+        assert_same(re, rm)
+
+    def test_empty_selector(self, counter_store):
+        e, m = services(counter_store)
+        query = 'sum(rate(no_such_metric[5m]))'
+        re, rm = self.q(e, query), self.q(m, query)
+        assert re.result.num_series == rm.result.num_series == 0
+
+    def test_mesh_used_not_fallback(self, counter_store):
+        _, m = services(counter_store)
+        plan_hits = []
+        orig = m.mesh_engine.execute
+
+        def spy(*a, **kw):
+            out = orig(*a, **kw)
+            plan_hits.append(out is not None)
+            return out
+
+        m.mesh_engine.execute = spy
+        self.q(m, 'sum(rate(http_requests_total[5m])) by (_ns_)')
+        assert plan_hits == [True]
+
+    def test_unsupported_shapes_fall_back(self, counter_store):
+        _, m = services(counter_store)
+        # offset / unsupported fn / binary join: exec path answers them
+        for query in [
+            'sum(rate(http_requests_total[5m] offset 1m))',
+            'sum(deriv(http_requests_total[5m]))',
+            'topk(2, rate(http_requests_total[5m]))',
+            'rate(http_requests_total[5m])',
+        ]:
+            r = self.q(m, query)
+            assert r is not None  # executes via fallback without raising
+
+    def test_mesh_skipped_when_shards_partial(self):
+        # a coordinator facade in a multi-node cluster holds only its own
+        # shards; the mesh must not serve partial data
+        ms = TimeSeriesMemStore()
+        for s in range(2):  # only 2 of 4 shards local
+            ms.setup("timeseries", s, StoreConfig())
+        svc = QueryService(ms, "timeseries", num_shards=4, spread=1,
+                           engine="mesh")
+        assert not svc._mesh_eligible()
+        called = []
+        svc.mesh_engine.execute = lambda *a, **kw: called.append(1)
+        # the exec fallback needs remote dispatchers for the missing shards
+        # (not wired in this test); the point is the mesh never engages
+        with pytest.raises(KeyError):
+            svc.query_range('sum(rate(x[5m]))', START, 60, START + 600)
+        assert not called
+
+    def test_ring_variant_parity(self, counter_store):
+        from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
+        e, m = services(counter_store)
+        m.mesh_engine = MeshQueryEngine(variant="ring")
+        query = 'sum(rate(http_requests_total[5m])) by (_ns_)'
+        assert_same(self.q(e, query), self.q(m, query))
