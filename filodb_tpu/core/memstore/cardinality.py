@@ -52,6 +52,7 @@ class CardinalityTracker:
         self._default_quotas = default_quotas or (2**62,) * (
             len(shard_key_labels) + 1)
         self._root.card.quota = self._default_quotas[0]
+        self._has_quotas = any(q < 2**62 for q in self._default_quotas)
 
     def _path(self, labels: dict[str, str]) -> list[str]:
         return [labels.get(k, "") for k in self.shard_key_labels]
@@ -74,9 +75,17 @@ class CardinalityTracker:
             cur = nxt
         return nodes
 
+    @property
+    def has_quotas(self) -> bool:
+        """True once any finite quota is configured (the native ingest lane
+        defers to the host path so rejection happens before buffering)."""
+        return getattr(self, "_has_quotas", False)
+
     def set_quota(self, prefix: list[str], quota: int) -> None:
         nodes = self._walk(prefix, create=True)
         nodes[-1].card.quota = quota
+        if quota < 2**62:
+            self._has_quotas = True
 
     def series_created(self, labels: dict[str, str]) -> None:
         """Increment counts; raises QuotaExceededError when a prefix is at

@@ -1,0 +1,280 @@
+"""Native-backed shard ingest: Python wrappers over the C++ shard core.
+
+The reference's ingest hot loop is native-tier code: per-shard single-writer
+appenders over off-heap write buffers with O(1) part-key lookup
+(``core/src/main/scala/filodb.core/memstore/TimeSeriesShard.scala:570``,
+``TimeSeriesPartition.scala:137``, ``PartitionSet.scala``). Here the hot loop
+lives in ``native/filodb_native.cpp`` (``shard_core_ingest``): binary
+RecordContainer bytes are parsed, routed, appended and sealed into encoded
+chunks entirely in C++ — Python sees only whole sealed chunks, partition
+-creation events, and counters.
+
+``NativeBackedPartition`` presents the ``TimeSeriesPartition`` protocol over
+a native partition so the entire query/flush/eviction path works unchanged.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+
+import numpy as np
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.schemas import Schema
+from filodb_tpu.memory import native
+from filodb_tpu.memory.chunk import Chunk
+from filodb_tpu.memory.codecs import CODEC_XOR_DOUBLE
+
+
+def native_available() -> bool:
+    return native.get_lib() is not None
+
+
+def part_key_blob(key: PartKey) -> bytes:
+    """Canonical key bytes — byte-identical to the container v2 record's
+    schema-id + label section (the native map key); one shared codec."""
+    from filodb_tpu.core.record import _schema_ids, encode_labels
+    return struct.pack("<H", _schema_ids(key.schema)) \
+        + encode_labels(key.labels)
+
+
+def part_key_from_blob(blob: bytes, schemas) -> PartKey:
+    from filodb_tpu.core.record import decode_labels
+    (sid,) = struct.unpack_from("<H", blob, 0)
+    labels, _ = decode_labels(blob, 2)
+    return PartKey(schemas.by_id(sid).name, labels)
+
+
+class NativeShardCore:
+    """Handle on one shard's C++ ingest core.
+
+    ``lock`` serializes every C++ call that can touch a partition's vectors:
+    the host query path reads lock-free under the GIL, but ctypes releases
+    the GIL, so a reader copying a buffer while the ingest thread reallocs
+    it would be a use-after-free. This is the native analog of the
+    reference's ChunkMap read/write latch (``ChunkMap.scala:15-44``).
+    """
+
+    def __init__(self, max_chunk_size: int, groups: int):
+        import threading
+        self._lib = native.get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._core = ctypes.c_void_p(
+            self._lib.shard_core_create(max_chunk_size, groups))
+        self.lock = threading.RLock()
+
+    def __del__(self):
+        core, self._core = getattr(self, "_core", None), None
+        if core:
+            self._lib.shard_core_destroy(core)
+
+    # -- ingest --
+
+    def ingest(self, raw: bytes, offset: int) -> int:
+        """Returns rows ingested, or -1 when the container holds value
+        shapes the native lane doesn't cover (caller falls back)."""
+        with self.lock:
+            # bytes are immutable and the C side takes const — zero-copy
+            return int(self._lib.shard_core_ingest(self._core, raw,
+                                                   len(raw), offset))
+
+    def set_watermark(self, group: int, offset: int) -> None:
+        self._lib.shard_core_set_watermark(self._core, group, offset)
+
+    def stat(self, which: int) -> int:
+        return int(self._lib.shard_core_stat(self._core, which))
+
+    def drain_new_parts(self) -> list[int]:
+        with self.lock:
+            n = self.stat(4)
+            if not n:
+                return []
+            out = (ctypes.c_int32 * n)()
+            got = self._lib.shard_core_drain_new(self._core, out, n)
+            return list(out[:got])
+
+    def key_blob(self, pid: int) -> bytes:
+        with self.lock:
+            n = self._lib.shard_core_key_len(self._core, pid)
+            out = (ctypes.c_uint8 * max(n, 1))()
+            self._lib.shard_core_key_copy(self._core, pid, out)
+            return bytes(out[:n])
+
+    def create_part(self, key: PartKey, ncols: int) -> int:
+        blob = part_key_blob(key)
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        with self.lock:
+            return int(self._lib.shard_core_create_part(
+                self._core, buf, len(blob), key.part_hash, ncols))
+
+    def part_hash(self, pid: int) -> int:
+        return int(self._lib.shard_core_part_hash(self._core, pid))
+
+
+class NativeBackedPartition:
+    """``TimeSeriesPartition``-protocol view over a native partition.
+
+    Sealed chunks materialize lazily as ``Chunk`` objects (cached per native
+    version); the active buffer materializes as a ``_Buffers`` snapshot on
+    access. All mutation goes through the core.
+    """
+
+    __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "shard",
+                 "bucket_les", "device_pages", "_core", "_lib",
+                 "_chunks_cache", "_chunks_ver")
+
+    def __init__(self, core: NativeShardCore, part_id: int, part_key: PartKey,
+                 schema: Schema, max_chunk_size: int = 400, shard: int = 0):
+        self._core = core
+        self._lib = core._lib
+        self.part_id = part_id
+        self.part_key = part_key
+        self.schema = schema
+        self.max_chunk_size = max_chunk_size
+        self.shard = shard
+        self.bucket_les = None
+        self.device_pages = False
+        self._chunks_cache: list[Chunk] = []
+        self._chunks_ver = -1
+
+    # -- ingest (rare path: replay of object containers, tests) --
+
+    def ingest(self, ts: int, values: tuple) -> bool:
+        vals = np.asarray(values, np.float64)
+        with self._core.lock:
+            return bool(self._lib.part_append(
+                self._core._core, self.part_id, ts,
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                len(vals)))
+
+    # -- state --
+
+    @property
+    def latest_ts(self) -> int:
+        return int(self._lib.part_latest_ts(self._core._core, self.part_id))
+
+    @property
+    def earliest_ts(self) -> int:
+        return int(self._lib.part_earliest_ts(self._core._core, self.part_id))
+
+    @property
+    def num_samples(self) -> int:
+        return int(self._lib.part_num_samples(self._core._core, self.part_id))
+
+    @property
+    def first_ts(self) -> int:
+        return int(self._lib.part_first_ts(self._core._core, self.part_id))
+
+    def seed_dedup_floor(self, ts: int) -> None:
+        self._lib.part_seed_floor(self._core._core, self.part_id, ts)
+
+    @property
+    def _flushed_id(self) -> int:
+        return int(self._lib.part_flushed_id(self._core._core, self.part_id))
+
+    # -- chunks --
+
+    @property
+    def chunks(self) -> list[Chunk]:
+        core, pid = self._core._core, self.part_id
+        with self._core.lock:
+            ver = int(self._lib.part_version(core, pid))
+            if ver == self._chunks_ver:
+                return self._chunks_cache
+            n = self._lib.part_num_sealed(core, pid)
+            ncols = self._lib.part_ncols(core, pid)
+            out: list[Chunk] = []
+            meta = (ctypes.c_int64 * 4)()
+            for i in range(n):
+                self._lib.part_sealed_meta(core, pid, i, meta)
+                vectors = []
+                for col in range(ncols + 1):
+                    ln = self._lib.part_sealed_veclen(core, pid, i, col)
+                    buf = (ctypes.c_uint8 * ln)()
+                    self._lib.part_sealed_veccopy(core, pid, i, col, buf)
+                    vectors.append(bytes(buf))
+                out.append(Chunk(int(meta[0]), int(meta[3]), int(meta[1]),
+                                 int(meta[2]), tuple(vectors)))
+            self._chunks_cache = out
+            self._chunks_ver = ver
+            return out
+
+    @property
+    def _buf(self):
+        from filodb_tpu.core.memstore.partition import _Buffers
+        core, pid = self._core._core, self.part_id
+        with self._core.lock:
+            n = self._lib.part_buf_count(core, pid)
+            ncols = self._lib.part_ncols(core, pid)
+            ts = np.empty(max(n, 1), np.int64)
+            cols = np.empty((ncols, max(n, 1)), np.float64)
+            if n:
+                n = self._lib.part_buf_copy(
+                    core, pid, n,
+                    ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    cols.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return _Buffers(ts, [cols[i] for i in range(ncols)], n)
+
+    def switch_buffers(self) -> None:
+        with self._core.lock:
+            self._lib.part_seal_buffer(self._core._core, self.part_id)
+
+    def make_flush_chunks(self, flush_buffer: bool = True) -> list[Chunk]:
+        with self._core.lock:
+            if flush_buffer:
+                self._lib.part_seal_buffer(self._core._core, self.part_id)
+            flushed = self._flushed_id
+            return [c for c in self.chunks if c.id > flushed]
+
+    def mark_flushed(self, up_to_id: int) -> None:
+        self._lib.part_mark_flushed(self._core._core, self.part_id, up_to_id)
+
+    def evict_flushed_chunks(self) -> int:
+        with self._core.lock:
+            return int(self._lib.part_evict_flushed(self._core._core,
+                                                    self.part_id))
+
+    @property
+    def chunk_nbytes(self) -> int:
+        """Encoded chunk bytes without materializing Chunk objects."""
+        with self._core.lock:
+            return int(self._lib.part_chunk_bytes(self._core._core,
+                                                  self.part_id))
+
+    @property
+    def unflushed_count(self) -> int:
+        with self._core.lock:
+            flushed = self._flushed_id
+            n = sum(1 for c in self.chunks if c.id > flushed)
+            if self._lib.part_buf_count(self._core._core, self.part_id):
+                n += 1
+            return n
+
+    def free(self) -> None:
+        with self._core.lock:
+            self._lib.part_free(self._core._core, self.part_id)
+
+    # -- reads: borrow the host partition's implementations (they only use
+    #    the protocol surface: chunks / _buf / schema / bucket_les) --
+
+    def chunks_in_range(self, start: int, end: int,
+                        include_buffer: bool = True) -> list[Chunk]:
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        return TimeSeriesPartition.chunks_in_range(self, start, end,
+                                                   include_buffer)
+
+    def _buffer_chunk(self) -> Chunk:
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        return TimeSeriesPartition._buffer_chunk(self)
+
+    def read_samples(self, start: int, end: int, col: int = None,
+                     extra_chunks: list | None = None):
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        return TimeSeriesPartition.read_samples(self, start, end, col,
+                                                extra_chunks)
+
+
+# sanity: the native value codec id must match what decode_any dispatches on
+assert CODEC_XOR_DOUBLE == 3

@@ -100,6 +100,22 @@ class TimeSeriesShard:
         # land between write_chunks and write_part_keys, so replay may be
         # what re-creates the partition) seeds its dedup floor from here
         self._persisted_floors: dict[PartKey, int] = {}
+        # C++ ingest core: binary containers bypass the Python record loop
+        # entirely (reference native-tier ingest, TimeSeriesShard.scala:570)
+        self._native_core = None
+        self._nat_skipped_seen = 0
+        self._nat_ooo_seen = 0
+        if store_config.native_ingest \
+                and not store_config.trace_part_key_substrings \
+                and not store_config.device_pages:
+            from filodb_tpu.core.memstore.native_shard import (
+                NativeShardCore,
+                native_available,
+            )
+            if native_available():
+                self._native_core = NativeShardCore(
+                    store_config.max_chunk_size,
+                    store_config.groups_per_shard)
 
     @property
     def data_version(self) -> int:
@@ -124,16 +140,35 @@ class TimeSeriesShard:
         self.cardinality.series_created(key.label_map)  # may raise quota
         schema = self.schemas[key.schema]
         pid = len(self.partitions)
-        cls = TimeSeriesPartition
-        if self.config.trace_part_key_substrings:
-            from filodb_tpu.core.memstore.partition import (
-                TracingTimeSeriesPartition,
+        native_backed = False
+        if self._native_core is not None:
+            # every partition gets a native slot so pid numbering stays
+            # aligned across both sides; only all-double schemas are
+            # native-backed (records of other schemas can never reach the
+            # native lane — their containers fail the scalar pre-scan)
+            ncols = len(schema.data.columns) - 1
+            nat_pid = self._native_core.create_part(key, ncols)
+            assert nat_pid == pid, (nat_pid, pid)
+            native_backed = self._native_eligible(schema)
+        if native_backed:
+            from filodb_tpu.core.memstore.native_shard import (
+                NativeBackedPartition,
             )
-            kstr = str(key)
-            if any(s in kstr for s in self.config.trace_part_key_substrings):
-                cls = TracingTimeSeriesPartition
-        part = cls(pid, key, schema, self.config.max_chunk_size,
-                   self.shard_num, device_pages=self.config.device_pages)
+            part = NativeBackedPartition(self._native_core, pid, key, schema,
+                                         self.config.max_chunk_size,
+                                         self.shard_num)
+        else:
+            cls = TimeSeriesPartition
+            if self.config.trace_part_key_substrings:
+                from filodb_tpu.core.memstore.partition import (
+                    TracingTimeSeriesPartition,
+                )
+                kstr = str(key)
+                if any(s in kstr
+                       for s in self.config.trace_part_key_substrings):
+                    cls = TracingTimeSeriesPartition
+            part = cls(pid, key, schema, self.config.max_chunk_size,
+                       self.shard_num, device_pages=self.config.device_pages)
         floor = self._persisted_floors.get(key)
         if floor is not None:
             part.seed_dedup_floor(floor)
@@ -171,8 +206,74 @@ class TimeSeriesShard:
         with self.write_lock:
             return self._ingest_locked(data, data.offset)
 
+    def _native_eligible(self, schema) -> bool:
+        from filodb_tpu.core.schemas import ColumnType
+        return all(c.ctype == ColumnType.DOUBLE
+                   for c in schema.data.columns[1:])
+
+    def _drain_native_parts(self) -> None:
+        """Register partitions the C++ core created during ingest: index,
+        cardinality metering, dirty part keys."""
+        from filodb_tpu.core.memstore.native_shard import (
+            NativeBackedPartition,
+            part_key_from_blob,
+        )
+        core = self._native_core
+        for pid in core.drain_new_parts():
+            key = part_key_from_blob(core.key_blob(pid), self.schemas)
+            # seed the hash from the container record: group_of/flush would
+            # otherwise recompute it — re-materializing the serialized blob
+            # the pops below exist to avoid
+            key.__dict__["part_hash"] = core.part_hash(pid)
+            schema = self.schemas[key.schema]
+            part = NativeBackedPartition(core, pid, key, schema,
+                                         self.config.max_chunk_size,
+                                         self.shard_num)
+            assert pid == len(self.partitions), (pid, len(self.partitions))
+            floor = self._persisted_floors.get(key)
+            if floor is not None:
+                part.seed_dedup_floor(floor)
+            self.partitions.append(part)
+            self._by_key[key] = pid
+            self.cardinality.series_created(key.label_map)
+            self.index.add_part_key(pid, key, part.first_ts)
+            self._dirty_part_keys.add(pid)
+            self.stats.partitions_created.inc()
+            # drop per-key caches materialized above: at 1M series the
+            # label_map dict + serialized bytes dominate resident memory
+            key.__dict__.pop("label_map", None)
+            key.__dict__.pop("serialized", None)
+        self.stats.num_partitions.set(len(self._by_key))
+
+    def _ingest_native(self, raw: bytes, offset: int) -> int:
+        """Fast lane: container bytes parsed + appended + sealed in C++.
+        Returns rows ingested, or -1 → caller takes the host loop."""
+        core = self._native_core
+        n = core.ingest(raw, offset)
+        if n < 0:
+            return -1
+        if core.stat(4):
+            self._drain_native_parts()
+        skipped, ooo = core.stat(1), core.stat(2)
+        if skipped != self._nat_skipped_seen:
+            self.stats.rows_skipped.inc(skipped - self._nat_skipped_seen)
+            self._nat_skipped_seen = skipped
+        if ooo != self._nat_ooo_seen:
+            self.stats.out_of_order_dropped.inc(ooo - self._nat_ooo_seen)
+            self._nat_ooo_seen = ooo
+        self._ingested_offset = max(self._ingested_offset, offset)
+        self.stats.rows_ingested.inc(n)
+        return n
+
     def _ingest_locked(self, data: SomeData, offset: int) -> int:
         from filodb_tpu.core.memstore.cardinality import QuotaExceededError
+        if self._native_core is not None \
+                and not self.cardinality.has_quotas:
+            raw = getattr(data.container, "raw", None)
+            if raw is not None:
+                n = self._ingest_native(raw, offset)
+                if n >= 0:
+                    return n
         n = 0
         for rec in data.container:
             group = self.group_of(rec.part_key)
@@ -242,6 +343,9 @@ class TimeSeriesShard:
                                          checkpoint_offset)
         self.group_watermarks[group] = max(self.group_watermarks[group],
                                            checkpoint_offset)
+        if self._native_core is not None:
+            self._native_core.set_watermark(group,
+                                            self.group_watermarks[group])
         self.stats.chunks_flushed.inc(written)
         self.stats.flushes_done.inc()
         return written
@@ -276,6 +380,8 @@ class TimeSeriesShard:
         for g, off in cps.items():
             if g < len(self.group_watermarks):
                 self.group_watermarks[g] = off
+                if self._native_core is not None:
+                    self._native_core.set_watermark(g, off)
         return min(cps.values()) if cps else -1
 
     def recover_index(self) -> int:
@@ -315,6 +421,14 @@ class TimeSeriesShard:
                     self.index.remove_part_key(pid)
                     del self._by_key[part.part_key]
                     self.partitions[pid] = None
+                    if self._native_core is not None:
+                        # EVERY partition has a native slot (pid alignment),
+                        # not just native-backed ones — free it or the C++
+                        # by_key entry survives and the next re-creation of
+                        # this series trips the pid-alignment assert
+                        with self._native_core.lock:
+                            self._native_core._lib.part_free(
+                                self._native_core._core, pid)
                     self.cardinality.series_stopped(part.part_key.label_map)
                     purged += 1
         if purged:
@@ -330,8 +444,14 @@ class TimeSeriesShard:
         return part.evict_flushed_chunks() if part else 0
 
     def chunk_bytes(self) -> int:
-        return sum(sum(c.nbytes for c in p.chunks)
-                   for p in self.partitions if p is not None)
+        total = 0
+        for p in self.partitions:
+            if p is None:
+                continue
+            nb = getattr(p, "chunk_nbytes", None)
+            total += nb if nb is not None \
+                else sum(c.nbytes for c in p.chunks)
+        return total
 
     def enforce_memory(self, budget_bytes: int | None = None) -> int:
         """Evict persisted chunks, oldest-data partitions first, until chunk

@@ -20,6 +20,48 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.schemas import Schemas
 
 
+def encode_labels(labels: tuple[tuple[str, str], ...]) -> bytes:
+    """Label-section wire codec: u16 nlabels | (u16 klen|k|u16 vlen|v)*.
+    Shared by container records and the native part-key blob — the native
+    hash-map keys them byte-identically, so there is exactly one encoder."""
+    out = [struct.pack("<H", len(labels))]
+    for k, v in labels:
+        kb, vb = k.encode(), v.encode()
+        out.append(struct.pack("<H", len(kb)))
+        out.append(kb)
+        out.append(struct.pack("<H", len(vb)))
+        out.append(vb)
+    return b"".join(out)
+
+
+def decode_labels(data: bytes, off: int) -> tuple[tuple, int]:
+    (nlabels,) = struct.unpack_from("<H", data, off)
+    off += 2
+    labels = []
+    for _ in range(nlabels):
+        (kl,) = struct.unpack_from("<H", data, off)
+        off += 2
+        k = data[off : off + kl].decode()
+        off += kl
+        (vl,) = struct.unpack_from("<H", data, off)
+        off += 2
+        labels.append((k, data[off : off + vl].decode()))
+        off += vl
+    return tuple(labels), off
+
+
+_SCHEMA_ID_CACHE: dict[str, int] = {}
+
+
+def _schema_ids(name: str) -> int:
+    sid = _SCHEMA_ID_CACHE.get(name)
+    if sid is None:
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        sid = DEFAULT_SCHEMAS[name].schema_id
+        _SCHEMA_ID_CACHE[name] = sid
+    return sid
+
+
 @dataclass(frozen=True)
 class IngestRecord:
     """One sample for one series. ``values`` follows the schema's non-timestamp
@@ -51,20 +93,99 @@ class RecordContainer:
         return iter(self.records)
 
     def serialize(self) -> bytes:
-        # versioned, length-prefixed pickle: containers are internal transport,
-        # produced and consumed only by our own gateway/shard runtimes.
-        payload = pickle.dumps(
-            [(r.part_key.schema, r.part_key.labels, r.timestamp,
-              tuple(v.tolist() if isinstance(v, np.ndarray) else v for v in r.values))
-             for r in self.records],
-            protocol=pickle.HIGHEST_PROTOCOL,
-        )
-        return struct.pack("<BI", 1, len(payload)) + payload
+        """Versioned schema-tagged binary layout (v2) — the wire/WAL format.
+
+        Mirrors the reference's RecordContainer contract
+        (``RecordContainer.scala:13-27``, ``RecordBuilder.scala:34``): each
+        record embeds the partition hash, timestamp, schema id, the full
+        part key (sorted labels) and the column values. No pickle: the
+        format is language-neutral and parsed directly by the C++ ingest
+        runtime.
+
+        Layout (little-endian)::
+
+            u8 ver=2 | u32 n_records | records...
+            record: u32 rec_len | u32 part_hash | i64 ts | u16 schema_id
+                    | u16 nlabels | (u16 klen|k|u16 vlen|v)*  (sorted)
+                    | u8 nvals | values*
+            value:  u8 0 | f64                      (double column)
+                    u8 1 | u16 nb | f64*nb | i64*nb (histogram les+counts)
+        """
+        out = [struct.pack("<BI", 2, len(self.records))]
+        for r in self.records:
+            body = [struct.pack("<IqH", r.part_key.part_hash, r.timestamp,
+                                _schema_ids(r.part_key.schema)),
+                    encode_labels(r.part_key.labels),
+                    struct.pack("<B", len(r.values))]
+            for v in r.values:
+                if isinstance(v, tuple) or (
+                        isinstance(v, np.ndarray) and v.ndim):
+                    les, counts = v
+                    les = np.ascontiguousarray(les, np.float64)
+                    counts = np.ascontiguousarray(counts, np.int64)
+                    body.append(struct.pack("<BH", 1, len(les)))
+                    body.append(les.tobytes())
+                    body.append(counts.tobytes())
+                else:
+                    body.append(struct.pack("<Bd", 0, float(v)))
+            payload = b"".join(body)
+            out.append(struct.pack("<I", len(payload)))
+            out.append(payload)
+        return b"".join(out)
 
     @staticmethod
     def deserialize(data: bytes, schemas: Schemas | None = None) -> "RecordContainer":
+        ver = data[0]
+        if ver == 1:
+            return RecordContainer._deserialize_v1_pickle(data)
+        assert ver == 2, f"unknown container version {ver}"
+        (n,) = struct.unpack_from("<I", data, 1)
+        off = 5
+        c = RecordContainer()
+        key_memo: dict = {}  # same series repeats within a batch
+        from filodb_tpu.core.schemas import DEFAULT_SCHEMAS
+        reg = schemas or DEFAULT_SCHEMAS
+        for _ in range(n):
+            (rec_len,) = struct.unpack_from("<I", data, off)
+            off += 4
+            end = off + rec_len
+            part_hash, ts, sid = struct.unpack_from("<IqH", data, off)
+            off += 14
+            labels_start = off
+            labels, off = decode_labels(data, off)
+            label_blob = data[labels_start:off]
+            nvals = data[off]
+            off += 1
+            vals = []
+            for _ in range(nvals):
+                tag = data[off]
+                off += 1
+                if tag == 0:
+                    (x,) = struct.unpack_from("<d", data, off)
+                    off += 8
+                    vals.append(x)
+                else:
+                    (nb,) = struct.unpack_from("<H", data, off)
+                    off += 2
+                    les = np.frombuffer(data, np.float64, nb, off).copy()
+                    off += 8 * nb
+                    counts = np.frombuffer(data, np.int64, nb, off).copy()
+                    off += 8 * nb
+                    vals.append((les, counts))
+            assert off == end, "record length mismatch"
+            memo_key = (sid, label_blob)
+            pk = key_memo.get(memo_key)
+            if pk is None:
+                pk = PartKey(reg.by_id(sid).name, tuple(labels))
+                pk.__dict__["part_hash"] = part_hash  # seed the cached hash
+                key_memo[memo_key] = pk
+            c.add(IngestRecord(pk, ts, tuple(vals)))
+        return c
+
+    @staticmethod
+    def _deserialize_v1_pickle(data: bytes) -> "RecordContainer":
+        # legacy WAL segments written before the binary format
         ver, ln = struct.unpack_from("<BI", data, 0)
-        assert ver == 1
         raw = pickle.loads(data[5 : 5 + ln])
         c = RecordContainer()
         for schema, labels, ts, values in raw:
@@ -74,9 +195,44 @@ class RecordContainer:
         return c
 
 
+class BytesContainer:
+    """A container backed by its serialized bytes, parsed lazily.
+
+    WAL replay and network transports hand these to the shard: the native
+    ingest lane consumes ``raw`` directly in C++ (no per-record Python
+    objects); the host fallback iterates, triggering a one-time parse.
+    """
+
+    __slots__ = ("raw", "_parsed")
+
+    def __init__(self, raw: bytes):
+        self.raw = raw
+        self._parsed = None
+
+    @property
+    def records(self) -> list[IngestRecord]:
+        if self._parsed is None:
+            self._parsed = RecordContainer.deserialize(self.raw).records
+        return self._parsed
+
+    def __len__(self) -> int:
+        if self._parsed is not None:
+            return len(self._parsed)
+        if self.raw[0] == 2:
+            (n,) = struct.unpack_from("<I", self.raw, 1)
+            return n
+        return len(self.records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+    def serialize(self) -> bytes:
+        return self.raw
+
+
 @dataclass(frozen=True)
 class SomeData:
     """A container together with its log offset (reference ``SomeData``)."""
 
-    container: RecordContainer
+    container: RecordContainer | BytesContainer
     offset: int

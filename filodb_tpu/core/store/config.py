@@ -30,6 +30,9 @@ class StoreConfig:
     assert_single_writer: bool = False
     # encode device pages at ingest and run the decode-on-device query path
     device_pages: bool = False
+    # route binary containers through the C++ ingest core when possible
+    # (scalar-column schemas; falls back per-container otherwise)
+    native_ingest: bool = True
 
 
 @dataclass(frozen=True)

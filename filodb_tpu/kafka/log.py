@@ -14,7 +14,7 @@ import struct
 import threading
 from collections.abc import Iterator
 
-from filodb_tpu.core.record import RecordContainer, SomeData
+from filodb_tpu.core.record import BytesContainer, RecordContainer, SomeData
 
 
 class ReplayLog:
@@ -160,7 +160,7 @@ class FileLog(ReplayLog):
                 (ln,) = struct.unpack("<I", hdr)
                 data = f.read(ln)
                 if cur >= offset:
-                    yield SomeData(RecordContainer.deserialize(data), cur)
+                    yield SomeData(BytesContainer(data), cur)
                 cur += 1
 
     @property

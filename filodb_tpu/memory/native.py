@@ -87,6 +87,49 @@ def _load():
         lib.arena_stats.argtypes = [ctypes.c_void_p, i64]
         lib.arena_stats.restype = i64
         lib.arena_destroy.argtypes = [ctypes.c_void_p]
+        # shard ingest core
+        vp, i32 = ctypes.c_void_p, ctypes.c_int32
+        lib.shard_core_create.argtypes = [i32, i32]
+        lib.shard_core_create.restype = vp
+        lib.shard_core_destroy.argtypes = [vp]
+        lib.shard_core_set_watermark.argtypes = [vp, i32, i64]
+        lib.shard_core_ingest.argtypes = [vp, ctypes.c_char_p, i64, i64]
+        lib.shard_core_ingest.restype = i64
+        lib.shard_core_stat.argtypes = [vp, i32]
+        lib.shard_core_stat.restype = i64
+        lib.shard_core_drain_new.argtypes = [vp, ctypes.POINTER(i32), i32]
+        lib.shard_core_drain_new.restype = i32
+        lib.shard_core_create_part.argtypes = [vp, u8p, i32,
+                                               ctypes.c_uint32, i32]
+        lib.shard_core_create_part.restype = i32
+        lib.shard_core_key_len.argtypes = [vp, i32]
+        lib.shard_core_key_len.restype = i32
+        lib.shard_core_key_copy.argtypes = [vp, i32, u8p]
+        lib.shard_core_part_hash.argtypes = [vp, i32]
+        lib.shard_core_part_hash.restype = ctypes.c_uint32
+        lib.part_append.argtypes = [vp, i32, i64, f64p, i32]
+        lib.part_append.restype = i64
+        for fn in ("part_latest_ts", "part_first_ts", "part_earliest_ts",
+                   "part_num_samples", "part_version", "part_flushed_id",
+                   "part_chunk_bytes"):
+            getattr(lib, fn).argtypes = [vp, i32]
+            getattr(lib, fn).restype = i64
+        for fn in ("part_buf_count", "part_ncols", "part_num_sealed"):
+            getattr(lib, fn).argtypes = [vp, i32]
+            getattr(lib, fn).restype = i32
+        lib.part_buf_copy.argtypes = [vp, i32, i32, i64p, f64p]
+        lib.part_buf_copy.restype = i32
+        lib.part_seal_buffer.argtypes = [vp, i32]
+        lib.part_seal_buffer.restype = i32
+        lib.part_sealed_meta.argtypes = [vp, i32, i32, i64p]
+        lib.part_sealed_veclen.argtypes = [vp, i32, i32, i32]
+        lib.part_sealed_veclen.restype = i64
+        lib.part_sealed_veccopy.argtypes = [vp, i32, i32, i32, u8p]
+        lib.part_mark_flushed.argtypes = [vp, i32, i64]
+        lib.part_evict_flushed.argtypes = [vp, i32]
+        lib.part_evict_flushed.restype = i32
+        lib.part_seed_floor.argtypes = [vp, i32, i64]
+        lib.part_free.argtypes = [vp, i32]
         _lib = lib
         HAVE_NATIVE = True
         return lib

@@ -13,8 +13,12 @@
 #include <cstring>
 #include <cstdlib>
 #include <atomic>
+#include <deque>
 #include <initializer_list>
 #include <new>
+#include <string>
+#include <unordered_map>
+#include <vector>
 
 extern "C" {
 
@@ -334,6 +338,451 @@ void arena_destroy(void* arena) {
         }
     }
     delete a;
+}
+
+// ---------------------------------------------------------------------------
+// Shard ingest core — the native hot loop.
+//
+// Counterpart of the reference's per-shard ingest path
+// (core/src/main/scala/filodb.core/memstore/TimeSeriesShard.scala:570 →
+// TimeSeriesPartition.scala:137 appenders over off-heap buffers): parses
+// binary RecordContainer v2 bytes directly (no per-record host-language
+// objects), looks partitions up in a native hash map keyed by the canonical
+// part-key bytes, appends to growable columnar buffers, and seals full
+// buffers into encoded chunks (delta-delta timestamps + XOR-double values,
+// byte-identical to the numpy codecs) — the Python layer only sees whole
+// sealed chunks and partition-creation events.
+
+namespace {
+
+struct NSealed {
+    int64_t id, start, end;
+    int32_t nrows;
+    std::string ts_bytes;
+    std::vector<std::string> col_bytes;
+};
+
+struct NPart {
+    std::string key;  // schema_id + label blob (canonical container bytes)
+    uint32_t hash = 0;
+    bool alive = true;
+    int64_t floor_ts = -1;   // dedup floor (recovery / eviction)
+    int64_t first_ts = -1;
+    int32_t seq = 0;
+    int64_t flushed_id = -1;
+    int64_t version = 0;     // bumped on seal/evict (python cache key)
+    int64_t samples_sealed = 0;
+    std::vector<int64_t> ts;
+    std::vector<std::vector<double>> cols;
+    std::vector<NSealed> sealed;
+
+    int64_t latest() const {
+        int64_t t = floor_ts;
+        if (!ts.empty()) {
+            if (ts.back() > t) t = ts.back();
+        } else if (!sealed.empty()) {
+            if (sealed.back().end > t) t = sealed.back().end;
+        }
+        return t;
+    }
+};
+
+struct ShardCore {
+    int32_t max_chunk;
+    int32_t groups;
+    std::vector<int64_t> watermarks;
+    std::unordered_map<std::string, int32_t> by_key;
+    std::deque<NPart> parts;  // stable references; index == pid
+    std::vector<int32_t> new_parts;
+    int64_t rows_skipped = 0, rows_ooo = 0, rows_ingested = 0;
+    // encode scratch (single-writer per shard)
+    std::vector<int64_t> resid;
+    std::vector<uint64_t> words;
+    std::vector<uint8_t> packed;
+    std::string scratch_key;
+};
+
+inline uint16_t rd_u16(const uint8_t* p) {
+    uint16_t v; std::memcpy(&v, p, 2); return v;
+}
+inline uint32_t rd_u32(const uint8_t* p) {
+    uint32_t v; std::memcpy(&v, p, 4); return v;
+}
+inline int64_t rd_i64(const uint8_t* p) {
+    int64_t v; std::memcpy(&v, p, 8); return v;
+}
+
+inline int64_t floordiv_i64(int64_t a, int64_t b) {
+    int64_t q = a / b, r = a % b;
+    return (r != 0 && ((r < 0) != (b < 0))) ? q - 1 : q;
+}
+
+// delta-delta codec, byte-identical to codecs.encode_delta_delta:
+// u8 codec | u32 n | i64 base | i64 slope [| nibble_pack(zigzag(resid))]
+void encode_dd(ShardCore* c, const int64_t* v, int64_t n, std::string& out) {
+    int64_t base = n ? v[0] : 0;
+    int64_t slope = n > 1 ? floordiv_i64(v[n - 1] - base, n - 1) : 0;
+    c->resid.resize(n);
+    int all_zero = delta_delta_residuals(v, n, base, slope, c->resid.data());
+    uint8_t head[21];
+    head[0] = (n && !all_zero) ? 1 : 2;  // CODEC_DELTA_DELTA(_CONST)
+    uint32_t n32 = (uint32_t)n;
+    std::memcpy(head + 1, &n32, 4);
+    std::memcpy(head + 5, &base, 8);
+    std::memcpy(head + 13, &slope, 8);
+    out.assign((char*)head, 21);
+    if (n && !all_zero) {
+        c->words.resize(n);
+        zigzag_encode_i64(c->resid.data(), c->words.data(), n);
+        c->packed.resize(16 + n * 9 + 64);
+        int64_t m = nibble_pack(c->words.data(), n, c->packed.data());
+        out.append((char*)c->packed.data(), m);
+    }
+}
+
+// XOR-double codec, byte-identical to codecs.encode_xor_double:
+// u8 codec=3 | u32 n | nibble_pack(xor-prep)
+void encode_xor(ShardCore* c, const double* v, int64_t n, std::string& out) {
+    uint8_t head[5];
+    head[0] = 3;
+    uint32_t n32 = (uint32_t)n;
+    std::memcpy(head + 1, &n32, 4);
+    out.assign((char*)head, 5);
+    c->words.resize(n);
+    xor_encode_f64(v, c->words.data(), n);
+    c->packed.resize(16 + n * 9 + 64);
+    int64_t m = nibble_pack(c->words.data(), n, c->packed.data());
+    out.append((char*)c->packed.data(), m);
+}
+
+void seal_part(ShardCore* c, NPart& p) {
+    int64_t n = (int64_t)p.ts.size();
+    if (!n) return;
+    NSealed s;
+    s.nrows = (int32_t)n;
+    s.start = p.ts[0];
+    s.end = p.ts[n - 1];
+    s.id = (s.start << 12) | (int64_t)(p.seq & 0xFFF);
+    p.seq = (p.seq + 1) & 0xFFF;
+    encode_dd(c, p.ts.data(), n, s.ts_bytes);
+    s.col_bytes.resize(p.cols.size());
+    for (size_t i = 0; i < p.cols.size(); i++)
+        encode_xor(c, p.cols[i].data(), n, s.col_bytes[i]);
+    p.samples_sealed += n;
+    p.sealed.push_back(std::move(s));
+    p.ts.clear();
+    for (auto& col : p.cols) col.clear();
+    p.version++;
+}
+
+}  // namespace
+
+void* shard_core_create(int32_t max_chunk_size, int32_t groups) {
+    ShardCore* c = new ShardCore();
+    c->max_chunk = max_chunk_size;
+    c->groups = groups > 0 ? groups : 1;
+    c->watermarks.assign(c->groups, -1);
+    return c;
+}
+
+void shard_core_destroy(void* cp) { delete static_cast<ShardCore*>(cp); }
+
+void shard_core_set_watermark(void* cp, int32_t group, int64_t off) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    if (group >= 0 && group < c->groups) c->watermarks[group] = off;
+}
+
+// Parse + ingest one binary RecordContainer (format: core/record.py v2).
+// Returns rows ingested, or -1 if any record has a non-scalar value shape
+// (histograms/strings): the container is then NOT ingested at all and the
+// caller takes the host fallback path. All-or-nothing via a validate pass.
+int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
+                          int64_t offset) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    if (len < 5 || d[0] != 2) return -1;
+    uint32_t nrec = rd_u32(d + 1);
+    // pass 1: validate shapes and bounds
+    int64_t off = 5;
+    for (uint32_t i = 0; i < nrec; i++) {
+        if (off + 4 > len) return -1;
+        uint32_t rl = rd_u32(d + off);
+        off += 4;
+        int64_t end = off + rl;
+        if (end > len || rl < 17) return -1;
+        int64_t o = off + 14;
+        uint16_t nl = rd_u16(d + o);
+        o += 2;
+        for (uint16_t j = 0; j < nl; j++) {
+            if (o + 2 > end) return -1;
+            o += 2 + rd_u16(d + o);
+            if (o + 2 > end) return -1;
+            o += 2 + rd_u16(d + o);
+        }
+        if (o + 1 > end) return -1;
+        uint8_t nv = d[o];
+        o += 1;
+        if (nv == 0) return -1;
+        for (uint8_t j = 0; j < nv; j++) {
+            if (o + 9 > end || d[o] != 0) return -1;  // scalar f64 only
+            o += 9;
+        }
+        if (o != end) return -1;
+        off = end;
+    }
+    // pass 2: ingest
+    int64_t ingested = 0;
+    off = 5;
+    for (uint32_t i = 0; i < nrec; i++) {
+        uint32_t rl = rd_u32(d + off);
+        off += 4;
+        int64_t end = off + rl;
+        uint32_t hash = rd_u32(d + off);
+        int64_t ts = rd_i64(d + off + 4);
+        int64_t key_off = off + 12;  // schema id + labels = canonical key
+        int64_t o = key_off + 2;
+        uint16_t nl = rd_u16(d + o);
+        o += 2;
+        for (uint16_t j = 0; j < nl; j++) {
+            o += 2 + rd_u16(d + o);
+            o += 2 + rd_u16(d + o);
+        }
+        int64_t key_len = o - key_off;
+        uint8_t nv = d[o];
+        o += 1;
+        int32_t group = (int32_t)(hash % (uint32_t)c->groups);
+        if (offset <= c->watermarks[group]) {
+            c->rows_skipped++;
+            off = end;
+            continue;
+        }
+        c->scratch_key.assign((const char*)d + key_off, key_len);
+        auto it = c->by_key.find(c->scratch_key);
+        NPart* p;
+        if (it == c->by_key.end()) {
+            int32_t pid = (int32_t)c->parts.size();
+            c->parts.emplace_back();
+            p = &c->parts.back();
+            p->key = c->scratch_key;
+            p->hash = hash;
+            p->cols.resize(nv);
+            p->ts.reserve(8);
+            for (auto& col : p->cols) col.reserve(8);
+            c->by_key.emplace(p->key, pid);
+            c->new_parts.push_back(pid);
+        } else {
+            p = &c->parts[it->second];
+        }
+        if (ts <= p->latest()) {
+            c->rows_ooo++;
+            off = end;
+            continue;
+        }
+        if (p->first_ts < 0) p->first_ts = ts;
+        p->ts.push_back(ts);
+        for (uint8_t j = 0; j < nv && j < (uint8_t)p->cols.size(); j++) {
+            double x;
+            std::memcpy(&x, d + o + 1 + j * 9, 8);
+            p->cols[j].push_back(x);
+        }
+        if ((int32_t)p->ts.size() >= c->max_chunk) seal_part(c, *p);
+        ingested++;
+        off = end;
+    }
+    c->rows_ingested += ingested;
+    return ingested;
+}
+
+int64_t shard_core_stat(void* cp, int32_t which) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    switch (which) {
+        case 0: return c->rows_ingested;
+        case 1: return c->rows_skipped;
+        case 2: return c->rows_ooo;
+        case 3: return (int64_t)c->parts.size();
+        case 4: return (int64_t)c->new_parts.size();
+        default: return -1;
+    }
+}
+
+int32_t shard_core_drain_new(void* cp, int32_t* out, int32_t cap) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    int32_t n = (int32_t)c->new_parts.size();
+    if (n > cap) n = cap;
+    for (int32_t i = 0; i < n; i++) out[i] = c->new_parts[i];
+    c->new_parts.erase(c->new_parts.begin(), c->new_parts.begin() + n);
+    return n;
+}
+
+int32_t shard_core_create_part(void* cp, const uint8_t* key, int32_t key_len,
+                               uint32_t hash, int32_t ncols) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    std::string k((const char*)key, key_len);
+    auto it = c->by_key.find(k);
+    if (it != c->by_key.end()) return it->second;
+    int32_t pid = (int32_t)c->parts.size();
+    c->parts.emplace_back();
+    NPart& p = c->parts.back();
+    p.key = std::move(k);
+    p.hash = hash;
+    p.cols.resize(ncols > 0 ? ncols : 1);
+    c->by_key.emplace(p.key, pid);
+    return pid;
+}
+
+int32_t shard_core_key_len(void* cp, int32_t pid) {
+    return (int32_t)static_cast<ShardCore*>(cp)->parts[pid].key.size();
+}
+void shard_core_key_copy(void* cp, int32_t pid, uint8_t* out) {
+    const std::string& k = static_cast<ShardCore*>(cp)->parts[pid].key;
+    std::memcpy(out, k.data(), k.size());
+}
+uint32_t shard_core_part_hash(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].hash;
+}
+
+int64_t part_append(void* cp, int32_t pid, int64_t ts, const double* vals,
+                    int32_t nvals) {
+    // host-fallback single append: the CALLER counts drops (returning 0
+    // here already feeds stats.out_of_order_dropped; bumping rows_ooo too
+    // would double-count when the delta sync runs)
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    NPart& p = c->parts[pid];
+    if (ts <= p.latest()) return 0;
+    if (p.first_ts < 0) p.first_ts = ts;
+    p.ts.push_back(ts);
+    for (int32_t j = 0; j < nvals && j < (int32_t)p.cols.size(); j++)
+        p.cols[j].push_back(vals[j]);
+    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, p);
+    c->rows_ingested++;
+    return 1;
+}
+
+int64_t part_latest_ts(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].latest();
+}
+int64_t part_first_ts(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].first_ts;
+}
+int64_t part_earliest_ts(void* cp, int32_t pid) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    if (!p.sealed.empty()) return p.sealed.front().start;
+    if (!p.ts.empty()) return p.ts.front();
+    return -1;
+}
+int64_t part_num_samples(void* cp, int32_t pid) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    return p.samples_sealed + (int64_t)p.ts.size();
+}
+int64_t part_version(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].version;
+}
+int32_t part_buf_count(void* cp, int32_t pid) {
+    return (int32_t)static_cast<ShardCore*>(cp)->parts[pid].ts.size();
+}
+int32_t part_ncols(void* cp, int32_t pid) {
+    return (int32_t)static_cast<ShardCore*>(cp)->parts[pid].cols.size();
+}
+// copies up to n rows (snapshot prefix); cols_out laid out column-major
+// [ncols][n]
+int32_t part_buf_copy(void* cp, int32_t pid, int32_t n, int64_t* ts_out,
+                      double* cols_out) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    int32_t have = (int32_t)p.ts.size();
+    if (n > have) n = have;
+    std::memcpy(ts_out, p.ts.data(), n * 8);
+    for (size_t ci = 0; ci < p.cols.size(); ci++)
+        std::memcpy(cols_out + ci * n, p.cols[ci].data(), n * 8);
+    return n;
+}
+
+int32_t part_seal_buffer(void* cp, int32_t pid) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    NPart& p = c->parts[pid];
+    if (p.ts.empty()) return 0;
+    seal_part(c, p);
+    return 1;
+}
+
+int32_t part_num_sealed(void* cp, int32_t pid) {
+    return (int32_t)static_cast<ShardCore*>(cp)->parts[pid].sealed.size();
+}
+void part_sealed_meta(void* cp, int32_t pid, int32_t idx, int64_t* out4) {
+    NSealed& s = static_cast<ShardCore*>(cp)->parts[pid].sealed[idx];
+    out4[0] = s.id;
+    out4[1] = s.start;
+    out4[2] = s.end;
+    out4[3] = s.nrows;
+}
+int64_t part_sealed_veclen(void* cp, int32_t pid, int32_t idx, int32_t col) {
+    NSealed& s = static_cast<ShardCore*>(cp)->parts[pid].sealed[idx];
+    if (col == 0) return (int64_t)s.ts_bytes.size();
+    return (int64_t)s.col_bytes[col - 1].size();
+}
+void part_sealed_veccopy(void* cp, int32_t pid, int32_t idx, int32_t col,
+                         uint8_t* out) {
+    NSealed& s = static_cast<ShardCore*>(cp)->parts[pid].sealed[idx];
+    const std::string& b = col == 0 ? s.ts_bytes : s.col_bytes[col - 1];
+    std::memcpy(out, b.data(), b.size());
+}
+
+void part_mark_flushed(void* cp, int32_t pid, int64_t up_to_id) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    if (up_to_id > p.flushed_id) p.flushed_id = up_to_id;
+}
+int64_t part_flushed_id(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].flushed_id;
+}
+
+int32_t part_evict_flushed(void* cp, int32_t pid) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    int32_t dropped = 0;
+    int64_t floor = p.floor_ts;
+    std::vector<NSealed> keep;
+    for (auto& s : p.sealed) {
+        if (s.id <= p.flushed_id) {
+            if (s.end > floor) floor = s.end;
+            dropped++;
+        } else {
+            keep.push_back(std::move(s));
+        }
+    }
+    if (dropped) {
+        p.sealed = std::move(keep);
+        p.floor_ts = floor;
+        p.version++;
+    }
+    return dropped;
+}
+
+void part_seed_floor(void* cp, int32_t pid, int64_t ts) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    if (ts > p.floor_ts) p.floor_ts = ts;
+}
+
+int64_t part_chunk_bytes(void* cp, int32_t pid) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    int64_t n = 0;
+    for (auto& s : p.sealed) {
+        n += (int64_t)s.ts_bytes.size();
+        for (auto& cb : s.col_bytes) n += (int64_t)cb.size();
+    }
+    return n;
+}
+
+void part_free(void* cp, int32_t pid) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    NPart& p = c->parts[pid];
+    if (!p.alive) return;
+    c->by_key.erase(p.key);
+    p.alive = false;
+    p.key.clear();
+    p.key.shrink_to_fit();
+    p.ts.clear();
+    p.ts.shrink_to_fit();
+    p.cols.clear();
+    p.cols.shrink_to_fit();
+    p.sealed.clear();
+    p.sealed.shrink_to_fit();
 }
 
 }  // extern "C"
