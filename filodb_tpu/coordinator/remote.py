@@ -4,8 +4,11 @@ Counterpart of the reference's Akka-remoting + Kryo plan shipping
 (``PlanDispatcher.scala:31`` ``ActorPlanDispatcher``, ``client/Serializer.
 scala:23-64``): ExecPlan subtrees are serialized and executed on the node
 owning the target shard; results (StepMatrix batches) return on the same
-connection. Serialization is pickle — an internal, trusted-cluster transport
-exactly like the reference's Kryo (never exposed on the public API port).
+connection. Serialization is the typed wire codec (``coordinator/wire.py``)
+— a closed class registry, so a hostile peer cannot execute code — with a
+hard frame-size cap and an optional shared-secret handshake
+(``FILODB_CLUSTER_SECRET``): connections must authenticate before any other
+message when the server has a secret configured.
 
 Control messages (ping/shard-status) share the channel — the cluster's
 failure detector rides the same transport.
@@ -13,28 +16,41 @@ failure detector rides the same transport.
 
 from __future__ import annotations
 
+import hmac
 import logging
-import pickle
+import os
 import socket
 import socketserver
 import struct
 import threading
 
+from filodb_tpu.coordinator.wire import MAX_FRAME, decode, encode
 from filodb_tpu.query.exec.plan import ExecContext, PlanDispatcher
 from filodb_tpu.query.model import QueryContext
 
 log = logging.getLogger(__name__)
 
 
+def cluster_secret() -> str | None:
+    return os.environ.get("FILODB_CLUSTER_SECRET") or None
+
+
 def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = encode(obj)
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame {len(payload)} exceeds cap {MAX_FRAME}")
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
 
-def _recv_msg(sock: socket.socket):
+AUTH_FRAME_CAP = 4096  # pre-auth frames must be tiny (auth messages are)
+
+
+def _recv_msg(sock: socket.socket, cap: int = MAX_FRAME):
     hdr = _recv_exact(sock, 4)
     (ln,) = struct.unpack("<I", hdr)
-    return pickle.loads(_recv_exact(sock, ln))
+    if ln > cap:
+        raise ConnectionError(f"frame {ln} exceeds cap {cap}")
+    return decode(_recv_exact(sock, ln))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -52,18 +68,35 @@ class PlanExecutorServer:
     (the receive side of ``ActorPlanDispatcher``)."""
 
     def __init__(self, memstore, host: str = "127.0.0.1", port: int = 0,
-                 extra_handlers: dict | None = None):
+                 extra_handlers: dict | None = None,
+                 secret: str | None = None):
         self.memstore = memstore
         # control-plane extensions: {kind: fn(*payload) -> response tuple}
         # (join/start_shard/shard_status... registered by the server runtime)
         self.extra_handlers = extra_handlers or {}
+        self.secret = secret if secret is not None else cluster_secret()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                authed = outer.secret is None
                 try:
                     while True:
-                        msg = _recv_msg(self.request)
+                        # unauthenticated peers get a tiny frame budget: no
+                        # 256MB parse work before the secret check
+                        msg = _recv_msg(self.request,
+                                        MAX_FRAME if authed
+                                        else AUTH_FRAME_CAP)
+                        if not authed:
+                            if msg[0] == "auth" and len(msg) == 2 \
+                                    and isinstance(msg[1], str) \
+                                    and hmac.compare_digest(msg[1],
+                                                            outer.secret):
+                                authed = True
+                                _send_msg(self.request, ("ok", True))
+                                continue
+                            _send_msg(self.request, ("err", "auth required"))
+                            return  # drop the unauthenticated connection
                         _send_msg(self.request, outer._handle(msg))
                 except (ConnectionError, EOFError):
                     pass
@@ -74,8 +107,11 @@ class PlanExecutorServer:
                     except Exception:
                         pass
 
-        self.server = socketserver.ThreadingTCPServer((host, port), Handler,
-                                                      bind_and_activate=True)
+        class Server(socketserver.ThreadingTCPServer):
+            # fixed executor ports must rebind across fast restarts
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler, bind_and_activate=True)
         self.server.daemon_threads = True
         self.port = self.server.server_address[1]
         self.address = (host, self.port)
@@ -92,7 +128,7 @@ class PlanExecutorServer:
                 ctx = ExecContext(self.memstore, dataset,
                                   qcontext or QueryContext())
                 result = plan.execute(ctx)
-                result.result.materialize()  # pickle host arrays, not device
+                result.result.materialize()  # wire-encode host, not device
                 return ("ok", result)
             except Exception as e:
                 log.exception("plan execution failed")
@@ -122,6 +158,8 @@ class RemotePlanDispatcher(PlanDispatcher):
 
     _local = threading.local()
 
+    __wire_fields__ = ("host", "port", "timeout")
+
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host = host
         self.port = port
@@ -137,6 +175,13 @@ class RemotePlanDispatcher(PlanDispatcher):
             sock = socket.create_connection((self.host, self.port),
                                             timeout=self.timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            secret = cluster_secret()
+            if secret is not None:
+                _send_msg(sock, ("auth", secret))
+                resp = _recv_msg(sock)
+                if resp[0] != "ok":
+                    sock.close()
+                    raise ConnectionError("cluster auth rejected")
             pool[key] = sock
         # pooled sockets are shared across dispatcher instances; apply this
         # dispatcher's timeout (a prior short-timeout ping must not poison a
@@ -189,6 +234,3 @@ class RemotePlanDispatcher(PlanDispatcher):
             return None
         raise RuntimeError(f"control call {kind} failed: {resp[1]}")
 
-    def __reduce__(self):
-        # dispatchers travel inside shipped plans; reconnect lazily
-        return (RemotePlanDispatcher, (self.host, self.port, self.timeout))

@@ -1,0 +1,268 @@
+"""Typed wire codec for plan shipping — the Kryo replacement, without pickle.
+
+Counterpart of the reference's Kryo serializer registration
+(``coordinator/src/main/scala/filodb.coordinator/client/Serializer.scala:
+23-64``, ``FiloKryoSerializers.scala``): a closed registry of serializable
+classes (exec plans, transformers, filters, query model, results) encoded as
+a tagged binary tree. Decoding instantiates ONLY registered classes — unlike
+pickle, a hostile peer cannot execute code, and frames are length-capped.
+
+Format (little-endian): one tagged value.
+    N/T/F  none/true/false            I i64     F f64
+    S/B    u32 len + utf8/bytes       L/U u32 count + values (list/tuple)
+    D      u32 count + (key, value)*
+    A      dtype str | u8 ndim | i64 shape* | raw bytes
+    O      class-name str | u16 nfields | (name str, value)*
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+
+import numpy as np
+
+MAX_FRAME = 256 * 1024 * 1024  # hard cap on any frame (DoS guard)
+
+
+def _build_registry() -> dict[str, type]:
+    """All classes allowed on the wire. Subclass walks keep the registry in
+    step with new exec nodes/transformers/filters automatically."""
+    from filodb_tpu.core.filters import ColumnFilter, Filter
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.memory.chunk import Chunk
+    from filodb_tpu.memory.codecs import HistogramColumn
+    from filodb_tpu.query import exec as _exec  # noqa: F401
+    from filodb_tpu.query.exec import binaryjoin  # noqa: F401
+    from filodb_tpu.query.exec import remote_exec  # noqa: F401
+    from filodb_tpu.query.exec import transformers as _tr
+    from filodb_tpu.query.exec.plan import ExecPlan, PlanDispatcher
+    from filodb_tpu.query.model import (
+        PlannerParams,
+        QueryContext,
+        QueryResult,
+        QueryStats,
+        RangeVectorKey,
+        ScalarResult,
+        StepMatrix,
+    )
+
+    reg: dict[str, type] = {}
+
+    def walk(base):
+        for cls in base.__subclasses__():
+            reg[cls.__name__] = cls
+            walk(cls)
+
+    for base in (ExecPlan, PlanDispatcher, Filter,
+                 _tr.RangeVectorTransformer):
+        reg[base.__name__] = base
+        walk(base)
+    for cls in (ColumnFilter, PartKey, Chunk, HistogramColumn, PlannerParams,
+                QueryContext, QueryResult, QueryStats, RangeVectorKey,
+                ScalarResult, StepMatrix):
+        reg[cls.__name__] = cls
+    return reg
+
+
+_REGISTRY: dict[str, type] | None = None
+
+
+def registry() -> dict[str, type]:
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = _build_registry()
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# encode
+
+def encode(obj) -> bytes:
+    out = bytearray()
+    _enc(obj, out)
+    return bytes(out)
+
+
+def _enc_str(s: str, out: bytearray) -> None:
+    b = s.encode()
+    out += struct.pack("<I", len(b))
+    out += b
+
+
+def _enc(obj, out: bytearray) -> None:  # noqa: C901
+    if obj is None:
+        out += b"N"
+    elif obj is True:
+        out += b"T"
+    elif obj is False:
+        out += b"F"
+    elif isinstance(obj, int):
+        out += b"I"
+        out += struct.pack("<q", obj)
+    elif isinstance(obj, float):
+        out += b"f"
+        out += struct.pack("<d", obj)
+    elif isinstance(obj, str):
+        out += b"S"
+        _enc_str(obj, out)
+    elif isinstance(obj, bytes):
+        out += b"B"
+        out += struct.pack("<I", len(obj))
+        out += obj
+    elif isinstance(obj, np.ndarray):
+        a = np.ascontiguousarray(obj)
+        out += b"A"
+        _enc_str(a.dtype.str, out)
+        out += struct.pack("<B", a.ndim)
+        out += struct.pack(f"<{a.ndim}q", *a.shape)
+        out += a.tobytes()
+    elif isinstance(obj, (np.integer,)):
+        out += b"I"
+        out += struct.pack("<q", int(obj))
+    elif isinstance(obj, (np.floating,)):
+        out += b"f"
+        out += struct.pack("<d", float(obj))
+    elif isinstance(obj, list):
+        out += b"L"
+        out += struct.pack("<I", len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, tuple):
+        out += b"U"
+        out += struct.pack("<I", len(obj))
+        for x in obj:
+            _enc(x, out)
+    elif isinstance(obj, (set, frozenset)):
+        out += b"Z"
+        out += struct.pack("<I", len(obj))
+        for x in sorted(obj, key=repr):
+            _enc(x, out)
+    elif isinstance(obj, dict):
+        out += b"D"
+        out += struct.pack("<I", len(obj))
+        for k, v in obj.items():
+            _enc(k, out)
+            _enc(v, out)
+    else:
+        cls = type(obj)
+        name = cls.__name__
+        if registry().get(name) is not cls:
+            raise TypeError(f"{name} is not wire-serializable (register it)")
+        fields = _wire_fields(cls, obj)
+        out += b"O"
+        _enc_str(name, out)
+        out += struct.pack("<H", len(fields))
+        for fname, val in fields:
+            _enc_str(fname, out)
+            _enc(val, out)
+
+
+def _wire_fields(cls, obj) -> list[tuple[str, object]]:
+    if dataclasses.is_dataclass(cls):
+        return [(f.name, getattr(obj, f.name)) for f in
+                dataclasses.fields(cls) if f.init]
+    # non-dataclass registered classes expose __wire_fields__
+    names = getattr(cls, "__wire_fields__", None)
+    if names is None:
+        raise TypeError(f"{cls.__name__} has no wire fields")
+    return [(n, getattr(obj, n)) for n in names]
+
+
+# ---------------------------------------------------------------------------
+# decode
+
+def decode(data: bytes):
+    obj, off = _dec(data, 0)
+    if off != len(data):
+        raise ValueError(f"trailing bytes after wire value: {len(data) - off}")
+    return obj
+
+
+def _need(data: bytes, off: int, n: int) -> None:
+    if off + n > len(data):
+        raise ValueError(f"wire frame truncated: need {n} at {off}, "
+                         f"have {len(data) - off}")
+
+
+def _dec_str(data: bytes, off: int) -> tuple[str, int]:
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    _need(data, off, n)
+    return data[off : off + n].decode(), off + n
+
+
+def _dec(data: bytes, off: int):  # noqa: C901
+    tag = data[off : off + 1]
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"T":
+        return True, off
+    if tag == b"F":
+        return False, off
+    if tag == b"I":
+        (v,) = struct.unpack_from("<q", data, off)
+        return v, off + 8
+    if tag == b"f":
+        (v,) = struct.unpack_from("<d", data, off)
+        return v, off + 8
+    if tag == b"S":
+        return _dec_str(data, off)
+    if tag == b"B":
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        _need(data, off, n)
+        return data[off : off + n], off + n
+    if tag == b"A":
+        dt, off = _dec_str(data, off)
+        ndim = data[off]
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}q", data, off)
+        off += 8 * ndim
+        dtype = np.dtype(dt)
+        count = int(np.prod(shape)) if ndim else 1
+        nbytes = count * dtype.itemsize
+        _need(data, off, nbytes)
+        arr = np.frombuffer(data, dtype, count=count,
+                            offset=off).reshape(shape).copy()
+        return arr, off + nbytes
+    if tag in (b"L", b"U"):
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            x, off = _dec(data, off)
+            items.append(x)
+        return (items if tag == b"L" else tuple(items)), off
+    if tag == b"Z":
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            x, off = _dec(data, off)
+            items.append(x)
+        return frozenset(items), off
+    if tag == b"D":
+        (n,) = struct.unpack_from("<I", data, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _dec(data, off)
+            v, off = _dec(data, off)
+            d[k] = v
+        return d, off
+    if tag == b"O":
+        name, off = _dec_str(data, off)
+        cls = registry().get(name)
+        if cls is None:
+            raise ValueError(f"unknown wire class {name!r}")
+        (nf,) = struct.unpack_from("<H", data, off)
+        off += 2
+        kwargs = {}
+        for _ in range(nf):
+            fname, off = _dec_str(data, off)
+            val, off = _dec(data, off)
+            kwargs[fname] = val
+        return cls(**kwargs), off
+    raise ValueError(f"bad wire tag {tag!r} at {off - 1}")

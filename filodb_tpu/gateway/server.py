@@ -84,7 +84,10 @@ class GatewayServer:
                         lines_failed.inc()
                 outer.sink.flush()
 
-        self.server = socketserver.ThreadingTCPServer((host, port), Handler)
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True  # rebind across fast restarts
+
+        self.server = Server((host, port), Handler)
         self.server.daemon_threads = True
         self.port = self.server.server_address[1]
         self._thread = threading.Thread(target=self.server.serve_forever,

@@ -74,16 +74,23 @@ class FileLog(ReplayLog):
     MAGIC = b"FLOG1"
 
     def __init__(self, path: str, index_every: int = 64,
-                 fsync: bool = False):
+                 fsync: bool = False, read_only: bool = False):
+        """``read_only``: a shared-FS tailer's view of another process's
+        live segment — never truncates, never opens a write handle (a
+        tailer running the owner's torn-tail recovery would corrupt
+        acknowledged data mid-append)."""
         self.path = path
         self.index_every = index_every
         self.fsync = fsync
+        self.read_only = read_only
         self._lock = threading.Lock()
         self._count = 0
         self._index: list[tuple[int, int]] = []  # (offset, pos)
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if os.path.exists(path):
             self._recover_scan()
+        elif read_only:
+            raise FileNotFoundError(path)
         else:
             with open(path, "wb") as f:
                 f.write(self.MAGIC)
@@ -99,13 +106,16 @@ class FileLog(ReplayLog):
                     os.fsync(dfd)
                 finally:
                     os.close(dfd)
-        self._f = open(path, "ab")
+        self._f = None if read_only else open(path, "ab")
 
     def _recover_scan(self):
         size = os.path.getsize(self.path)
         with open(self.path, "rb") as f:
             magic = f.read(5)
-            assert magic == self.MAGIC, "bad log file"
+            if magic != self.MAGIC:
+                if self.read_only:
+                    return  # half-created segment: skip, retry next poll
+                raise ValueError(f"bad log file {self.path}")
             pos = 5
             while pos + 4 <= size:
                 f.seek(pos)
@@ -116,14 +126,18 @@ class FileLog(ReplayLog):
                     self._index.append((self._count, pos))
                 pos += 4 + ln
                 self._count += 1
-        if pos < size:
+        if pos < size and not self.read_only:
             # Torn tail: records appended after reopening in append mode
             # would land after the garbage bytes and be unreadable, so cut
-            # the file back to the last complete record.
+            # the file back to the last complete record. (Tailers must NOT
+            # do this — a partial record may be the owner's append in
+            # flight.)
             with open(self.path, "r+b") as f:
                 f.truncate(pos)
 
     def append(self, container: RecordContainer) -> int:
+        if self.read_only:
+            raise OSError(f"read-only tailer view of {self.path}")
         payload = container.serialize()
         with self._lock:
             pos = self._f.tell()
@@ -139,10 +153,18 @@ class FileLog(ReplayLog):
             return off
 
     def read_from(self, offset: int) -> Iterator[SomeData]:
+        """Yield complete records from ``offset`` to end-of-file.
+
+        Scans to EOF rather than to this instance's record count: a tailer
+        in ANOTHER process (shard owner tailing a gateway-written log on a
+        shared filesystem) must see records appended after it opened the
+        file. A partial record at the tail (append in flight, or torn) ends
+        the scan; the next poll retries it.
+        """
         offset = max(offset, 0)
         with self._lock:
-            self._f.flush()
-            count = self._count
+            if self._f is not None:
+                self._f.flush()
             # seek via sparse index
             seek_off, seek_pos = 0, 5
             for o, p in self._index:
@@ -151,24 +173,32 @@ class FileLog(ReplayLog):
                 else:
                     break
         with open(self.path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
             f.seek(seek_pos)
             cur = seek_off
-            while cur < count:
+            pos = seek_pos
+            while pos + 4 <= size:
                 hdr = f.read(4)
                 if len(hdr) < 4:
                     break
                 (ln,) = struct.unpack("<I", hdr)
+                if pos + 4 + ln > size:
+                    break  # partial tail: append in flight or torn
                 data = f.read(ln)
+                if len(data) < ln:
+                    break
                 if cur >= offset:
                     yield SomeData(BytesContainer(data), cur)
                 cur += 1
+                pos += 4 + ln
 
     @property
     def latest_offset(self) -> int:
         return self._count - 1
 
     def close(self):
-        self._f.close()
+        if self._f is not None:
+            self._f.close()
 
 
 class SegmentedFileLog(ReplayLog):
@@ -178,11 +208,17 @@ class SegmentedFileLog(ReplayLog):
     (``truncate_before``), bounding WAL growth without rewrite."""
 
     def __init__(self, directory: str, segment_entries: int = 4096,
-                 index_every: int = 64, fsync: bool = False):
+                 index_every: int = 64, fsync: bool = False,
+                 read_only: bool = False):
+        """``read_only``: a tailer's view of a log another process appends
+        to (shard owner tailing the gateway's segments on a shared FS) —
+        all segments open read-only, append/retention are forbidden, and
+        no recovery truncation ever touches the appender's files."""
         self.dir = directory
         self.segment_entries = segment_entries
         self.index_every = index_every
         self.fsync = fsync
+        self.read_only = read_only
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         self._segments: list[tuple[int, FileLog]] = []  # (first_offset, log)
@@ -191,8 +227,9 @@ class SegmentedFileLog(ReplayLog):
                 first = int(name[4:-4])
                 self._segments.append(
                     (first, FileLog(os.path.join(directory, name),
-                                    index_every, fsync=fsync)))
-        if not self._segments:
+                                    index_every, fsync=fsync,
+                                    read_only=read_only)))
+        if not self._segments and not read_only:
             self._roll(0)
 
     def _roll(self, first_offset: int) -> None:
@@ -201,6 +238,8 @@ class SegmentedFileLog(ReplayLog):
                                                      fsync=self.fsync)))
 
     def append(self, container: RecordContainer) -> int:
+        if self.read_only:
+            raise OSError(f"read-only tailer view of {self.dir}")
         with self._lock:
             first, seg = self._segments[-1]
             if seg.latest_offset + 1 >= self.segment_entries:
@@ -210,23 +249,50 @@ class SegmentedFileLog(ReplayLog):
             local = seg.append(container)
             return first + local
 
+    def _discover_segments(self) -> None:
+        """Pick up segment files rolled by another process (shared-FS
+        tailer): the appender may roll new files after we opened the dir.
+        Discovered segments open READ-ONLY — they belong to the appender
+        process; an append-mode open would run torn-tail truncation against
+        a live file."""
+        known = {first for first, _ in self._segments}
+        for name in sorted(os.listdir(self.dir)):
+            if name.startswith("seg-") and name.endswith(".log"):
+                first = int(name[4:-4])
+                if first not in known:
+                    try:
+                        self._segments.append(
+                            (first, FileLog(os.path.join(self.dir, name),
+                                            self.index_every,
+                                            read_only=True)))
+                    except FileNotFoundError:
+                        pass  # raced a concurrent delete
+        self._segments.sort(key=lambda t: t[0])
+
     def read_from(self, offset: int):
         offset = max(offset, 0)
         with self._lock:
+            self._discover_segments()
             segments = list(self._segments)
-        for first, seg in segments:
-            last = first + seg.latest_offset
-            if last < offset:
+        for i, (first, seg) in enumerate(segments):
+            # a segment's upper bound is the NEXT segment's first offset —
+            # this instance's record counts are stale for segments another
+            # process appends to, so never skip on latest_offset alone
+            if i + 1 < len(segments) and segments[i + 1][0] <= offset:
                 continue
             for sd in seg.read_from(max(offset - first, 0)):
                 yield SomeData(sd.container, first + sd.offset)
 
     @property
     def latest_offset(self) -> int:
+        if not self._segments:
+            return -1
         first, seg = self._segments[-1]
         return first + seg.latest_offset
 
     def align_after(self, offset: int) -> None:
+        if self.read_only:
+            return  # offset assignment is the appender's concern
         with self._lock:
             first, seg = self._segments[-1]
             if first + seg.latest_offset >= offset:
@@ -238,6 +304,8 @@ class SegmentedFileLog(ReplayLog):
     def truncate_before(self, offset: int) -> int:
         """Delete whole segments entirely below ``offset``. Returns segments
         removed. The newest segment is always retained."""
+        if self.read_only:
+            return 0
         removed = 0
         with self._lock:
             while len(self._segments) > 1:
@@ -253,7 +321,7 @@ class SegmentedFileLog(ReplayLog):
 
     @property
     def earliest_offset(self) -> int:
-        return self._segments[0][0]
+        return self._segments[0][0] if self._segments else 0
 
     def close(self):
         for _, seg in self._segments:

@@ -49,6 +49,11 @@ class InProcessPlanDispatcher(PlanDispatcher):
     """Executes against the local memstore (reference
     ``InProcessPlanDispatcher.scala``)."""
 
+    # stateless: serializes as a bare tag. (Deliberately NOT on the base
+    # class — stateful dispatchers like NodeDispatcher must fail at encode
+    # time, not silently drop their state.)
+    __wire_fields__ = ()
+
     def dispatch(self, plan, ctx):
         return plan.execute(ctx)
 

@@ -54,9 +54,13 @@ class FiloServer:
     def _shard_log(self, dataset: str, shard: int) -> SegmentedFileLog:
         key = (dataset, shard)
         if key not in self.logs:
+            # members tail segments the gateway host appends to on the
+            # shared wal_dir: their view must be read-only (an append-mode
+            # open would run torn-tail recovery against a live file)
+            tailer = bool(self.config.seeds) and not self.config.gateway_port
             self.logs[key] = SegmentedFileLog(
                 self._wal_path(dataset, shard),
-                fsync=self.config.wal_fsync)
+                fsync=self.config.wal_fsync, read_only=tailer)
         return self.logs[key]
 
     # -- control handlers (member side; reference NodeCoordinatorActor) --

@@ -182,6 +182,37 @@ class TestMemberRegistry:
         assert members["a"] == ("coord", "127.0.0.1", 1000)
 
 
+class TestCrossProcessTailing:
+    def test_tailer_sees_appends_from_other_instance(self, tmp_path):
+        # the shard owner tails segments the gateway process appends to on a
+        # shared FS: a second (read-only) log instance over the same dir
+        # must see records appended after it opened, and new rolled segments
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        keys = machine_metrics_series(1)
+        writer = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=5)
+        stream = list(gauge_stream(keys, 12, batch=1))
+        for sd in stream[:3]:
+            writer.append(sd.container)
+        tailer = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=5,
+                                  read_only=True)
+        assert len(list(tailer.read_from(0))) == 3
+        # appends after the tailer opened — incl. a segment roll at 5
+        for sd in stream[3:]:
+            writer.append(sd.container)
+        got = [e.offset for e in tailer.read_from(0)]
+        assert got == list(range(12))
+        # tailer never truncates or writes: appender continues cleanly
+        for sd in gauge_stream(keys, 1, batch=1, start_ms=10**9):
+            writer.append(sd.container)
+        assert len(list(tailer.read_from(0))) == 13
+        import pytest as _pytest
+        with _pytest.raises(OSError, match="read-only"):
+            tailer.append(stream[0].container)
+        writer.close()
+        tailer.close()
+
+
 class TestTornWAL:
     def test_torn_tail_ignored_on_recovery(self, tmp_path):
         from filodb_tpu.kafka.log import FileLog
