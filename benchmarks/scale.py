@@ -50,7 +50,11 @@ def main(argv=None):
     )
     from filodb_tpu.core.store.config import StoreConfig
 
-    ms = TimeSeriesMemStore()
+    from filodb_tpu.core.store.api import (
+        InMemoryColumnStore,
+        InMemoryMetaStore,
+    )
+    ms = TimeSeriesMemStore(InMemoryColumnStore(), InMemoryMetaStore())
     # small chunk size bounds the per-series write-buffer footprint, the way
     # the reference sizes WriteBufferPool appenders for high cardinality
     shard = ms.setup("scale", 0, StoreConfig(max_chunk_size=64,
@@ -98,6 +102,19 @@ def main(argv=None):
                         START + args.samples * 10, 60,
                         START + args.samples * 10)
     q_dt = time.perf_counter() - t0
+
+    # restart: index snapshot write + snapshot-restored recover
+    # (reference target: Lucene index ready without a full part-key scan)
+    t0 = time.perf_counter()
+    snap_bytes = shard.snapshot_index()
+    snap_dt = time.perf_counter() - t0
+    ms3 = TimeSeriesMemStore(ms.column_store, ms.meta_store)
+    t0 = time.perf_counter()
+    s3 = ms3.setup("scale", 0, StoreConfig(max_chunk_size=64,
+                                           groups_per_shard=64))
+    restored = s3.recover_index()
+    restart_dt = time.perf_counter() - t0
+
     out = {
         "series": n,
         "create_series_per_sec": round(n / create_dt),
@@ -107,6 +124,10 @@ def main(argv=None):
         "rss_mb": round(rss1, 1),
         "slice_query_series": int(r.result.values[0, 0]),
         "slice_query_sec": round(q_dt, 3),
+        "index_snapshot_mb": round(snap_bytes / 1e6, 1),
+        "index_snapshot_write_sec": round(snap_dt, 2),
+        "restart_index_ready_sec": round(restart_dt, 2),
+        "restart_series_restored": restored,
     }
     print(json.dumps(out))
 

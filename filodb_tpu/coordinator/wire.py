@@ -28,6 +28,10 @@ MAX_FRAME = 256 * 1024 * 1024  # hard cap on any frame (DoS guard)
 def _build_registry() -> dict[str, type]:
     """All classes allowed on the wire. Subclass walks keep the registry in
     step with new exec nodes/transformers/filters automatically."""
+    # import every module that defines wire classes BEFORE walking
+    # subclasses — the registry must not depend on process import order
+    from filodb_tpu.coordinator import cluster  # noqa: F401
+    from filodb_tpu.coordinator import remote  # noqa: F401
     from filodb_tpu.core.filters import ColumnFilter, Filter
     from filodb_tpu.core.partkey import PartKey
     from filodb_tpu.memory.chunk import Chunk

@@ -75,6 +75,33 @@ class CardinalityTracker:
             cur = nxt
         return nodes
 
+    def to_state(self) -> list:
+        """Serializable tree state (O(distinct shard-key prefixes), not
+        O(series)) — rides in the index snapshot so restored shards keep
+        their cardinality counts and quotas."""
+        def walk(node):
+            c = node.card
+            return [c.name, c.active_ts, c.total_ts, c.children, c.quota,
+                    [walk(ch) for ch in node.children.values()]]
+        return walk(self._root)
+
+    def load_state(self, state: list) -> None:
+        def build(entry) -> _Node:
+            name, active, total, children, quota, kids = entry
+            n = _Node(Cardinality(name, active, total, children, quota))
+            for kid in kids:
+                n.children[kid[0]] = build(kid)
+            return n
+        self._root = build(state)
+        self._has_quotas = self._has_quotas or self._any_finite(self._root)
+
+    @staticmethod
+    def _any_finite(node) -> bool:
+        if node.card.quota < 2**62:
+            return True
+        return any(CardinalityTracker._any_finite(ch)
+                   for ch in node.children.values())
+
     @property
     def has_quotas(self) -> bool:
         """True once any finite quota is configured (the native ingest lane

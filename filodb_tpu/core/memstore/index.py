@@ -7,38 +7,124 @@ per shard, maps label=value postings to partition ids, tracks per-partition
 regex / In filters (``leafFilter:455``, ``partIdsFromFilters:494``) and label
 introspection (labelValues / indexNames).
 
-Rebuilt TPU-first as a pure in-process structure: postings are Python sets
-over int part-ids (dense, starting at 0), time bounds are parallel numpy
-arrays — no Lucene, no mmap. Regex filters scan the per-label value
-dictionary, which is tiny relative to the postings.
+Rebuilt TPU-first as a two-tier structure (no Lucene, no mmap):
+
+- **frozen tier**: per label, a sorted value table (offset-indexed bytes) and
+  flat sorted pid arrays — loaded as zero-copy numpy slices from an index
+  snapshot; lookups are a binary search + array slice, and filter
+  intersections are ``np.intersect1d`` over sorted arrays (the
+  roaring-bitmap analog, vectorized instead of pointer-chasing sets).
+- **tail tier**: plain ``dict → set`` postings for keys added since the last
+  freeze/restore; merged into query results and folded into the next
+  snapshot.
+
+Regex/negative filters scan the per-label value table, which is tiny
+relative to the postings; non-empty-matching regexes use the value scan as a
+positive filter (Lucene's regexp query analog).
 """
 
 from __future__ import annotations
 
+from bisect import bisect_left
 from collections import defaultdict
 
 import numpy as np
 
-from filodb_tpu.core.filters import ColumnFilter, Equals, In
+from filodb_tpu.core.filters import ColumnFilter, Equals, EqualsRegex, In
 from filodb_tpu.core.partkey import PartKey
 
 _INIT_CAP = 1024
 # endTime for a still-ingesting partition (reference Long.MaxValue semantics)
 INGESTING = np.iinfo(np.int64).max
+_EMPTY = np.array([], np.int64)
+
+
+class FrozenLabel:
+    """One label's frozen postings: sorted value table + flat pid arrays."""
+
+    __slots__ = ("voff", "vblob", "poff", "pids")
+
+    def __init__(self, voff: np.ndarray, vblob: bytes, poff: np.ndarray,
+                 pids: np.ndarray):
+        self.voff = voff    # u32 [nv+1] offsets into vblob
+        self.vblob = vblob  # concatenated value bytes, sorted
+        self.poff = poff    # i64 [nv+1] offsets into pids
+        self.pids = pids    # i32, sorted within each value's slice
+
+    @property
+    def nv(self) -> int:
+        return len(self.voff) - 1
+
+    def value(self, vi: int) -> bytes:
+        return self.vblob[self.voff[vi] : self.voff[vi + 1]]
+
+    def find(self, value: bytes) -> int:
+        """Binary search the sorted value table; -1 when absent."""
+        lo, hi = 0, self.nv
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.value(mid) < value:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < self.nv and self.value(lo) == value:
+            return lo
+        return -1
+
+    def pid_slice(self, vi: int) -> np.ndarray:
+        return self.pids[self.poff[vi] : self.poff[vi + 1]]
+
+    def values(self):
+        for vi in range(self.nv):
+            yield self.value(vi), vi
+
+    @staticmethod
+    def build(pairs: list) -> "FrozenLabel":
+        """From (value_bytes, sorted pid sequence) pairs (any order).
+        Sequences may be arrays or lists; the flat pid array is built with
+        one fromiter pass (1M tiny per-value concatenations would dominate
+        snapshot writes at high cardinality)."""
+        from itertools import chain
+        pairs.sort(key=lambda t: t[0])
+        nv = len(pairs)
+        vlens = np.fromiter((len(vb) for vb, _ in pairs), np.uint32, nv)
+        plens = np.fromiter((len(a) for _, a in pairs), np.int64, nv)
+        voff = np.zeros(nv + 1, np.uint32)
+        np.cumsum(vlens, out=voff[1:])
+        poff = np.zeros(nv + 1, np.int64)
+        np.cumsum(plens, out=poff[1:])
+        vblob = b"".join(vb for vb, _ in pairs)
+        total = int(poff[-1])
+        pids = np.fromiter(chain.from_iterable(a for _, a in pairs),
+                           np.int32, total)
+        return FrozenLabel(voff, vblob, poff, pids)
+
+
+def _from_set(s: set[int]) -> np.ndarray:
+    a = np.fromiter(s, np.int64, len(s))
+    a.sort()
+    return a
 
 
 class PartKeyIndex:
     """Tag index for one shard."""
 
     def __init__(self):
-        # label -> value -> set of partIds
-        self._postings: dict[str, dict[str, set[int]]] = defaultdict(
+        # tail tier: label -> value -> set of partIds (new since freeze)
+        self._tail: dict[str, dict[str, set[int]]] = defaultdict(
             lambda: defaultdict(set)
         )
-        self._part_keys: list[PartKey | None] = []
-        self._start: np.ndarray = np.full(_INIT_CAP, np.iinfo(np.int64).max, np.int64)
-        self._end: np.ndarray = np.full(_INIT_CAP, np.iinfo(np.int64).max, np.int64)
+        # frozen tier from a snapshot restore: label -> FrozenLabel
+        self._frozen: dict[str, FrozenLabel] = {}
+        # pids removed since freeze (may still appear in frozen arrays)
+        self._deleted: set[int] = set()
+        # entries are PartKey objects, or raw key blobs (bytes) after a
+        # snapshot restore — materialized lazily via part_key()
+        self._part_keys: list[PartKey | bytes | None] = []
+        self._start: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
+        self._end: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
         self._count = 0
+        self._schemas = None  # set on snapshot restore (blob -> PartKey)
 
     def __len__(self) -> int:
         return self._count
@@ -60,19 +146,23 @@ class PartKeyIndex:
         self._part_keys[part_id] = key
         self._start[part_id] = start_time
         self._end[part_id] = end_time
+        self._deleted.discard(part_id)
         for name, value in key.labels:
-            self._postings[name][value].add(part_id)
+            self._tail[name][value].add(part_id)
 
     def remove_part_key(self, part_id: int) -> None:
-        key = self._part_keys[part_id]
+        key = self.part_key(part_id)
         if key is None:
             return
         for name, value in key.labels:
-            s = self._postings[name].get(value)
-            if s is not None:
-                s.discard(part_id)
-                if not s:
-                    del self._postings[name][value]
+            by_value = self._tail.get(name)
+            if by_value is not None:
+                s = by_value.get(value)
+                if s is not None:
+                    s.discard(part_id)
+                    if not s:
+                        del by_value[value]
+        self._deleted.add(part_id)  # masks any frozen postings
         self._part_keys[part_id] = None
         self._start[part_id] = INGESTING
         self._end[part_id] = INGESTING
@@ -88,26 +178,87 @@ class PartKeyIndex:
         return int(self._end[part_id])
 
     def part_key(self, part_id: int) -> PartKey | None:
-        return self._part_keys[part_id] if part_id < len(self._part_keys) else None
+        if part_id >= len(self._part_keys):
+            return None
+        k = self._part_keys[part_id]
+        if isinstance(k, bytes):  # lazy blob from a snapshot restore
+            from filodb_tpu.core.memstore.native_shard import (
+                part_key_from_blob,
+            )
+            k = part_key_from_blob(k, self._schemas)
+            self._part_keys[part_id] = k
+        return k
 
-    def _ids_for_filter(self, f: ColumnFilter) -> set[int] | None:
-        """Postings for one filter; None means 'all' (negative filters)."""
-        by_value = self._postings.get(f.column)
+    # ---- filter evaluation ----------------------------------------------
+
+    def _equals_ids(self, col: str, value: str) -> np.ndarray:
+        parts = []
+        fr = self._frozen.get(col)
+        if fr is not None:
+            vi = fr.find(value.encode())
+            if vi >= 0:
+                parts.append(fr.pid_slice(vi).astype(np.int64))
+        tail = self._tail.get(col)
+        if tail is not None:
+            s = tail.get(value)
+            if s:
+                parts.append(_from_set(s))
+        if not parts:
+            return _EMPTY
+        if len(parts) == 1:
+            return parts[0]
+        return np.unique(np.concatenate(parts))
+
+    def _value_scan_ids(self, col: str, match) -> np.ndarray:
+        """Union postings of every value matching the predicate."""
+        parts = []
+        fr = self._frozen.get(col)
+        if fr is not None:
+            for vb, vi in fr.values():
+                if match(vb.decode()):
+                    parts.append(fr.pid_slice(vi).astype(np.int64))
+        tail = self._tail.get(col)
+        if tail is not None:
+            for value, s in tail.items():
+                if s and match(value):
+                    parts.append(_from_set(s))
+        if not parts:
+            return _EMPTY
+        return np.unique(np.concatenate(parts))
+
+    def _ids_for_filter(self, f: ColumnFilter) -> np.ndarray:
         flt = f.filter
         if isinstance(flt, Equals):
-            if by_value is None:
-                return set()
-            return set(by_value.get(flt.value, ()))
+            return self._equals_ids(f.column, flt.value)
         if isinstance(flt, In):
-            if by_value is None:
-                return set()
+            parts = [self._equals_ids(f.column, v) for v in flt.values]
+            parts = [p for p in parts if len(p)]
+            if not parts:
+                return _EMPTY
+            return np.unique(np.concatenate(parts))
+        # EqualsRegex that can't match an absent label ("" doesn't match):
+        # the per-label value scan is a sound positive filter
+        return self._value_scan_ids(f.column, flt.matches)
+
+    def _all_live_ids(self) -> np.ndarray:
+        # live entries have real start bounds (tombstones carry INGESTING) —
+        # no key materialization needed
+        n = len(self._part_keys)
+        return np.flatnonzero(self._start[:n] != INGESTING).astype(np.int64)
+
+    def _ids_for_filter_set(self, f: ColumnFilter) -> set[int]:
+        """Tail-only postings as a set (fast path: nothing frozen)."""
+        by_value = self._tail.get(f.column)
+        flt = f.filter
+        if by_value is None:
+            return set()
+        if isinstance(flt, Equals):
+            return by_value.get(flt.value) or set()
+        if isinstance(flt, In):
             out: set[int] = set()
             for v in flt.values:
                 out |= by_value.get(v, set())
             return out
-        # regex / not-equals: scan the value dictionary for this label
-        if by_value is None:
-            return None  # label absent everywhere: negative filters pass all
         out = set()
         for value, ids in by_value.items():
             if flt.matches(value):
@@ -118,26 +269,68 @@ class PartKeyIndex:
         self, filters: list[ColumnFilter], start_time: int, end_time: int
     ) -> list[int]:
         """Intersect filter postings, then apply the time overlap predicate
-        (reference ``partIdsFromFilters:494``)."""
+        (reference ``partIdsFromFilters:494``). Set ops while everything is
+        in the mutable tail; sorted-array ops once a frozen tier exists."""
+        if not self._frozen:
+            return self._part_ids_set_path(filters, start_time, end_time)
+        result: np.ndarray | None = None
+        negatives: list[ColumnFilter] = []
+        for f in filters:
+            flt = f.filter
+            positive = isinstance(flt, (Equals, In)) or (
+                isinstance(flt, EqualsRegex) and not flt.matches(""))
+            if positive:
+                ids = self._ids_for_filter(f)
+                result = ids if result is None \
+                    else np.intersect1d(result, ids, assume_unique=True)
+                if not len(result):
+                    return []
+            else:
+                negatives.append(f)
+        if result is None:
+            result = self._all_live_ids()
+        if self._deleted and len(result):
+            dead = _from_set(self._deleted)
+            result = result[~np.isin(result, dead, assume_unique=True)]
+        for f in negatives:
+            # match semantics: absent label == "" for negative/regex filters
+            keep = []
+            for pid in result:
+                key = self.part_key(int(pid))
+                if key is not None and f.filter.matches(
+                        key.label_map.get(f.column, "")):
+                    keep.append(pid)
+            result = np.asarray(keep, np.int64)
+        if not len(result):
+            return []
+        ok = (self._start[result] <= end_time) & (self._end[result] >= start_time)
+        return [int(i) for i in result[ok]]
+
+    def _part_ids_set_path(self, filters, start_time, end_time) -> list[int]:
         result: set[int] | None = None
         negatives: list[ColumnFilter] = []
         for f in filters:
             flt = f.filter
             if isinstance(flt, (Equals, In)):
-                ids = self._ids_for_filter(f)
+                ids = self._ids_for_filter_set(f)
+                result = set(ids) if result is None else result & ids
+                if not result:
+                    return []
+            elif isinstance(flt, EqualsRegex) and not flt.matches(""):
+                ids = self._ids_for_filter_set(f)
                 result = ids if result is None else result & ids
                 if not result:
                     return []
             else:
                 negatives.append(f)
         if result is None:
-            result = {i for i, k in enumerate(self._part_keys) if k is not None}
+            result = set(self._all_live_ids().tolist())
         for f in negatives:
-            # match semantics: absent label == "" for negative/regex filters
             keep = set()
             for pid in result:
-                key = self._part_keys[pid]
-                if key is not None and f.filter.matches(key.label_map.get(f.column, "")):
+                key = self.part_key(pid)
+                if key is not None and f.filter.matches(
+                        key.label_map.get(f.column, "")):
                     keep.add(pid)
             result = keep
         if not result:
@@ -146,16 +339,81 @@ class PartKeyIndex:
         ok = (self._start[ids] <= end_time) & (self._end[ids] >= start_time)
         return sorted(int(i) for i in ids[ok])
 
+    # ---- label introspection --------------------------------------------
+
     def label_names(self) -> list[str]:
-        return sorted(k for k, v in self._postings.items() if v)
+        names = {k for k, v in self._tail.items() if any(v.values())}
+        names |= set(self._frozen.keys())
+        return sorted(names)
 
     def label_values(self, label: str,
                      filters: list[ColumnFilter] | None = None,
                      start_time: int = 0, end_time: int = INGESTING) -> list[str]:
-        by_value = self._postings.get(label)
-        if not by_value:
+        fr = self._frozen.get(label)
+        tail = self._tail.get(label)
+        if fr is None and not tail:
             return []
         if not filters:
-            return sorted(by_value.keys())
-        ids = set(self.part_ids_from_filters(filters, start_time, end_time))
-        return sorted(v for v, pids in by_value.items() if pids & ids)
+            out = {v for v, s in (tail or {}).items() if s}
+            if fr is not None:
+                if self._deleted:
+                    dead = _from_set(self._deleted)
+                    for vb, vi in fr.values():
+                        sl = fr.pid_slice(vi)
+                        if len(sl) and not np.isin(
+                                sl, dead, assume_unique=True).all():
+                            out.add(vb.decode())
+                else:
+                    out |= {vb.decode() for vb, _ in fr.values()}
+            return sorted(out)
+        ids = np.asarray(
+            self.part_ids_from_filters(filters, start_time, end_time),
+            np.int64)
+        out = set()
+        if len(ids):
+            if fr is not None:
+                for vb, vi in fr.values():
+                    if np.isin(fr.pid_slice(vi), ids).any():
+                        out.add(vb.decode())
+            for value, s in (tail or {}).items():
+                if s and not s.isdisjoint(ids.tolist()):
+                    out.add(value)
+        return sorted(out)
+
+    # ---- snapshot support -----------------------------------------------
+
+    def frozen_labels(self):
+        """Yield (label, FrozenLabel) merging the frozen and tail tiers with
+        deletions applied — the snapshot writer's view. A frozen label with
+        no tail additions and no deletions is yielded as-is (re-serialized
+        wholesale, no per-value work)."""
+        dead = _from_set(self._deleted) if self._deleted else None
+        labels = set(self._tail.keys()) | set(self._frozen.keys())
+        for name in sorted(labels):
+            fr = self._frozen.get(name)
+            tail = {v: s for v, s in (self._tail.get(name) or {}).items()
+                    if s}
+            if fr is not None and not tail and dead is None:
+                yield name, fr
+                continue
+            merged: dict[bytes, list] = {}
+            if fr is not None:
+                for vb, vi in fr.values():
+                    sl = fr.pid_slice(vi)
+                    if dead is not None and len(sl):
+                        sl = sl[~np.isin(sl, dead, assume_unique=True)]
+                    if len(sl):
+                        merged[vb] = [sl]
+            for value, s in tail.items():
+                merged.setdefault(value.encode(), []).append(sorted(s))
+            pairs = []
+            for vb, seqs in merged.items():
+                seq = seqs[0] if len(seqs) == 1 \
+                    else np.unique(np.concatenate(
+                        [np.asarray(a, np.int64) for a in seqs]))
+                pairs.append((vb, seq))
+            if pairs:
+                yield name, FrozenLabel.build(pairs)
+
+    def load_frozen(self, label: str, frozen: FrozenLabel) -> None:
+        self._frozen[label] = frozen

@@ -112,6 +112,58 @@ class NativeShardCore:
     def part_hash(self, pid: int) -> int:
         return int(self._lib.shard_core_part_hash(self._core, pid))
 
+    def lookup(self, key_blob: bytes) -> int:
+        """pid for canonical key bytes, or -1 — the authoritative key map
+        for restored shards (no host-language dictionary needed)."""
+        buf = (ctypes.c_uint8 * len(key_blob)).from_buffer_copy(key_blob)
+        with self.lock:
+            return int(self._lib.shard_core_lookup(self._core, buf,
+                                                   len(key_blob)))
+
+    def bootstrap(self, buf: bytes) -> int:
+        """Bulk-create partitions from snapshot entries (one C call)."""
+        with self.lock:
+            n = int(self._lib.shard_core_bootstrap(self._core, buf,
+                                                   len(buf)))
+        if n < 0:
+            raise ValueError("malformed bootstrap buffer or non-empty core")
+        return n
+
+    def seed_floors(self, pids: np.ndarray, floors: np.ndarray) -> None:
+        pids = np.ascontiguousarray(pids, np.int32)
+        floors = np.ascontiguousarray(floors, np.int64)
+        with self.lock:
+            self._lib.shard_core_seed_floors(
+                self._core,
+                pids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                floors.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(pids))
+
+    def part_floor(self, pid: int) -> int:
+        return int(self._lib.part_floor(self._core, pid))
+
+    def export_entries(self, n: int) -> tuple[bytes, np.ndarray, np.ndarray]:
+        """(core_section, key_off i64[n], key_len i32[n]) — the snapshot's
+        partition registry section, built in one C++ pass."""
+        with self.lock:
+            size = int(self._lib.shard_core_export_size(self._core))
+            buf = (ctypes.c_uint8 * size)()
+            key_off = np.empty(max(n, 1), np.int64)
+            key_len = np.empty(max(n, 1), np.int32)
+            self._lib.shard_core_export(
+                self._core, buf,
+                key_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                key_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return bytes(buf), key_off[:n], key_len[:n]
+
+    def floors(self, n: int) -> np.ndarray:
+        out = np.empty(max(n, 1), np.int64)
+        with self.lock:
+            self._lib.shard_core_floors(
+                self._core,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n)
+        return out[:n]
+
 
 class NativeBackedPartition:
     """``TimeSeriesPartition``-protocol view over a native partition.
@@ -121,23 +173,47 @@ class NativeBackedPartition:
     access. All mutation goes through the core.
     """
 
-    __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "shard",
-                 "bucket_les", "device_pages", "_core", "_lib",
-                 "_chunks_cache", "_chunks_ver")
+    __slots__ = ("part_id", "max_chunk_size", "shard", "bucket_les",
+                 "device_pages", "_core", "_lib", "_chunks_cache",
+                 "_chunks_ver", "_part_key", "_schema", "_key_blob",
+                 "_schemas")
 
-    def __init__(self, core: NativeShardCore, part_id: int, part_key: PartKey,
-                 schema: Schema, max_chunk_size: int = 400, shard: int = 0):
+    def __init__(self, core: NativeShardCore, part_id: int,
+                 part_key: PartKey | None = None,
+                 schema: Schema | None = None, max_chunk_size: int = 400,
+                 shard: int = 0, key_blob: bytes | None = None,
+                 schemas=None):
+        """Either (part_key, schema) or (key_blob, schemas): snapshot
+        restore passes blobs so a million keys don't materialize at boot —
+        ``part_key``/``schema`` parse lazily on first access."""
         self._core = core
         self._lib = core._lib
         self.part_id = part_id
-        self.part_key = part_key
-        self.schema = schema
+        self._part_key = part_key
+        self._schema = schema
+        self._key_blob = key_blob
+        self._schemas = schemas
         self.max_chunk_size = max_chunk_size
         self.shard = shard
         self.bucket_les = None
         self.device_pages = False
         self._chunks_cache: list[Chunk] = []
         self._chunks_ver = -1
+
+    @property
+    def part_key(self) -> PartKey:
+        if self._part_key is None:
+            self._part_key = part_key_from_blob(self._key_blob, self._schemas)
+            self._part_key.__dict__["part_hash"] = \
+                self._core.part_hash(self.part_id)
+        return self._part_key
+
+    @property
+    def schema(self) -> Schema:
+        if self._schema is None:
+            (sid,) = struct.unpack_from("<H", self._key_blob, 0)
+            self._schema = self._schemas.by_id(sid)
+        return self._schema
 
     # -- ingest (rare path: replay of object containers, tests) --
 

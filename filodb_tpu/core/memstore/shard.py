@@ -130,7 +130,7 @@ class TimeSeriesShard:
 
     def get_or_create_partition(self, key: PartKey, first_ts: int
                                 ) -> TimeSeriesPartition:
-        pid = self._by_key.get(key)
+        pid = self._pid_for_key(key)  # dict, or the C++ key map (restored)
         if pid is not None:
             part = self.partitions[pid]
             if part is not None:
@@ -177,7 +177,7 @@ class TimeSeriesShard:
         self.index.add_part_key(pid, key, first_ts)
         self._dirty_part_keys.add(pid)
         self.stats.partitions_created.inc()
-        self.stats.num_partitions.set(len(self._by_key))
+        self.stats.num_partitions.set(len(self.index))
         return part
 
     def partition(self, part_id: int) -> TimeSeriesPartition | None:
@@ -185,7 +185,9 @@ class TimeSeriesShard:
 
     @property
     def num_partitions(self) -> int:
-        return len(self._by_key)
+        # the index counts live keys; _by_key is empty for snapshot-restored
+        # native shards (the C++ key map is authoritative there)
+        return len(self.index)
 
     # ---- ingest ----------------------------------------------------------
 
@@ -243,7 +245,7 @@ class TimeSeriesShard:
             # label_map dict + serialized bytes dominate resident memory
             key.__dict__.pop("label_map", None)
             key.__dict__.pop("serialized", None)
-        self.stats.num_partitions.set(len(self._by_key))
+        self.stats.num_partitions.set(len(self.index))
 
     def _ingest_native(self, raw: bytes, offset: int) -> int:
         """Fast lane: container bytes parsed + appended + sealed in C++.
@@ -385,13 +387,29 @@ class TimeSeriesShard:
         return min(cps.values()) if cps else -1
 
     def recover_index(self) -> int:
-        """Rebuild the tag index from persisted part keys (reference
-        ``IndexBootstrapper.bootstrapIndexRaw``). Returns #keys restored.
+        """Restore the tag index (reference ``IndexBootstrapper``). Returns
+        #keys restored.
+
+        Fast path: load the persisted index snapshot (postings + key blobs
+        + floors in one pass; the C++ core bulk-bootstraps its key map) and
+        delta-replay only part keys / chunk floors written after the
+        snapshot's tokens. Fallback: full part-key scan.
 
         Each recovered partition's out-of-order floor is seeded with the max
         persisted chunk timestamp so WAL replay of rows that were flushed
         just before the crash (ingested mid-flush, above the checkpoint) is
         deduplicated instead of double-written."""
+        if not self.partitions:
+            snap = self.column_store.read_index_snapshot(self.dataset,
+                                                         self.shard_num)
+            if snap:
+                try:
+                    return self._recover_from_snapshot(snap)
+                except Exception:
+                    log.exception("index snapshot restore failed for "
+                                  "%s/%d; falling back to full rebuild",
+                                  self.dataset, self.shard_num)
+                    self._reset_registry()
         self._persisted_floors = self.column_store.max_persisted_ts(
             self.dataset, self.shard_num)
         n = 0
@@ -404,6 +422,67 @@ class TimeSeriesShard:
             self._dirty_part_keys.discard(part.part_id)
             n += 1
         return n
+
+    def _reset_registry(self) -> None:
+        """Clear partition/index/native state after a failed restore."""
+        self.partitions = []
+        self._by_key = {}
+        self.index = PartKeyIndex()
+        if self._native_core is not None:
+            from filodb_tpu.core.memstore.native_shard import NativeShardCore
+            self._native_core = NativeShardCore(self.config.max_chunk_size,
+                                                self.config.groups_per_shard)
+
+    def _recover_from_snapshot(self, snap: bytes) -> int:
+        from filodb_tpu.core.memstore.index_snapshot import load_snapshot
+        from filodb_tpu.core.memstore.native_shard import part_key_blob
+        info = load_snapshot(self, snap)
+        # delta: part keys created/updated after the snapshot's token
+        for rec in self.column_store.scan_part_keys_since(
+                self.dataset, self.shard_num, info["pk_token"]):
+            pid = self._pid_for_key(rec.part_key)
+            if pid is None:
+                part = self.get_or_create_partition(rec.part_key,
+                                                    rec.start_time)
+                pid = part.part_id
+                self._dirty_part_keys.discard(pid)
+            self.index.update_end_time(pid, rec.end_time)
+        # delta: chunk floors written after the snapshot's token
+        delta_floors = self.column_store.max_persisted_ts_since(
+            self.dataset, self.shard_num, info["chunk_token"])
+        self._persisted_floors = delta_floors  # replay-created partitions
+        for key, mx in delta_floors.items():
+            pid = self._pid_for_key(key)
+            if pid is not None and self.partitions[pid] is not None:
+                self.partitions[pid].seed_dedup_floor(mx)
+        self.stats.num_partitions.set(len(self.index))
+        return len(self.index)
+
+    def _pid_for_key(self, key: PartKey) -> int | None:
+        pid = self._by_key.get(key)
+        if pid is not None:
+            return pid
+        if self._native_core is not None:
+            from filodb_tpu.core.memstore.native_shard import part_key_blob
+            nat = self._native_core.lookup(part_key_blob(key))
+            if nat >= 0:
+                return nat
+        return None
+
+    def snapshot_index(self) -> int:
+        """Serialize + persist the index snapshot (reference: the Lucene
+        index directory surviving restarts). Returns snapshot bytes."""
+        import time as _time
+        from filodb_tpu.core.memstore.index_snapshot import save_snapshot
+        chunk_token, pk_token = self.column_store.update_tokens(
+            self.dataset, self.shard_num)
+        with self.write_lock:
+            data = save_snapshot(self, chunk_token=chunk_token,
+                                 pk_token=pk_token,
+                                 snapshot_ms=int(_time.time() * 1000))
+        self.column_store.write_index_snapshot(self.dataset, self.shard_num,
+                                               data)
+        return len(data)
 
     # ---- retention -------------------------------------------------------
 
@@ -419,7 +498,7 @@ class TimeSeriesShard:
                 latest = part.latest_ts
                 if latest != -1 and latest < cutoff:
                     self.index.remove_part_key(pid)
-                    del self._by_key[part.part_key]
+                    self._by_key.pop(part.part_key, None)
                     self.partitions[pid] = None
                     if self._native_core is not None:
                         # EVERY partition has a native slot (pid alignment),
@@ -433,7 +512,7 @@ class TimeSeriesShard:
                     purged += 1
         if purged:
             self.stats.partitions_purged.inc(purged)
-            self.stats.num_partitions.set(len(self._by_key))
+            self.stats.num_partitions.set(len(self.index))
         return purged
 
     def evict_partition_chunks(self, part_id: int) -> int:

@@ -69,6 +69,31 @@ class ColumnStore:
         deduplicated instead of double-written."""
         return {}
 
+    # ---- index snapshots (reference: durable Lucene index dir) ----------
+
+    def write_index_snapshot(self, dataset: str, shard: int,
+                             data: bytes) -> None:
+        """Persist an index snapshot (atomic replace)."""
+
+    def read_index_snapshot(self, dataset: str, shard: int) -> bytes | None:
+        return None
+
+    def update_tokens(self, dataset: str, shard: int) -> tuple[int, int]:
+        """(chunk_token, pk_token): monotonic write counters. A snapshot
+        stores the tokens captured BEFORE serialization; restore replays
+        only entries written after them (idempotent overlaps)."""
+        return (-1, -1)
+
+    def max_persisted_ts_since(self, dataset: str, shard: int,
+                               chunk_token: int) -> dict[PartKey, int]:
+        """Delta of max_persisted_ts for chunks written after the token."""
+        return self.max_persisted_ts(dataset, shard)
+
+    def scan_part_keys_since(self, dataset: str, shard: int,
+                             pk_token: int) -> list[PartKeyRecord]:
+        """Part keys created/updated after the token."""
+        return self.scan_part_keys(dataset, shard)
+
 
 class MetaStore:
     """Cluster metadata + ingestion checkpoints."""
@@ -168,6 +193,20 @@ class InMemoryColumnStore(ColumnStore):
         return {pk: max(c.end_time for _, c in entries)
                 for pk, entries in self._chunks[(dataset, shard)].items()
                 if entries}
+
+    def write_index_snapshot(self, dataset, shard, data):
+        if not hasattr(self, "_snapshots"):
+            self._snapshots = {}
+        self._snapshots[(dataset, shard)] = data
+
+    def read_index_snapshot(self, dataset, shard):
+        return getattr(self, "_snapshots", {}).get((dataset, shard))
+
+    def update_tokens(self, dataset, shard):
+        # in-memory double: counts stand in for write counters (chunk and
+        # part-key writes are append-only here)
+        nchunks = sum(len(v) for v in self._chunks[(dataset, shard)].values())
+        return (nchunks, len(self._part_keys[(dataset, shard)]))
 
 
 class InMemoryMetaStore(MetaStore):

@@ -33,6 +33,9 @@ class StoreConfig:
     # route binary containers through the C++ ingest core when possible
     # (scalar-column schemas; falls back per-container otherwise)
     native_ingest: bool = True
+    # persist the part-key index snapshot this often (0 = only on demand);
+    # restart loads the snapshot + delta instead of a full part-key scan
+    index_snapshot_interval_ms: int = 600_000
 
 
 @dataclass(frozen=True)

@@ -74,6 +74,13 @@ class _Db:
                     end_time INTEGER)""")
                 c.execute("""CREATE TABLE IF NOT EXISTS checkpoints (
                     grp INTEGER PRIMARY KEY, offset INTEGER)""")
+                # monotonic write counters for snapshot delta-replay
+                for tbl in ("chunks", "partkeys"):
+                    try:
+                        c.execute(f"ALTER TABLE {tbl} ADD COLUMN upd "
+                                  "INTEGER DEFAULT 0")
+                    except sqlite3.OperationalError:
+                        pass  # column already present
                 self._conns[key] = c
             return c
 
@@ -89,19 +96,40 @@ class LocalDiskColumnStore(ColumnStore):
         self.root = root
         self._db = _Db(root)
         self._wlock = threading.Lock()
+        self._upd: dict[tuple[str, int], int] = {}
 
     def initialize(self, dataset: str, num_shards: int) -> None:
         for s in range(num_shards):
             self._db.conn(dataset, s)
 
+    def _upd_peek(self, c, dataset, shard) -> int:
+        """Current write counter, initializing from the db once (caller
+        holds _wlock)."""
+        key = (dataset, shard)
+        cur = self._upd.get(key)
+        if cur is None:
+            cur = c.execute(
+                "SELECT MAX(m) FROM (SELECT COALESCE(MAX(upd),0) m FROM "
+                "chunks UNION ALL SELECT COALESCE(MAX(upd),0) FROM partkeys)"
+            ).fetchone()[0] or 0
+            self._upd[key] = cur
+        return cur
+
+    def _next_upd(self, c, dataset, shard) -> int:
+        cur = self._upd_peek(c, dataset, shard) + 1
+        self._upd[(dataset, shard)] = cur
+        return cur
+
     def write_chunks(self, dataset, shard, part_key, chunks, ingestion_time):
         c = self._db.conn(dataset, shard)
         blob = _pk_blob(part_key)
         with self._wlock:
+            upd = self._next_upd(c, dataset, shard)
             c.executemany(
-                "INSERT OR IGNORE INTO chunks VALUES (?,?,?,?,?)",
-                [(blob, ch.id, ch.start_time, ch.end_time, ch.serialize())
-                 for ch in chunks])
+                "INSERT OR IGNORE INTO chunks(partition, chunkid, "
+                "start_time, end_time, data, upd) VALUES (?,?,?,?,?,?)",
+                [(blob, ch.id, ch.start_time, ch.end_time, ch.serialize(),
+                  upd) for ch in chunks])
             c.executemany(
                 "INSERT OR IGNORE INTO ingestion_time_index VALUES (?,?,?)",
                 [(blob, ingestion_time, ch.id) for ch in chunks])
@@ -118,12 +146,15 @@ class LocalDiskColumnStore(ColumnStore):
     def write_part_keys(self, dataset, shard, records):
         c = self._db.conn(dataset, shard)
         with self._wlock:
+            upd = self._next_upd(c, dataset, shard)
             for r in records:
                 c.execute(
-                    "INSERT INTO partkeys VALUES (?,?,?) ON CONFLICT(partition)"
+                    "INSERT INTO partkeys(partition, start_time, end_time, "
+                    "upd) VALUES (?,?,?,?) ON CONFLICT(partition)"
                     " DO UPDATE SET start_time=MIN(start_time, excluded."
-                    "start_time), end_time=excluded.end_time",
-                    (_pk_blob(r.part_key), r.start_time, r.end_time))
+                    "start_time), end_time=excluded.end_time, "
+                    "upd=excluded.upd",
+                    (_pk_blob(r.part_key), r.start_time, r.end_time, upd))
             c.commit()
 
     def scan_part_keys(self, dataset, shard):
@@ -174,6 +205,43 @@ class LocalDiskColumnStore(ColumnStore):
             "SELECT partition, MAX(end_time) FROM chunks GROUP BY partition"
         ).fetchall()
         return {_pk_from_blob(b): int(mx) for b, mx in rows}
+
+    def max_persisted_ts_since(self, dataset, shard, chunk_token):
+        c = self._db.conn(dataset, shard)
+        rows = c.execute(
+            "SELECT partition, MAX(end_time) FROM chunks WHERE upd > ? "
+            "GROUP BY partition", (chunk_token,)).fetchall()
+        return {_pk_from_blob(b): int(mx) for b, mx in rows}
+
+    def scan_part_keys_since(self, dataset, shard, pk_token):
+        c = self._db.conn(dataset, shard)
+        rows = c.execute(
+            "SELECT partition, start_time, end_time FROM partkeys "
+            "WHERE upd > ?", (pk_token,)).fetchall()
+        return [PartKeyRecord(_pk_from_blob(b), st, et) for b, st, et in rows]
+
+    def update_tokens(self, dataset, shard):
+        c = self._db.conn(dataset, shard)
+        with self._wlock:
+            cur = self._upd_peek(c, dataset, shard)
+        return (cur, cur)
+
+    def write_index_snapshot(self, dataset, shard, data):
+        d = os.path.join(self.root, dataset)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"index-shard-{shard}.snap")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic: readers never see a partial file
+
+    def read_index_snapshot(self, dataset, shard):
+        path = os.path.join(self.root, dataset, f"index-shard-{shard}.snap")
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     def close(self):
         self._db.close()

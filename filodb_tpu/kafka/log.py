@@ -280,8 +280,15 @@ class SegmentedFileLog(ReplayLog):
             # process appends to, so never skip on latest_offset alone
             if i + 1 < len(segments) and segments[i + 1][0] <= offset:
                 continue
-            for sd in seg.read_from(max(offset - first, 0)):
-                yield SomeData(sd.container, first + sd.offset)
+            try:
+                for sd in seg.read_from(max(offset - first, 0)):
+                    yield SomeData(sd.container, first + sd.offset)
+            except FileNotFoundError:
+                # another process truncated this flushed segment; its
+                # records are below every checkpoint — drop our entry
+                with self._lock:
+                    self._segments = [(f, s) for f, s in self._segments
+                                      if f != first]
 
     @property
     def latest_offset(self) -> int:
@@ -303,9 +310,13 @@ class SegmentedFileLog(ReplayLog):
 
     def truncate_before(self, offset: int) -> int:
         """Delete whole segments entirely below ``offset``. Returns segments
-        removed. The newest segment is always retained."""
-        if self.read_only:
-            return 0
+        removed. The newest segment is always retained.
+
+        Allowed on read-only tailer views too: the shard OWNER drives
+        retention (it knows the checkpoint watermark), and unlinking a
+        wholly-flushed segment file is safe against the appender — the
+        appender only writes to the newest segment, and POSIX keeps its
+        open handles valid."""
         removed = 0
         with self._lock:
             while len(self._segments) > 1:

@@ -102,6 +102,19 @@ def _load():
         lib.shard_core_create_part.argtypes = [vp, u8p, i32,
                                                ctypes.c_uint32, i32]
         lib.shard_core_create_part.restype = i32
+        lib.shard_core_lookup.argtypes = [vp, u8p, i32]
+        lib.shard_core_lookup.restype = i32
+        lib.shard_core_bootstrap.argtypes = [vp, ctypes.c_char_p, i64]
+        lib.shard_core_bootstrap.restype = i64
+        lib.shard_core_seed_floors.argtypes = [vp, ctypes.POINTER(i32), i64p,
+                                               i64]
+        lib.part_floor.argtypes = [vp, i32]
+        lib.part_floor.restype = i64
+        lib.shard_core_floors.argtypes = [vp, i64p, i64]
+        lib.shard_core_export_size.argtypes = [vp]
+        lib.shard_core_export_size.restype = i64
+        lib.shard_core_export.argtypes = [vp, u8p, i64p,
+                                          ctypes.POINTER(i32)]
         lib.shard_core_key_len.argtypes = [vp, i32]
         lib.shard_core_key_len.restype = i32
         lib.shard_core_key_copy.argtypes = [vp, i32, u8p]

@@ -613,6 +613,101 @@ int32_t shard_core_drain_new(void* cp, int32_t* out, int32_t cap) {
     return n;
 }
 
+// O(1) part lookup by canonical key bytes; -1 when absent. Restored shards
+// need no host-language key dictionary — this map is authoritative.
+int32_t shard_core_lookup(void* cp, const uint8_t* key, int32_t key_len) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    c->scratch_key.assign((const char*)key, key_len);
+    auto it = c->by_key.find(c->scratch_key);
+    return it == c->by_key.end() ? -1 : it->second;
+}
+
+// Bulk restore from an index snapshot: entries laid out as
+//   u32 key_len | key bytes | u32 hash | i64 floor | u8 alive | u8 ncols
+// pid == entry ordinal; key_len==0 marks a purged tombstone slot.
+// Returns entries restored, or -1 on a malformed buffer.
+int64_t shard_core_bootstrap(void* cp, const uint8_t* d, int64_t len) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    if (!c->parts.empty()) return -1;  // only into an empty core
+    int64_t off = 0, n = 0;
+    while (off < len) {
+        if (off + 4 > len) return -1;
+        uint32_t kl = rd_u32(d + off);
+        off += 4;
+        if (off + kl + 14 > len) return -1;
+        c->parts.emplace_back();
+        NPart& p = c->parts.back();
+        if (kl) p.key.assign((const char*)d + off, kl);
+        off += kl;
+        p.hash = rd_u32(d + off);
+        p.floor_ts = rd_i64(d + off + 4);
+        p.alive = kl != 0 && d[off + 12] != 0;
+        uint8_t ncols = d[off + 13];
+        off += 14;
+        if (p.alive) {
+            p.cols.resize(ncols ? ncols : 1);
+            c->by_key.emplace(p.key, (int32_t)(c->parts.size() - 1));
+        } else {
+            p.key.clear();
+        }
+        n++;
+    }
+    return n;
+}
+
+int64_t part_floor(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].floor_ts;
+}
+
+// bulk floor export for index snapshots (one call, not one per series)
+void shard_core_floors(void* cp, int64_t* out, int64_t cap) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    int64_t n = (int64_t)c->parts.size();
+    if (n > cap) n = cap;
+    for (int64_t i = 0; i < n; i++) out[i] = c->parts[i].floor_ts;
+}
+
+// snapshot export: the exact bootstrap layout, built in one pass in C++
+// (key_off/key_len let the host slice key blobs without re-parsing)
+int64_t shard_core_export_size(void* cp) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    int64_t total = 0;
+    for (auto& p : c->parts) total += 4 + (int64_t)p.key.size() + 14;
+    return total;
+}
+
+void shard_core_export(void* cp, uint8_t* out, int64_t* key_off,
+                       int32_t* key_len) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    int64_t off = 0;
+    int64_t i = 0;
+    for (auto& p : c->parts) {
+        uint32_t kl = (uint32_t)p.key.size();
+        std::memcpy(out + off, &kl, 4);
+        off += 4;
+        key_off[i] = off;
+        key_len[i] = (int32_t)kl;
+        if (kl) std::memcpy(out + off, p.key.data(), kl);
+        off += kl;
+        std::memcpy(out + off, &p.hash, 4);
+        std::memcpy(out + off + 4, &p.floor_ts, 8);
+        out[off + 12] = p.alive ? 1 : 0;
+        out[off + 13] = (uint8_t)p.cols.size();
+        off += 14;
+        i++;
+    }
+}
+
+// bulk floor seeding (post-bootstrap delta from the column store)
+void shard_core_seed_floors(void* cp, const int32_t* pids,
+                            const int64_t* floors, int64_t n) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    for (int64_t i = 0; i < n; i++) {
+        NPart& p = c->parts[pids[i]];
+        if (floors[i] > p.floor_ts) p.floor_ts = floors[i];
+    }
+}
+
 int32_t shard_core_create_part(void* cp, const uint8_t* key, int32_t key_len,
                                uint32_t hash, int32_t ncols) {
     ShardCore* c = static_cast<ShardCore*>(cp);

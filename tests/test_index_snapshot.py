@@ -1,0 +1,207 @@
+"""Persistent part-key index snapshots (reference PartKeyLuceneIndex
+durability + IndexBootstrapper): snapshot → restart → delta replay."""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.filters import ColumnFilter, Equals
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.memstore.native_shard import native_available
+from filodb_tpu.core.record import BytesContainer, SomeData
+from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.core.store.localstore import (
+    LocalDiskColumnStore,
+    LocalDiskMetaStore,
+)
+from filodb_tpu.testing.data import (
+    gauge_stream,
+    histogram_series,
+    histogram_stream,
+    machine_metrics_series,
+)
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native library unavailable")
+
+
+def bytes_stream(stream, extra_offset=0):
+    for sd in stream:
+        yield SomeData(BytesContainer(sd.container.serialize()),
+                       sd.offset + extra_offset)
+
+
+def small_cfg(**kw):
+    d = dict(max_chunk_size=50, groups_per_shard=2)
+    d.update(kw)
+    return StoreConfig(**d)
+
+
+class TestSnapshotRoundTrip:
+    def build(self, cs, meta, n_series=6, n_samples=40):
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("ds", 0, small_cfg())
+        keys = machine_metrics_series(n_series)
+        for sd in bytes_stream(gauge_stream(keys, n_samples, batch=1)):
+            shard.ingest(sd)
+        shard.flush_all()
+        return ms, shard, keys
+
+    def test_restore_matches_full_rebuild(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        _, shard, keys = self.build(cs, meta)
+        assert shard.snapshot_index() > 0
+
+        # restart via snapshot
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        n = s2.recover_index()
+        assert n == 6
+        assert s2.num_partitions == 6
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
+        pids = s2.lookup_partitions(f, 0, 10**15)
+        assert len(pids) == 6
+        # lazy keys materialize correctly
+        for pid in pids:
+            assert s2.index.part_key(pid) == shard.index.part_key(pid)
+        # floors restored: replaying flushed rows is a no-op
+        s2.setup_watermarks_for_recovery()
+        for sd in bytes_stream(gauge_stream(keys, 40, batch=1)):
+            s2.ingest(sd)
+        total = sum(p.num_samples for p in s2.partitions if p is not None)
+        assert total == 0  # everything below watermark or floor
+
+    def test_delta_partkeys_after_snapshot(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        _, shard, keys = self.build(cs, meta)
+        shard.snapshot_index()
+        # new series and chunks AFTER the snapshot
+        new_keys = machine_metrics_series(2, metric="late_metric")
+        for sd in bytes_stream(gauge_stream(new_keys, 30, batch=1),
+                               extra_offset=10_000):
+            shard.ingest(sd)
+        shard.flush_all()
+
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        n = s2.recover_index()
+        assert n == 8  # 6 from snapshot + 2 delta
+        f = [ColumnFilter("_metric_", Equals("late_metric"))]
+        assert len(s2.lookup_partitions(f, 0, 10**15)) == 2
+        # delta floors: replaying the late chunks doesn't duplicate
+        s2.setup_watermarks_for_recovery()
+        for sd in bytes_stream(gauge_stream(new_keys, 30, batch=1),
+                               extra_offset=10_000):
+            s2.ingest(sd)
+        s2.flush_all()
+        for key in new_keys:
+            chunks = cs.read_chunks("ds", 0, key, 0, 10**15)
+            all_ts = [t for c in chunks for t in c.decode_column(0)]
+            assert len(all_ts) == len(set(all_ts))
+
+    def test_snapshot_with_purged_and_hist_partitions(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("ds", 0, small_cfg(retention_ms=1_000_000))
+        gkeys = machine_metrics_series(3)
+        hkeys = histogram_series(1)
+        for sd in bytes_stream(gauge_stream(gkeys, 10, batch=1)):
+            shard.ingest(sd)
+        for sd in bytes_stream(histogram_stream(hkeys, 10, batch=1),
+                               extra_offset=100):
+            shard.ingest(sd)
+        late = machine_metrics_series(1, metric="fresh")
+        for sd in bytes_stream(gauge_stream(late, 5, batch=1,
+                                            start_ms=10_000_000),
+                               extra_offset=200):
+            shard.ingest(sd)
+        # purge everything old (3 gauges + 1 hist), keep 'fresh'
+        assert shard.purge_expired(now_ms=8_000_000) == 4
+        shard.flush_all()
+        shard.snapshot_index()
+
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg(retention_ms=1_000_000))
+        n = s2.recover_index()
+        assert n == 1
+        f = [ColumnFilter("_metric_", Equals("fresh"))]
+        assert len(s2.lookup_partitions(f, 0, 10**15)) == 1
+        # tombstone pids stay dead; pid numbering is preserved
+        assert s2.partitions[0] is None and s2.partitions[4] is not None
+
+    def test_hist_partition_restored_as_host_backed(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        ms = TimeSeriesMemStore(cs, meta)
+        shard = ms.setup("ds", 0, small_cfg())
+        hkeys = histogram_series(1)
+        for sd in bytes_stream(histogram_stream(hkeys, 10, batch=1)):
+            shard.ingest(sd)
+        shard.flush_all()
+        shard.snapshot_index()
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        assert s2.recover_index() == 1
+        assert type(s2.partitions[0]).__name__ == "TimeSeriesPartition"
+        # ODP still serves the flushed hist chunks through this partition
+        from filodb_tpu.core.memstore.odp import page_partitions
+        extra = page_partitions(s2, [s2.partitions[0]], 0, 10**15,
+                                s2.odp_cache)
+        ts, vals = s2.partitions[0].read_samples(
+            0, 10**15, extra_chunks=extra.get(0))
+        assert len(ts) == 10
+
+    def test_corrupt_snapshot_falls_back_to_scan(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        _, shard, keys = self.build(cs, meta)
+        cs.write_index_snapshot("ds", 0, b"FIDX2garbage-not-a-snapshot")
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        assert s2.recover_index() == 6  # full scan fallback
+
+    def test_cardinality_survives_restore(self, tmp_path):
+        cs = LocalDiskColumnStore(str(tmp_path))
+        meta = LocalDiskMetaStore(str(tmp_path))
+        _, shard, keys = self.build(cs, meta)
+        before = shard.cardinality.cardinality([]).active_ts
+        assert before == 6
+        shard.snapshot_index()
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        s2.recover_index()
+        assert s2.cardinality.cardinality([]).active_ts == 6
+
+    def test_tailer_truncates_flushed_segments(self, tmp_path):
+        # the shard owner (read-only tailer) drives WAL retention on the
+        # shared FS; the appender survives the unlink and both sides skip
+        # the deleted segment afterwards
+        from filodb_tpu.kafka.log import SegmentedFileLog
+        from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+        keys = machine_metrics_series(1)
+        writer = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=4)
+        for sd in gauge_stream(keys, 10, batch=1):
+            writer.append(sd.container)
+        tailer = SegmentedFileLog(str(tmp_path / "wal"), segment_entries=4,
+                                  read_only=True)
+        assert len(list(tailer.read_from(0))) == 10
+        removed = tailer.truncate_before(8)
+        assert removed == 2  # two wholly-flushed segments deleted
+        assert [e.offset for e in tailer.read_from(0)] == [8, 9]
+        # the appender keeps working and skips the deleted files
+        for sd in gauge_stream(keys, 2, batch=1, start_ms=10**9):
+            writer.append(sd.container)
+        assert [e.offset for e in writer.read_from(0)] == [8, 9, 10, 11]
+        writer.close()
+        tailer.close()
+
+    def test_inmemory_store_snapshot(self):
+        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+        _, shard, keys = self.build(cs, meta)
+        shard.snapshot_index()
+        ms2 = TimeSeriesMemStore(cs, meta)
+        s2 = ms2.setup("ds", 0, small_cfg())
+        assert s2.recover_index() == 6
