@@ -19,6 +19,8 @@ DEFAULTS = {
     "data_dir": "./filodb-data",
     "wal_dir": None,
     "wal_fsync": False,           # fsync every WAL append (power-failure safe)
+    "wal_server_port": 0,         # serve this node's WAL over TCP (broker)
+    "wal_remote": None,           # "host:port" — use a remote log server
     "http_port": 8080,
     "gateway_port": 0,            # 0 = disabled
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
@@ -53,6 +55,8 @@ class ServerConfig:
     data_dir: str = "./filodb-data"
     wal_dir: str | None = None  # shared log dir (the "Kafka"); default in data_dir
     wal_fsync: bool = False     # fsync every WAL append (power-failure safe)
+    wal_server_port: int = 0    # serve this node's WAL over TCP (broker)
+    wal_remote: str | None = None  # "host:port" — use a remote log server
     http_port: int = 8080
     gateway_port: int = 0
     executor_port: int = 0
@@ -89,6 +93,8 @@ class ServerConfig:
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],
             wal_dir=cfg.get("wal_dir"),
             wal_fsync=cfg.get("wal_fsync", False),
+            wal_server_port=cfg.get("wal_server_port", 0),
+            wal_remote=cfg.get("wal_remote"),
             http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),

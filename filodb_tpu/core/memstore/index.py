@@ -240,6 +240,22 @@ class PartKeyIndex:
         # the per-label value scan is a sound positive filter
         return self._value_scan_ids(f.column, flt.matches)
 
+    def _label_all_ids(self, col: str) -> np.ndarray:
+        """Every pid that has ANY value for this label."""
+        parts = []
+        fr = self._frozen.get(col)
+        if fr is not None and len(fr.pids):
+            parts.append(np.unique(fr.pids).astype(np.int64))
+        tail = self._tail.get(col)
+        if tail is not None:
+            for s in tail.values():
+                if s:
+                    parts.append(_from_set(s))
+        if not parts:
+            return _EMPTY
+        return np.unique(np.concatenate(parts)) if len(parts) > 1 \
+            else parts[0]
+
     def _all_live_ids(self) -> np.ndarray:
         # live entries have real start bounds (tombstones carry INGESTING) —
         # no key materialization needed
@@ -293,14 +309,22 @@ class PartKeyIndex:
             dead = _from_set(self._deleted)
             result = result[~np.isin(result, dead, assume_unique=True)]
         for f in negatives:
-            # match semantics: absent label == "" for negative/regex filters
-            keep = []
-            for pid in result:
-                key = self.part_key(int(pid))
-                if key is not None and f.filter.matches(
-                        key.label_map.get(f.column, "")):
-                    keep.append(pid)
-            result = np.asarray(keep, np.int64)
+            # match semantics: absent label == "" for negative/regex
+            # filters. Evaluated against the label's VALUE TABLE (frozen +
+            # tail) — never by materializing per-series keys: keep pids
+            # whose value matches, plus pids lacking the label entirely
+            # when the filter matches "".
+            if not len(result):
+                break
+            matched = self._value_scan_ids(f.column, f.filter.matches)
+            keep = result[np.isin(result, matched)] if len(matched) \
+                else result[:0]
+            if f.filter.matches(""):
+                has_label = self._label_all_ids(f.column)
+                absent = result[~np.isin(result, has_label)] \
+                    if len(has_label) else result
+                keep = np.union1d(keep, absent)
+            result = keep
         if not len(result):
             return []
         ok = (self._start[result] <= end_time) & (self._end[result] >= start_time)

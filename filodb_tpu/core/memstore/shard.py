@@ -424,10 +424,14 @@ class TimeSeriesShard:
         return n
 
     def _reset_registry(self) -> None:
-        """Clear partition/index/native state after a failed restore."""
+        """Clear partition/index/native/cardinality state after a failed
+        restore (a partially-loaded tracker would double-count during the
+        full-rebuild fallback)."""
+        from filodb_tpu.core.memstore.cardinality import CardinalityTracker
         self.partitions = []
         self._by_key = {}
         self.index = PartKeyIndex()
+        self.cardinality = CardinalityTracker(self.shard_num)
         if self._native_core is not None:
             from filodb_tpu.core.memstore.native_shard import NativeShardCore
             self._native_core = NativeShardCore(self.config.max_chunk_size,

@@ -319,11 +319,20 @@ class SegmentedFileLog(ReplayLog):
         open handles valid."""
         removed = 0
         with self._lock:
+            self._discover_segments()
             while len(self._segments) > 1:
                 first, seg = self._segments[0]
-                if first + seg.latest_offset < offset:
+                # a segment's true upper bound is the NEXT segment's first
+                # offset — this instance's record counts are stale for
+                # segments another process appends to, so latest_offset
+                # must never decide deletability
+                next_first = self._segments[1][0]
+                if next_first <= offset:
                     seg.close()
-                    os.remove(seg.path)
+                    try:
+                        os.remove(seg.path)
+                    except FileNotFoundError:
+                        pass  # another process already truncated it
                     self._segments.pop(0)
                     removed += 1
                 else:

@@ -1,0 +1,239 @@
+"""Networked ingest log: the Kafka-contract transport.
+
+Counterpart of the reference's Kafka ingestion path
+(``kafka/src/main/scala/filodb/kafka/KafkaIngestionStream.scala:24,63``): one
+log partition == one shard, messages are binary RecordContainer bytes, and
+the gateway and shard owners talk to the log over the NETWORK — no shared
+filesystem. ``LogServer`` fronts a directory of ``SegmentedFileLog``s (the
+"broker"); ``RemoteLog`` implements the ``ReplayLog`` interface over the
+framed, secret-authenticated transport shared with plan shipping
+(``coordinator/remote.py``).
+
+Protocol messages (typed wire codec):
+    ("append", dataset, shard, container_bytes)      -> ("ok", offset)
+    ("read",   dataset, shard, from_offset, max_n)   -> ("ok", [(off, bytes)])
+    ("latest", dataset, shard)                       -> ("ok", offset)
+    ("truncate", dataset, shard, before_offset)      -> ("ok", removed)
+    ("align",  dataset, shard, offset)               -> ("ok", True)
+"""
+
+from __future__ import annotations
+
+import hmac
+import logging
+import os
+import socket
+import socketserver
+import threading
+
+from filodb_tpu.coordinator.remote import (
+    AUTH_FRAME_CAP,
+    _recv_msg,
+    _send_msg,
+    cluster_secret,
+)
+from filodb_tpu.coordinator.wire import MAX_FRAME
+from filodb_tpu.core.record import BytesContainer, RecordContainer, SomeData
+from filodb_tpu.kafka.log import ReplayLog, SegmentedFileLog
+
+log = logging.getLogger(__name__)
+
+
+class LogServer:
+    """Serves a WAL directory over TCP (the broker role)."""
+
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 segment_entries: int = 4096, fsync: bool = False,
+                 secret: str | None = None):
+        self.root = root
+        self.secret = secret if secret is not None else cluster_secret()
+        self._logs: dict[tuple[str, int], SegmentedFileLog] = {}
+        self._lock = threading.Lock()
+        self._segment_entries = segment_entries
+        self._fsync = fsync
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                authed = outer.secret is None
+                try:
+                    while True:
+                        msg = _recv_msg(self.request,
+                                        MAX_FRAME if authed
+                                        else AUTH_FRAME_CAP)
+                        if not authed:
+                            if msg[0] == "auth" and len(msg) == 2 \
+                                    and isinstance(msg[1], str) \
+                                    and hmac.compare_digest(msg[1],
+                                                            outer.secret):
+                                authed = True
+                                _send_msg(self.request, ("ok", True))
+                                continue
+                            _send_msg(self.request, ("err", "auth required"))
+                            return
+                        _send_msg(self.request, outer._handle(msg))
+                except (ConnectionError, EOFError, OSError):
+                    pass
+                except Exception as e:  # pragma: no cover
+                    log.exception("log server request failed")
+                    try:
+                        _send_msg(self.request, ("err", repr(e)))
+                    except Exception:
+                        pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def _log(self, dataset: str, shard: int) -> SegmentedFileLog:
+        key = (dataset, shard)
+        with self._lock:
+            lg = self._logs.get(key)
+            if lg is None:
+                lg = SegmentedFileLog(
+                    os.path.join(self.root, dataset, f"shard-{shard}"),
+                    segment_entries=self._segment_entries,
+                    fsync=self._fsync)
+                self._logs[key] = lg
+            return lg
+
+    def _handle(self, msg):
+        kind = msg[0]
+        try:
+            if kind == "ping":
+                return ("pong",)
+            if kind == "append":
+                _, dataset, shard, raw = msg
+                off = self._log(dataset, shard).append(BytesContainer(raw))
+                return ("ok", off)
+            if kind == "read":
+                _, dataset, shard, from_off, max_n = msg
+                out = []
+                for sd in self._log(dataset, shard).read_from(from_off):
+                    out.append((sd.offset, sd.container.serialize()))
+                    if len(out) >= max_n:
+                        break
+                return ("ok", out)
+            if kind == "latest":
+                _, dataset, shard = msg
+                return ("ok", self._log(dataset, shard).latest_offset)
+            if kind == "truncate":
+                _, dataset, shard, before = msg
+                return ("ok",
+                        self._log(dataset, shard).truncate_before(before))
+            if kind == "align":
+                _, dataset, shard, offset = msg
+                self._log(dataset, shard).align_after(offset)
+                return ("ok", True)
+            return ("err", f"unknown message {kind!r}")
+        except Exception as e:
+            log.exception("log op %s failed", kind)
+            return ("err", repr(e))
+
+    def start(self) -> "LogServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        with self._lock:
+            for lg in self._logs.values():
+                lg.close()
+            self._logs.clear()
+
+
+class RemoteLog(ReplayLog):
+    """``ReplayLog`` over a ``LogServer`` — the KafkaIngestionStream analog:
+    shard owners tail their partition, gateways produce to it, across
+    hosts."""
+
+    def __init__(self, host: str, port: int, dataset: str, shard: int,
+                 timeout: float = 30.0, read_batch: int = 256):
+        self.host = host
+        self.port = port
+        self.dataset = dataset
+        self.shard = shard
+        self.timeout = timeout
+        self.read_batch = read_batch
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            secret = cluster_secret()
+            if secret is not None:
+                _send_msg(s, ("auth", secret))
+                if _recv_msg(s)[0] != "ok":
+                    s.close()
+                    raise ConnectionError("log server auth rejected")
+            self._sock = s
+        return self._sock
+
+    def _call(self, *msg):
+        with self._lock:
+            try:
+                sock = self._conn()
+                _send_msg(sock, msg)
+                resp = _recv_msg(sock)
+            except (ConnectionError, OSError):
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+        if resp[0] == "ok":
+            return resp[1]
+        if resp[0] == "pong":
+            return True
+        raise RuntimeError(f"log op failed: {resp[1]}")
+
+    def append(self, container: RecordContainer) -> int:
+        return self._call("append", self.dataset, self.shard,
+                          container.serialize())
+
+    def read_from(self, offset: int):
+        cur = max(offset, 0)
+        while True:
+            batch = self._call("read", self.dataset, self.shard, cur,
+                               self.read_batch)
+            for off, raw in batch:
+                yield SomeData(BytesContainer(raw), off)
+                cur = off + 1
+            if len(batch) < self.read_batch:
+                return
+
+    @property
+    def latest_offset(self) -> int:
+        return self._call("latest", self.dataset, self.shard)
+
+    def truncate_before(self, offset: int) -> int:
+        return self._call("truncate", self.dataset, self.shard, offset)
+
+    def align_after(self, offset: int) -> None:
+        self._call("align", self.dataset, self.shard, offset)
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._call("ping"))
+        except (ConnectionError, OSError, RuntimeError):
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

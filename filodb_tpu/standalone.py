@@ -51,16 +51,24 @@ class FiloServer:
                                                    "wal")
         return os.path.join(root, dataset, f"shard-{shard}")
 
-    def _shard_log(self, dataset: str, shard: int) -> SegmentedFileLog:
+    def _shard_log(self, dataset: str, shard: int):
         key = (dataset, shard)
         if key not in self.logs:
-            # members tail segments the gateway host appends to on the
-            # shared wal_dir: their view must be read-only (an append-mode
-            # open would run torn-tail recovery against a live file)
-            tailer = bool(self.config.seeds) and not self.config.gateway_port
-            self.logs[key] = SegmentedFileLog(
-                self._wal_path(dataset, shard),
-                fsync=self.config.wal_fsync, read_only=tailer)
+            if self.config.wal_remote:
+                # networked log (the Kafka contract): no shared FS needed
+                from filodb_tpu.kafka.log_server import RemoteLog
+                host, port = self.config.wal_remote.rsplit(":", 1)
+                self.logs[key] = RemoteLog(host, int(port), dataset, shard)
+            else:
+                # members tail segments the gateway host appends to on the
+                # shared wal_dir: their view must be read-only (an
+                # append-mode open would run torn-tail recovery against a
+                # live file)
+                tailer = bool(self.config.seeds) \
+                    and not self.config.gateway_port
+                self.logs[key] = SegmentedFileLog(
+                    self._wal_path(dataset, shard),
+                    fsync=self.config.wal_fsync, read_only=tailer)
         return self.logs[key]
 
     # -- control handlers (member side; reference NodeCoordinatorActor) --
@@ -102,6 +110,17 @@ class FiloServer:
 
     def start(self) -> "FiloServer":
         cfg = self.config
+        if cfg.wal_server_port:
+            # broker role: serve this node's WAL dir over TCP (reference
+            # Kafka broker analog)
+            from filodb_tpu.kafka.log_server import LogServer
+            root = cfg.wal_dir or os.path.join(cfg.data_dir, "wal")
+            self.log_server = LogServer(root,
+                                        port=cfg.wal_server_port).start()
+            if not cfg.wal_remote:
+                # the broker's own shards go through the server too — one
+                # owner per log file
+                cfg.wal_remote = f"127.0.0.1:{self.log_server.port}"
         # control/executor port: plan shipping + shard lifecycle messages
         self.executor = PlanExecutorServer(
             self.memstore, port=cfg.executor_port,
@@ -355,6 +374,8 @@ class FiloServer:
         self.cluster.stop()
         for l in self.logs.values():
             l.close()
+        if getattr(self, "log_server", None) is not None:
+            self.log_server.stop()  # broker role: port, thread, open logs
         self.column_store.close()
         self.meta_store.close()
 
