@@ -267,11 +267,25 @@ def main():
     qps = run_queries(svc, N_QUERIES, start_sec, end_sec)
     baseline = naive_baseline_qps(svc, start_sec, end_sec)
 
+    # Honest reference comparison: the JVM reference cannot run in this
+    # image (no JVM/sbt, zero egress), so alongside the measured
+    # naive-python ratio we publish an ESTIMATE of the reference engine's
+    # throughput on this workload, derived in BENCH_LOCAL.md ("Honest
+    # baseline"): decode-aware chunked iteration at 10-50ns/sample over
+    # ~72k samples/query -> ~280-1400 q/s single-threaded JVM.
+    ref_lo, ref_hi = 280, 1400
     print(json.dumps({
         "metric": "promql_sum_rate_range_query_throughput",
         "value": round(qps, 2),
         "unit": "queries/sec",
         "vs_baseline": round(qps / baseline, 2),
+        "baseline_note": ("vs_baseline = measured ratio against naive "
+                          "per-sample numpy/python iteration; see "
+                          "reference_jvm_estimated_qps for the JVM-engine "
+                          "estimate (BENCH_LOCAL.md)"),
+        "reference_jvm_estimated_qps": [ref_lo, ref_hi],
+        "vs_reference_estimate": [round(qps / ref_hi, 2),
+                                  round(qps / ref_lo, 2)],
         "platform": platform,
         "probe": probe_log,
         "kernel_microbench": micro,

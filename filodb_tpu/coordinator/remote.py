@@ -35,6 +35,43 @@ def cluster_secret() -> str | None:
     return os.environ.get("FILODB_CLUSTER_SECRET") or None
 
 
+def make_authed_handler(get_secret, handle, log_label: str):
+    """Build a socketserver handler enforcing the framed auth protocol:
+    pre-auth frames capped at AUTH_FRAME_CAP, ("auth", secret) handshake
+    via constant-time compare, connection dropped on failure. ``handle``
+    maps a decoded message to a response tuple. Shared by the plan
+    executor and the log server so the protocol cannot drift."""
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            secret = get_secret()
+            authed = secret is None
+            try:
+                while True:
+                    msg = _recv_msg(self.request,
+                                    MAX_FRAME if authed else AUTH_FRAME_CAP)
+                    if not authed:
+                        if msg[0] == "auth" and len(msg) == 2 \
+                                and isinstance(msg[1], str) \
+                                and hmac.compare_digest(msg[1], secret):
+                            authed = True
+                            _send_msg(self.request, ("ok", True))
+                            continue
+                        _send_msg(self.request, ("err", "auth required"))
+                        return  # drop the unauthenticated connection
+                    _send_msg(self.request, handle(msg))
+            except (ConnectionError, EOFError, OSError):
+                pass
+            except Exception as e:  # pragma: no cover
+                log.exception("%s request failed", log_label)
+                try:
+                    _send_msg(self.request, ("err", repr(e)))
+                except Exception:
+                    pass
+
+    return Handler
+
+
 def _send_msg(sock: socket.socket, obj) -> None:
     payload = encode(obj)
     if len(payload) > MAX_FRAME:
@@ -75,37 +112,8 @@ class PlanExecutorServer:
         # (join/start_shard/shard_status... registered by the server runtime)
         self.extra_handlers = extra_handlers or {}
         self.secret = secret if secret is not None else cluster_secret()
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                authed = outer.secret is None
-                try:
-                    while True:
-                        # unauthenticated peers get a tiny frame budget: no
-                        # 256MB parse work before the secret check
-                        msg = _recv_msg(self.request,
-                                        MAX_FRAME if authed
-                                        else AUTH_FRAME_CAP)
-                        if not authed:
-                            if msg[0] == "auth" and len(msg) == 2 \
-                                    and isinstance(msg[1], str) \
-                                    and hmac.compare_digest(msg[1],
-                                                            outer.secret):
-                                authed = True
-                                _send_msg(self.request, ("ok", True))
-                                continue
-                            _send_msg(self.request, ("err", "auth required"))
-                            return  # drop the unauthenticated connection
-                        _send_msg(self.request, outer._handle(msg))
-                except (ConnectionError, EOFError):
-                    pass
-                except Exception as e:  # pragma: no cover
-                    log.exception("remote exec failed")
-                    try:
-                        _send_msg(self.request, ("err", repr(e)))
-                    except Exception:
-                        pass
+        Handler = make_authed_handler(lambda: self.secret, self._handle,
+                                      "remote exec")
 
         class Server(socketserver.ThreadingTCPServer):
             # fixed executor ports must rebind across fast restarts

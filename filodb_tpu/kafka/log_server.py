@@ -19,7 +19,6 @@ Protocol messages (typed wire codec):
 
 from __future__ import annotations
 
-import hmac
 import logging
 import os
 import socket
@@ -27,12 +26,11 @@ import socketserver
 import threading
 
 from filodb_tpu.coordinator.remote import (
-    AUTH_FRAME_CAP,
     _recv_msg,
     _send_msg,
     cluster_secret,
+    make_authed_handler,
 )
-from filodb_tpu.coordinator.wire import MAX_FRAME
 from filodb_tpu.core.record import BytesContainer, RecordContainer, SomeData
 from filodb_tpu.kafka.log import ReplayLog, SegmentedFileLog
 
@@ -51,35 +49,8 @@ class LogServer:
         self._lock = threading.Lock()
         self._segment_entries = segment_entries
         self._fsync = fsync
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self):
-                authed = outer.secret is None
-                try:
-                    while True:
-                        msg = _recv_msg(self.request,
-                                        MAX_FRAME if authed
-                                        else AUTH_FRAME_CAP)
-                        if not authed:
-                            if msg[0] == "auth" and len(msg) == 2 \
-                                    and isinstance(msg[1], str) \
-                                    and hmac.compare_digest(msg[1],
-                                                            outer.secret):
-                                authed = True
-                                _send_msg(self.request, ("ok", True))
-                                continue
-                            _send_msg(self.request, ("err", "auth required"))
-                            return
-                        _send_msg(self.request, outer._handle(msg))
-                except (ConnectionError, EOFError, OSError):
-                    pass
-                except Exception as e:  # pragma: no cover
-                    log.exception("log server request failed")
-                    try:
-                        _send_msg(self.request, ("err", repr(e)))
-                    except Exception:
-                        pass
+        Handler = make_authed_handler(lambda: self.secret, self._handle,
+                                      "log server")
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -51,9 +51,9 @@ _TOKEN_RE = re.compile(r"""
       (?P<WS>\s+)
     | (?P<COMMENT>\#[^\n]*)
     | (?P<DURATION>[0-9]+(?:\.[0-9]+)?(?:ms|s|m|h|d|w|y|i)(?:[0-9]+(?:ms|s|m|h|d|w|y))*)
-    | (?P<NUMBER>0x[0-9a-fA-F]+|(?:[0-9]*\.[0-9]+|[0-9]+\.?)(?:[eE][+-]?[0-9]+)?|[Ii][Nn][Ff]|[Nn][Aa][Nn])
+    | (?P<NUMBER>0x[0-9a-fA-F]+|(?:[0-9]*\.[0-9]+|[0-9]+\.?)(?:[eE][+-]?[0-9]+)?|[Ii][Nn][Ff](?![a-zA-Z0-9_:])|[Nn][Aa][Nn](?![a-zA-Z0-9_:]))
     | (?P<IDENT>[a-zA-Z_][a-zA-Z0-9_:]*)
-    | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*')
+    | (?P<STRING>"(?:\\.|[^"\\])*"|'(?:\\.|[^'\\])*'|`[^`]*`)
     | (?P<OP>=~|!~|==|!=|<=|>=|<|>|=|\+|-|\*|/|%|\^|\(|\)|\{|\}|\[|\]|,|:|@)
 """, re.VERBOSE)
 
@@ -104,6 +104,8 @@ def parse_duration_ms(text: str, step_ms: int = 0) -> int:
 
 def _unquote(s: str) -> str:
     body = s[1:-1]
+    if s[0] == "`":
+        return body  # raw string: no escape processing (PromQL backticks)
     return (body.replace("\\\\", "\x00").replace('\\"', '"')
             .replace("\\'", "'").replace("\\n", "\n").replace("\\t", "\t")
             .replace("\x00", "\\"))

@@ -76,10 +76,12 @@ def topk_mask(values, group_ids, num_groups: int, k: int, bottom: bool = False):
     def per_group(g):
         vg = jnp.where(group_ids[:, None] == g, masked_all, -jnp.inf)  # [P, K]
         kk = min(k, vg.shape[0])
-        top = jax.lax.top_k(vg.T, kk)[0]  # [K, kk] descending
-        thr = top[:, kk - 1]  # k-th largest per step
-        sel = (vg >= thr[None, :]) & jnp.isfinite(vg)
-        return sel
+        # select by INDEX, not threshold: Prometheus returns exactly k
+        # series even on ties (tie-break arbitrary; here lowest index)
+        vals, idx = jax.lax.top_k(vg.T, kk)  # [K, kk]
+        finite = jnp.isfinite(vals)  # drop -inf fillers (NaN/out-of-group)
+        onehot = jax.nn.one_hot(idx, vg.shape[0], dtype=bool)  # [K, kk, P]
+        return jnp.any(onehot & finite[..., None], axis=1).T  # [P, K]
 
     sels = jax.vmap(per_group)(jnp.arange(num_groups))  # [G, P, K]
     return jnp.any(sels, axis=0)

@@ -30,6 +30,12 @@ def _dur(ms: int) -> str:
     return f"{ms}ms"
 
 
+def _q(v: str) -> str:
+    """Quote a label value/pattern as re-parseable PromQL."""
+    return '"' + v.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n").replace("\t", "\\t") + '"'
+
+
 def _selector(filters, column=None) -> str:
     metric = ""
     matchers = []
@@ -39,16 +45,19 @@ def _selector(filters, column=None) -> str:
             metric = flt.value
             continue
         if isinstance(flt, Equals):
-            matchers.append(f'{f.column}="{flt.value}"')
+            matchers.append(f'{f.column}={_q(flt.value)}')
         elif isinstance(flt, NotEquals):
-            matchers.append(f'{f.column}!="{flt.value}"')
+            matchers.append(f'{f.column}!={_q(flt.value)}')
         elif isinstance(flt, EqualsRegex):
-            matchers.append(f'{f.column}=~"{flt.pattern}"')
+            matchers.append(f'{f.column}=~{_q(flt.pattern)}')
         elif isinstance(flt, NotEqualsRegex):
-            matchers.append(f'{f.column}!~"{flt.pattern}"')
+            matchers.append(f'{f.column}!~{_q(flt.pattern)}')
         elif isinstance(flt, In):
-            vals = "|".join(sorted(flt.values))
-            matchers.append(f'{f.column}=~"{vals}"')
+            import re as _re
+            # regex-escape each value: the rendered =~ must match the
+            # literal strings, not treat '.' or '|' inside them as regex
+            vals = "|".join(_re.escape(v) for v in sorted(flt.values))
+            matchers.append(f'{f.column}=~{_q(vals)}')
     body = metric
     if column:
         body += f"::{column}"

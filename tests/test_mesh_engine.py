@@ -174,6 +174,19 @@ class TestMeshParity:
             svc.query_range('sum(rate(x[5m]))', START, 60, START + 600)
         assert not called
 
+    def test_topk_wrapper_on_mesh(self, counter_store):
+        e, m = services(counter_store)
+        query = 'topk(2, sum(rate(http_requests_total[5m])) by (instance))'
+        re, rm = self.q(e, query), self.q(m, query)
+        assert_same(re, rm)
+        # the mesh path actually engaged (not the exec fallback)
+        hits = []
+        orig = m.mesh_engine.execute
+        m.mesh_engine.execute = lambda *a, **kw: (hits.append(1),
+                                                  orig(*a, **kw))[1]
+        self.q(m, query)
+        assert hits
+
     def test_ring_variant_parity(self, counter_store):
         from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
         e, m = services(counter_store)

@@ -160,11 +160,186 @@ VALID = [
     'histogram_quantile(0.9, sum(rate(b[5m])) by (le, job))',
     'topk(3, sum(rate(a[1m])) by (x)) + on (x) bottomk(3, b)',
     'ceil(abs(sum(rate(foo[5m]))))',
-    'clamp(sum by (a) (rate(m[5m])), 0, scalar(max(cap)))'
-    if False else 'clamp(sum by (a) (rate(m[5m])), 0, 10)',
+    'clamp(sum by (a) (rate(m[5m])), 0, 10)',
     # step-multiple durations (filodb extension)
     'rate(foo[5i])',
     'sum_over_time(foo[2i])',
+    # --- round-2 expansion toward ParserSpec breadth -------------------
+    # selector spellings
+    'foo{bar="baz", quux="nerf"}',
+    'foo{bar="baz",}',
+    "foo{bar='baz'}",
+    'foo{bar=`baz`}',
+    '{job="api", __name__="m"}',
+    'foo{label="value with spaces"}',
+    'foo{label="esc\\"aped"}',
+    'foo{label="tab\\tnewline\\n"}',
+    'foo{label=""}',
+    'foo{label!=""}',
+    'foo{label=~""}',
+    'a_metric_with_a_very_long_name_0123456789',
+    'nan_metric',
+    'inf_metric',
+    'foo{on="x"}',
+    'foo{and="x"}',
+    'foo{or="x"}',
+    'foo{unless="x"}',
+    'foo{group_left="x"}',
+    'foo{bool="x"}',
+    'foo{offset="x"}',
+    # durations
+    'foo offset 0s',
+    'foo offset 30s',
+    'foo offset 90m',
+    'foo offset 2d',
+    'foo offset 3w',
+    'foo offset 1y',
+    'rate(foo[90s])',
+    'rate(foo[1h30m])',
+    'rate(foo[1d1h])',
+    'rate(foo[1w1d])',
+    'avg_over_time(foo[2w])',
+    'sum_over_time(foo[1y])',
+    # @ modifier
+    'foo @ 1609746000',
+    'foo @ 1609746000.123',
+    'foo offset 5m @ 1609746000',
+    'foo @ 1609746000 offset 5m',
+    'rate(foo[5m] @ 1609746000)',
+    'sum(foo @ 1609746000)',
+    'max_over_time(rate(foo[1m])[30m:1m] @ 1609746000)',
+    # arithmetic with scalars on both sides
+    '1 + foo',
+    'foo - 1',
+    '1 - foo',
+    '10 / foo',
+    'foo ^ 2 ^ 3',
+    '2 ^ -1',
+    '-(foo)',
+    '-sum(foo)',
+    '+foo',
+    '(((foo)))',
+    '((foo + bar))',
+    # comparison + bool
+    'foo != bool bar',
+    'foo >= bool 0.5',
+    'foo <= bool bar',
+    'foo < bool 1e3',
+    '1 == bool 1',
+    # scientific / numeric literal forms
+    '1e4',
+    '1.5e-3',
+    '2E5 * foo',
+    '0.0001 + foo',
+    # vector matching variants
+    'foo + on (a, b) bar',
+    'foo + ignoring (a, b) bar',
+    'foo * on (a) group_left (c, d) bar',
+    'foo * on (a) group_right (c) bar',
+    'foo * on () bar',
+    'foo and on (job) bar',
+    'foo or on (job) bar',
+    'foo unless on (job) bar',
+    'foo and ignoring (x) bar',
+    'foo or ignoring () bar',
+    'a + on (x) b + on (y) c',
+    # aggregation spellings
+    'sum (foo)',
+    'sum by () (foo)',
+    'sum without () (foo)',
+    'sum(foo)',
+    'avg by (a) (rate(foo[5m]))',
+    'count without (a, b) (foo)',
+    'topk(1, foo)',
+    'topk(10, rate(foo[1m]))',
+    'bottomk(2, foo) by (job)',
+    'topk(5, foo) without (instance)',
+    'quantile(0.5, rate(foo[5m]))',
+    'quantile(0.999, foo) by (le)',
+    'count_values("code", http_requests)',
+    'stddev by (job) (foo)',
+    'stdvar without (x) (foo)',
+    'group by (job) (foo)',
+    # range + instant function nesting
+    'rate(sum_metric_bucket[5m])',
+    'irate(foo{job="x"}[30s])',
+    'increase(foo[1i])',
+    'resets(counter_total[1h])',
+    'deriv(gauge_metric[10m])',
+    'predict_linear(gauge_metric[1h], 14400)',
+    'holt_winters(foo[10m], 0.5, 0.5)',
+    'quantile_over_time(0.25, foo{a="b"}[10m])',
+    'absent_over_time(foo[10m])',
+    'present_over_time(foo{job="x"}[1h])',
+    'avg_over_time(max_over_time(foo[5m])[30m:5m])',
+    'ceil(rate(foo[5m]))',
+    'abs(delta(gauge[1h]))',
+    'sqrt(sum(foo))',
+    'exp(ln(foo))',
+    'clamp_min(clamp_max(foo, 10), 1)',
+    'round(foo, 5)',
+    'round(rate(foo[5m]), 0.001)',
+    # histogram pipelines
+    'histogram_quantile(0.5, req_bucket)',
+    'histogram_quantile(0.95, sum by (le) (rate(req_bucket[5m])))',
+    'histogram_quantile(0.9, sum(rate(b[5m])) without (instance))',
+    'sum(histogram_quantile(0.99, rate(b[5m]))) by (job)',
+    # label manipulation
+    'label_replace(foo, "a", "$0", "b", ".*")',
+    'label_replace(rate(foo[5m]), "x", "$1-$2", "y", "(.)-(.)")',
+    'label_join(foo, "dst", ",", "a")',
+    'label_join(foo, "dst", "", "a", "b", "c")',
+    'sort(sum by (a) (foo))',
+    'sort_desc(rate(foo[5m]))',
+    # scalar/vector conversions
+    'scalar(sum(foo))',
+    'vector(0)',
+    'vector(scalar(foo))',
+    'scalar(foo) * scalar(bar)',
+    'time() - foo',
+    'foo - time()',
+    'year()',
+    'month()',
+    'minute()',
+    'hour()',
+    # absent family
+    'absent(foo{a="b", c="d"})',
+    'absent(rate(foo[5m]))',
+    'absent_over_time(foo{x="y"}[30m])',
+    # subquery depth
+    'max_over_time(rate(foo[1m])[1h:])',
+    'min_over_time(rate(foo[1m])[1h:30s])',
+    'avg_over_time(sum by (a) (rate(m[5m]))[30m:1m])',
+    'sum_over_time(avg_over_time(foo[5m])[30m:5m])',
+    'max_over_time(max_over_time(max_over_time(m[1m])[5m:1m])[15m:5m])',
+    'rate(foo[5m:30s])',
+    'last_over_time(foo[10m:1m])',
+    'quantile_over_time(0.9, rate(foo[1m])[10m:1m])',
+    'max_over_time(rate(foo[1m] offset 5m)[30m:1m])',
+    'avg_over_time(foo[1h:5m] offset 30m)',
+    # keyword-ish metric names
+    'rate_total',
+    'sum_total',
+    'avg_metric',
+    'min_max_gauge',
+    'bool_metric',
+    # deep expressions
+    '(a + b) / (c + d)',
+    '(a / b) or (c / d)',
+    'a unless (b and c)',
+    '((a or b) and c) unless d',
+    'sum(rate(a[5m])) / sum(rate(b[5m])) > bool 0.1',
+    'max(a) - min(a)',
+    'avg(a) + stddev(a) * 2',
+    'topk(5, a / b)',
+    'sum(a) by (x, y) + on (x) group_left sum(b) by (x)',
+    'histogram_quantile(0.99, sum(rate(lat_bucket{svc="s"}[5m])) by (le))'
+    ' > 0.5',
+    'clamp(a, 1, 2)',
+    # comments & whitespace tolerance
+    'foo # trailing comment',
+    '  foo  +  bar  ',
+    'sum(\n  rate(foo[5m])\n) by (job)',
 ]
 
 INVALID = [
@@ -192,6 +367,11 @@ ROUND_TRIP_SKIP = {
     '1', '2.5', '.5 * 4', '0x1F + 1', 'Inf', 'NaN', '-1 ^ 2', '5 % 2',
     '1 + 2 * 3 - 4 / 2', '-foo', 'timestamp(foo)', 'foo{}',
     'quantile_over_time(0.5, foo[1h:])',
+    # normalizations: quote style, __name__ promotion, float @ precision,
+    # scalar folds, absent_over_time lowering
+    'foo{bar=`baz`}', '{job="api", __name__="m"}',
+    'foo @ 1609746000.123', '2 ^ -1', '1 == bool 1',
+    'absent_over_time(foo[10m])', 'absent_over_time(foo{x="y"}[30m])',
 }
 
 

@@ -278,6 +278,73 @@ class TestInstantAndMisc:
         # max over subquery >= direct rate at aligned steps
         assert np.nanmax(r.result.values) > 0
 
+    def test_subquery_semantics_vs_direct(self, gauge_svc):
+        # avg_over_time(g[10m:INTERVAL]) samples every raw point, so it must
+        # closely track avg_over_time(g[10m]) at the same steps
+        svc, _ = gauge_svc
+        sub = svc.query_range('avg_over_time(heap_usage[10m:10s])',
+                              START + 3600, 300, START + 4500)
+        direct = svc.query_range('avg_over_time(heap_usage[10m])',
+                                 START + 3600, 300, START + 4500)
+        assert sub.result.num_series == direct.result.num_series == 10
+        os_ = np.argsort([str(k) for k in sub.result.keys])
+        od = np.argsort([str(k) for k in direct.result.keys])
+        np.testing.assert_allclose(sub.result.values[os_],
+                                   direct.result.values[od], rtol=5e-2)
+
+    def test_nested_subquery(self, gauge_svc):
+        # the subquery evaluates the inner expression on its own aligned
+        # grid; the outer max at T covers grid points in (T-20m, T]
+        svc, _ = gauge_svc
+        r = svc.query_range(
+            'max_over_time(max_over_time(heap_usage[5m])[20m:5m])',
+            START + 3600, 300, START + 4500)
+        assert r.result.num_series == 10
+        sub_step = 300
+        g_start = ((START + 3600 - 1200) // sub_step) * sub_step
+        g_end = ((START + 4500) // sub_step) * sub_step
+        grid = svc.query_range('max_over_time(heap_usage[5m])',
+                               g_start, sub_step, g_end)
+        og = np.argsort([str(k) for k in grid.result.keys])
+        orr = np.argsort([str(k) for k in r.result.keys])
+        gv = grid.result.values[og]
+        gt = grid.result.steps_ms
+        for ks, t_ms in enumerate(r.result.steps_ms):
+            sel = (gt > t_ms - 1_200_000) & (gt <= t_ms)
+            expect = np.max(gv[:, sel], axis=1)
+            np.testing.assert_allclose(r.result.values[orr][:, ks], expect,
+                                       rtol=1e-9)
+
+    def test_subquery_with_offset_inside(self, counter_svc):
+        # offset applies to the inner selector; the subquery result at T
+        # equals the un-offset subquery at T-5m
+        svc, _ = counter_svc
+        off = svc.query_range(
+            'max_over_time(rate(http_requests_total[1m] offset 5m)[10m:1m])',
+            START + 3900, 300, START + 4500)
+        plain = svc.query_range(
+            'max_over_time(rate(http_requests_total[1m])[10m:1m])',
+            START + 3600, 300, START + 4200)
+        assert off.result.num_series == plain.result.num_series == 6
+        oo = np.argsort([str(k) for k in off.result.keys])
+        op = np.argsort([str(k) for k in plain.result.keys])
+        np.testing.assert_allclose(off.result.values[oo],
+                                   plain.result.values[op],
+                                   rtol=1e-5, equal_nan=True)
+
+    def test_subquery_offset_outside(self, gauge_svc):
+        svc, _ = gauge_svc
+        r = svc.query_range('avg_over_time(heap_usage[10m:1m] offset 10m)',
+                            START + 3600, 300, START + 4200)
+        plain = svc.query_range('avg_over_time(heap_usage[10m:1m])',
+                                START + 3000, 300, START + 3600)
+        assert r.result.num_series == 10
+        orr = np.argsort([str(k) for k in r.result.keys])
+        op = np.argsort([str(k) for k in plain.result.keys])
+        np.testing.assert_allclose(r.result.values[orr],
+                                   plain.result.values[op],
+                                   rtol=1e-6, equal_nan=True)
+
 
 class TestLimitsAndMetadata:
     def test_sample_limit(self, gauge_svc):
