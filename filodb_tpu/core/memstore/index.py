@@ -109,7 +109,9 @@ def _from_set(s: set[int]) -> np.ndarray:
 class PartKeyIndex:
     """Tag index for one shard."""
 
-    def __init__(self):
+    def __init__(self, schemas=None):
+        # schema registry for lazy blob -> PartKey materialization
+        self._schemas = schemas
         # tail tier: label -> value -> set of partIds (new since freeze)
         self._tail: dict[str, dict[str, set[int]]] = defaultdict(
             lambda: defaultdict(set)
@@ -124,7 +126,6 @@ class PartKeyIndex:
         self._start: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
         self._end: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
         self._count = 0
-        self._schemas = None  # set on snapshot restore (blob -> PartKey)
 
     def __len__(self) -> int:
         return self._count
@@ -149,6 +150,15 @@ class PartKeyIndex:
         self._deleted.discard(part_id)
         for name, value in key.labels:
             self._tail[name][value].add(part_id)
+
+    def add_part_key_blob(self, part_id: int, key: PartKey, blob: bytes,
+                          start_time: int,
+                          end_time: int = INGESTING) -> None:
+        """Register postings from ``key`` but keep only the canonical blob
+        in the key table (materialized lazily on demand): at high
+        cardinality per-series PartKey objects dominate resident memory."""
+        self.add_part_key(part_id, key, start_time, end_time)
+        self._part_keys[part_id] = blob
 
     def remove_part_key(self, part_id: int) -> None:
         key = self.part_key(part_id)

@@ -70,7 +70,7 @@ class TimeSeriesShard:
 
         self.partitions: list[TimeSeriesPartition | None] = []
         self._by_key: dict[PartKey, int] = {}
-        self.index = PartKeyIndex()
+        self.index = PartKeyIndex(schemas)
         # per-group recovery watermarks: ingest offsets <= watermark are skipped
         self.group_watermarks: list[int] = [-1] * store_config.groups_per_shard
         self._dirty_part_keys: set[int] = set()
@@ -222,29 +222,31 @@ class TimeSeriesShard:
         )
         core = self._native_core
         for pid in core.drain_new_parts():
-            key = part_key_from_blob(core.key_blob(pid), self.schemas)
+            blob = core.key_blob(pid)
+            key = part_key_from_blob(blob, self.schemas)
             # seed the hash from the container record: group_of/flush would
-            # otherwise recompute it — re-materializing the serialized blob
-            # the pops below exist to avoid
+            # otherwise recompute it via the serialized blob
             key.__dict__["part_hash"] = core.part_hash(pid)
-            schema = self.schemas[key.schema]
-            part = NativeBackedPartition(core, pid, key, schema,
-                                         self.config.max_chunk_size,
-                                         self.shard_num)
+            # the wrapper stays blob-backed: the transient PartKey above is
+            # only needed for registration and is dropped afterwards — at
+            # 1M series, per-key PartKey objects (labels tuple + __dict__
+            # caches) dominate resident memory; the C++ key map is the
+            # authoritative lookup
+            part = NativeBackedPartition(core, pid,
+                                         max_chunk_size=self.config
+                                         .max_chunk_size,
+                                         shard=self.shard_num,
+                                         key_blob=blob,
+                                         schemas=self.schemas)
             assert pid == len(self.partitions), (pid, len(self.partitions))
             floor = self._persisted_floors.get(key)
             if floor is not None:
                 part.seed_dedup_floor(floor)
             self.partitions.append(part)
-            self._by_key[key] = pid
             self.cardinality.series_created(key.label_map)
-            self.index.add_part_key(pid, key, part.first_ts)
+            self.index.add_part_key_blob(pid, key, blob, part.first_ts)
             self._dirty_part_keys.add(pid)
             self.stats.partitions_created.inc()
-            # drop per-key caches materialized above: at 1M series the
-            # label_map dict + serialized bytes dominate resident memory
-            key.__dict__.pop("label_map", None)
-            key.__dict__.pop("serialized", None)
         self.stats.num_partitions.set(len(self.index))
 
     def _ingest_native(self, raw: bytes, offset: int) -> int:
@@ -430,7 +432,7 @@ class TimeSeriesShard:
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
         self.partitions = []
         self._by_key = {}
-        self.index = PartKeyIndex()
+        self.index = PartKeyIndex(self.schemas)
         self.cardinality = CardinalityTracker(self.shard_num)
         if self._native_core is not None:
             from filodb_tpu.core.memstore.native_shard import NativeShardCore
