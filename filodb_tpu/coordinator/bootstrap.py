@@ -129,6 +129,41 @@ class RemoteNodeHandle:
         pass
 
 
+class ShardUpdateSubscriber:
+    """Member-side mirror of the coordinator's shard map (reference
+    ``StatusActor`` subscriber with ack/resync): polls the sequenced event
+    feed, applying deltas to a local ``ShardMapper``; a feed gap triggers a
+    full-snapshot resync. The member acks implicitly with its next poll's
+    ``since_seq``."""
+
+    def __init__(self, dataset: str, num_shards: int, dispatcher):
+        from filodb_tpu.coordinator.shardmapper import ShardMapper
+        self.dataset = dataset
+        self.dispatcher = dispatcher
+        self.mapper = ShardMapper(num_shards)
+        self.last_seq = 0
+        self.resyncs = 0
+
+    def poll(self) -> int:
+        """One poll cycle; returns events applied."""
+        from filodb_tpu.coordinator.shardmapper import (
+            ShardEvent,
+            ShardMapper,
+            ShardStatus,
+        )
+        events, seq, resynced = self.dispatcher.call(
+            "shard_events", self.dataset, self.last_seq)
+        if resynced:
+            self.mapper = ShardMapper(self.mapper.num_shards)
+            self.resyncs += 1
+        for shard, status_name, node, progress in events:
+            self.mapper.apply(ShardEvent(int(shard),
+                                         ShardStatus[status_name], node,
+                                         int(progress)))
+        self.last_seq = seq
+        return len(events)
+
+
 def poll_remote_statuses(cluster, dataset: str) -> None:
     """Pull shard statuses from remote members into the shard manager
     (stands in for the reference's status events over Akka)."""

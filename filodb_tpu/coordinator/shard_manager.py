@@ -11,6 +11,7 @@ rate-limits auto-reassignment after failures
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -68,6 +69,15 @@ class ShardManager:
     subscribers: list = field(default_factory=list)
     _nodes: list[str] = field(default_factory=list)
     _last_reassign: dict[int, float] = field(default_factory=dict)
+    # sequenced event log for remote subscribers (reference StatusActor
+    # ack/resync, ``StatusActor.scala:41``): followers poll with their last
+    # -seen sequence; a gap beyond the retained window forces a resync
+    event_log_cap: int = 512
+    _seq: int = 0
+    _event_log: list = field(default_factory=list)  # [(seq, ShardEvent)]
+    # _publish runs on heartbeat/join threads; events_since on executor
+    # handler threads — the log and mapper snapshot need a lock
+    _ev_lock: object = field(default_factory=threading.Lock)
 
     def __post_init__(self):
         self.mapper = ShardMapper(self.num_shards)
@@ -141,13 +151,38 @@ class ShardManager:
         return ev
 
     def _publish(self, ev: ShardEvent) -> ShardEvent:
-        self.mapper.apply(ev)
+        with self._ev_lock:
+            self.mapper.apply(ev)
+            self._seq += 1
+            self._event_log.append((self._seq, ev))
+            if len(self._event_log) > self.event_log_cap:
+                del self._event_log[: len(self._event_log)
+                                    - self.event_log_cap]
         for sub in self.subscribers:
             try:
                 sub(ev)
             except Exception:
                 log.exception("shard event subscriber failed")
         return ev
+
+    def events_since(self, since_seq: int):
+        """(events, current_seq, resynced): ordered events after
+        ``since_seq``. The follower resyncs with a full-state snapshot when
+        its ack falls behind the retained window OR is AHEAD of the current
+        sequence (a coordinator restart reset the counter) — the
+        reference's resync path."""
+        with self._ev_lock:
+            oldest = self._event_log[0][0] if self._event_log \
+                else self._seq + 1
+            behind = since_seq + 1 < oldest and self._seq > since_seq
+            ahead = since_seq > self._seq
+            if behind or ahead:
+                snapshot = [ShardEvent(s, self.mapper.statuses[s],
+                                       self.mapper.owners[s])
+                            for s in range(self.num_shards)]
+                return snapshot, self._seq, True
+            events = [ev for seq, ev in self._event_log if seq > since_seq]
+            return events, self._seq, False
 
     def subscribe(self, fn) -> None:
         self.subscribers.append(fn)

@@ -35,9 +35,12 @@ log = logging.getLogger(__name__)
 
 class FiloHttpServer:
     def __init__(self, services: dict[str, QueryService], host="127.0.0.1",
-                 port=8080, cluster=None):
+                 port=8080, cluster=None, shard_maps=None):
         self.services = services
         self.cluster = cluster
+        # member mode: dataset -> mirrored ShardMapper (StatusActor
+        # subscription) so members answer cluster-status queries locally
+        self.shard_maps = shard_maps or {}
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -262,6 +265,10 @@ def _make_handler(server: FiloHttpServer):
             if len(rest) == 2 and rest[1] == "status":
                 if cluster is not None:
                     data = cluster.shard_statuses(dataset)
+                elif dataset in server.shard_maps:
+                    # member: serve the coordinator's state from the local
+                    # mirror (sequenced subscription with resync)
+                    data = server.shard_maps[dataset]().snapshot()
                 else:
                     svc = server.services.get(dataset)
                     data = [{"shard": s.shard_num, "status": "active",

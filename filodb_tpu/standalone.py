@@ -91,6 +91,17 @@ class FiloServer:
                             else "recovery"))
         return out
 
+    def _handle_shard_events(self, dataset: str, since_seq: int):
+        """Sequenced shard-event feed for member subscribers (reference
+        StatusActor ack/resync): events after ``since_seq``, or a full
+        snapshot when the follower fell behind the retained window."""
+        sm = self.cluster.shard_managers.get(dataset)
+        if sm is None:
+            return ([], since_seq, False)
+        events, seq, resynced = sm.events_since(since_seq)
+        return ([(e.shard, e.status.name, e.node, e.progress)
+                 for e in events], seq, resynced)
+
     def _handle_join(self, name: str, host: str, control_port: int):
         """Coordinator side: a remote member joined (reference
         NodeClusterActor member-up). Shard assignment (which calls back to
@@ -128,6 +139,7 @@ class FiloServer:
                 "start_shard": self._handle_start_shard,
                 "stop_shard": self._handle_stop_shard,
                 "shard_status": self._handle_shard_status,
+                "shard_events": self._handle_shard_events,
                 "join": self._handle_join,
             }).start()
         self.node.executor_port = self.executor.port
@@ -149,6 +161,31 @@ class FiloServer:
                     log.warning("seed %s unreachable: %s", seed, e)
             if not joined:
                 raise RuntimeError("could not join any seed")
+            # mirror the coordinator's shard map locally (reference
+            # StatusActor subscription with ack/resync); members serve
+            # cluster-status queries from this mirror
+            from filodb_tpu.coordinator.bootstrap import (
+                ShardUpdateSubscriber,
+            )
+            self.shard_subscribers = {}
+            for name, ing_cfg in cfg.datasets.items():
+                self.shard_subscribers[name] = ShardUpdateSubscriber(
+                    name, ing_cfg.num_shards,
+                    RemotePlanDispatcher(host, int(port)))
+            import threading as _th
+            self._sub_stop = _th.Event()
+
+            def poll_loop():
+                while not self._sub_stop.wait(1.0):
+                    for sub in self.shard_subscribers.values():
+                        try:
+                            sub.poll()
+                        except Exception:
+                            log.debug("shard-update poll failed",
+                                      exc_info=True)
+
+            _th.Thread(target=poll_loop, daemon=True,
+                       name="shard-updates").start()
         else:
             # coordinator role: own the cluster singleton
             self.cluster.join(self.node)
@@ -163,9 +200,14 @@ class FiloServer:
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
             self.cluster.start_failure_detector()
+        shard_maps = {
+            name: (lambda n=name: self.shard_subscribers[n].mapper)
+            for name in getattr(self, "shard_subscribers", {})
+        }
         self.http = FiloHttpServer(services, port=cfg.http_port,
                                    cluster=self.cluster
-                                   if not cfg.seeds else None).start()
+                                   if not cfg.seeds else None,
+                                   shard_maps=shard_maps).start()
         if cfg.gateway_port:
             first = next(iter(cfg.datasets.values()))
             sink = ContainerSink(
@@ -365,6 +407,8 @@ class FiloServer:
     def shutdown(self):
         if getattr(self, "_failover_stop", None) is not None:
             self._failover_stop.set()
+        if getattr(self, "_sub_stop", None) is not None:
+            self._sub_stop.set()  # stop the shard-update poll loop
         if self.http:
             self.http.stop()
         if self.gateway:

@@ -1,0 +1,106 @@
+"""Shard-status ack/resync protocol (reference ``StatusActor.scala:41``):
+sequenced event feed, implicit acks via poll offsets, gap-forced resync."""
+
+from filodb_tpu.coordinator.bootstrap import ShardUpdateSubscriber
+from filodb_tpu.coordinator.shard_manager import ShardManager
+from filodb_tpu.coordinator.shardmapper import ShardStatus
+
+
+class _LocalDispatcher:
+    """Calls a ShardManager directly, shaped like the control transport."""
+
+    def __init__(self, sm: ShardManager):
+        self.sm = sm
+
+    def call(self, kind, dataset, since_seq):
+        assert kind == "shard_events"
+        events, seq, resynced = self.sm.events_since(since_seq)
+        return ([(e.shard, e.status.name, e.node, e.progress)
+                 for e in events], seq, resynced)
+
+
+class TestAckResync:
+    def test_incremental_delivery_and_ack(self):
+        sm = ShardManager("ds", 4)
+        sub = ShardUpdateSubscriber("ds", 4, _LocalDispatcher(sm))
+        sm.add_member("n0")
+        assert sub.poll() == 4  # four ASSIGNED events
+        assert sub.mapper.owners == sm.mapper.owners
+        assert sub.poll() == 0  # acked: nothing new
+        sm.shard_active(2, "n0")
+        assert sub.poll() == 1
+        assert sub.mapper.statuses[2] == ShardStatus.ACTIVE
+        assert sub.resyncs == 0
+
+    def test_gap_forces_resync(self):
+        sm = ShardManager("ds", 4, event_log_cap=3)
+        sub = ShardUpdateSubscriber("ds", 4, _LocalDispatcher(sm))
+        sm.add_member("n0")
+        # overflow the retained window before the subscriber polls
+        for _ in range(5):
+            sm.shard_active(0, "n0")
+            sm.shard_active(1, "n0")
+        applied = sub.poll()
+        assert sub.resyncs == 1
+        assert applied == 4  # full snapshot, one event per shard
+        assert sub.mapper.owners == sm.mapper.owners
+        assert sub.mapper.statuses[0] == ShardStatus.ACTIVE
+        # back in step: subsequent polls are incremental again
+        sm.shard_recovery(3, "n0", 50)
+        assert sub.poll() == 1
+        assert sub.resyncs == 1
+        assert sub.mapper.statuses[3] == ShardStatus.RECOVERY
+
+    def test_fresh_subscriber_gets_snapshot_or_log(self):
+        sm = ShardManager("ds", 2)
+        sm.add_member("a")
+        sm.shard_active(0, "a")
+        sub = ShardUpdateSubscriber("ds", 2, _LocalDispatcher(sm))
+        sub.poll()
+        assert sub.mapper.owners == sm.mapper.owners
+        assert sub.mapper.statuses == sm.mapper.statuses
+
+    def test_coordinator_restart_forces_resync(self):
+        # follower's ack can be AHEAD after a coordinator restart resets the
+        # sequence — must resync, not silently skip the fresh events
+        sm1 = ShardManager("ds", 2)
+        sub = ShardUpdateSubscriber("ds", 2, _LocalDispatcher(sm1))
+        sm1.add_member("a")
+        for _ in range(6):
+            sm1.shard_active(0, "a")
+        sub.poll()
+        assert sub.last_seq > 0
+        # coordinator restarts with fresh state
+        sm2 = ShardManager("ds", 2)
+        sm2.add_member("b")
+        sub.dispatcher = _LocalDispatcher(sm2)
+        sub.poll()
+        assert sub.resyncs == 1
+        assert sub.mapper.owners == sm2.mapper.owners
+
+    def test_member_mirrors_coordinator_over_wire(self):
+        """End to end over the real control transport."""
+        from filodb_tpu.coordinator.remote import (
+            PlanExecutorServer,
+            RemotePlanDispatcher,
+        )
+        sm = ShardManager("ds", 4)
+        sm.add_member("n0")
+
+        def handler(dataset, since_seq):
+            events, seq, resynced = sm.events_since(since_seq)
+            return ([(e.shard, e.status.name, e.node, e.progress)
+                     for e in events], seq, resynced)
+
+        srv = PlanExecutorServer(None, extra_handlers={
+            "shard_events": handler}).start()
+        try:
+            sub = ShardUpdateSubscriber(
+                "ds", 4, RemotePlanDispatcher("127.0.0.1", srv.port))
+            sub.poll()
+            assert sub.mapper.owners == sm.mapper.owners
+            sm.shard_active(1, "n0")
+            sub.poll()
+            assert sub.mapper.statuses[1] == ShardStatus.ACTIVE
+        finally:
+            srv.stop()
