@@ -86,9 +86,14 @@ def save_snapshot(shard, chunk_token: int = -1, pk_token: int = -1,
     out += MAGIC
     out += struct.pack("<Iqqq", n, snapshot_ms, chunk_token, pk_token)
 
-    host_pids = [pid for pid, p in enumerate(shard.partitions)
-                 if p is not None
-                 and not isinstance(p, NativeBackedPartition)]
+    if shard._native_core is not None:
+        # the shard tracks host-backed pids; scanning partitions would
+        # materialize every lazy wrapper under the write lock
+        host_pids = sorted(shard._host_pids)
+    else:
+        host_pids = [pid for pid, p in enumerate(shard.partitions)
+                     if p is not None
+                     and not isinstance(p, NativeBackedPartition)]
     if shard._native_core is not None:
         core_sec, key_off, key_len = shard._native_core.export_entries(n)
         core_sec = bytearray(core_sec)
@@ -223,6 +228,7 @@ def load_snapshot(shard, data: bytes) -> dict:
         if floor > -1:
             p.seed_dedup_floor(floor)
         shard._by_key[key] = pid
+        shard._host_pids.add(pid)
         parts[pid] = p
     shard.partitions = parts
 

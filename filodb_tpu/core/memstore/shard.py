@@ -105,6 +105,9 @@ class TimeSeriesShard:
         self._native_core = None
         self._nat_skipped_seen = 0
         self._nat_ooo_seen = 0
+        # pids of host-backed (non-native) partitions, e.g. histograms —
+        # lets shard-wide accounting avoid walking every lazy partition
+        self._host_pids: set[int] = set()
         if store_config.native_ingest \
                 and not store_config.trace_part_key_substrings \
                 and not store_config.device_pages:
@@ -173,6 +176,10 @@ class TimeSeriesShard:
         if floor is not None:
             part.seed_dedup_floor(floor)
         self.partitions.append(part)
+        if self._native_core is not None and not native_backed:
+            # AFTER the append: a concurrent chunk_bytes() snapshot of
+            # _host_pids must never index past the partitions list
+            self._host_pids.add(pid)
         self._by_key[key] = pid
         self.index.add_part_key(pid, key, first_ts)
         self._dirty_part_keys.add(pid)
@@ -432,6 +439,7 @@ class TimeSeriesShard:
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
         self.partitions = []
         self._by_key = {}
+        self._host_pids = set()
         self.index = PartKeyIndex(self.schemas)
         self.cardinality = CardinalityTracker(self.shard_num)
         if self._native_core is not None:
@@ -505,6 +513,7 @@ class TimeSeriesShard:
                 if latest != -1 and latest < cutoff:
                     self.index.remove_part_key(pid)
                     self._by_key.pop(part.part_key, None)
+                    self._host_pids.discard(pid)
                     self.partitions[pid] = None
                     if self._native_core is not None:
                         # EVERY partition has a native slot (pid alignment),
@@ -530,6 +539,20 @@ class TimeSeriesShard:
 
     def chunk_bytes(self) -> int:
         total = 0
+        if self._native_core is not None:
+            # one C++ pass over every native slot (the flush scheduler
+            # calls this each tick; per-partition FFI or a walk of the
+            # lazy partition list would be O(series))
+            with self._native_core.lock:
+                total += int(self._native_core._lib.shard_core_chunk_bytes(
+                    self._native_core._core))
+            # snapshot: the writer thread mutates the set under write_lock,
+            # which this (flush-scheduler) path does not hold
+            for pid in list(self._host_pids):
+                p = self.partitions[pid]
+                if p is not None:
+                    total += sum(c.nbytes for c in p.chunks)
+            return total
         for p in self.partitions:
             if p is None:
                 continue

@@ -184,7 +184,16 @@ class RecordContainer:
 
     @staticmethod
     def _deserialize_v1_pickle(data: bytes) -> "RecordContainer":
-        # legacy WAL segments written before the binary format
+        """Legacy WAL segments written before the binary format. Pickle is
+        code execution, so this path is OPT-IN (local replay of old files
+        only) — container bytes now also arrive over the network
+        (log_server), where a crafted v1 frame must never deserialize."""
+        import os
+        if not os.environ.get("FILODB_ALLOW_LEGACY_WAL"):
+            raise ValueError(
+                "legacy v1 (pickle) container rejected; set "
+                "FILODB_ALLOW_LEGACY_WAL=1 only when replaying trusted "
+                "pre-binary WAL files")
         ver, ln = struct.unpack_from("<BI", data, 0)
         raw = pickle.loads(data[5 : 5 + ln])
         c = RecordContainer()

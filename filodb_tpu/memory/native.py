@@ -113,6 +113,8 @@ def _load():
         lib.shard_core_floors.argtypes = [vp, i64p, i64]
         lib.shard_core_export_size.argtypes = [vp]
         lib.shard_core_export_size.restype = i64
+        lib.shard_core_chunk_bytes.argtypes = [vp]
+        lib.shard_core_chunk_bytes.restype = i64
         lib.shard_core_export.argtypes = [vp, u8p, i64p,
                                           ctypes.POINTER(i32)]
         lib.shard_core_key_len.argtypes = [vp, i32]

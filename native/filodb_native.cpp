@@ -854,6 +854,21 @@ void part_seed_floor(void* cp, int32_t pid, int64_t ts) {
     if (ts > p.floor_ts) p.floor_ts = ts;
 }
 
+// whole-shard encoded chunk footprint in one call (the flush scheduler
+// checks the memory budget every tick; per-partition calls would be O(n)
+// FFI round trips at high cardinality)
+int64_t shard_core_chunk_bytes(void* cp) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    int64_t total = 0;
+    for (auto& p : c->parts) {
+        for (auto& s : p.sealed) {
+            total += (int64_t)s.ts_bytes.size();
+            for (auto& cb : s.col_bytes) total += (int64_t)cb.size();
+        }
+    }
+    return total;
+}
+
 int64_t part_chunk_bytes(void* cp, int32_t pid) {
     NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
     int64_t n = 0;

@@ -139,3 +139,23 @@ class TestTransportHardening:
         b = encode(("execute", "ds", None, QueryContext()))
         assert not b.startswith(b"\x80")
         assert b"\x80\x05" not in b
+
+
+class TestLegacyContainerGate:
+    def test_v1_pickle_rejected_by_default(self, monkeypatch):
+        import pickle, struct as _s
+        from filodb_tpu.core.record import RecordContainer
+        monkeypatch.delenv("FILODB_ALLOW_LEGACY_WAL", raising=False)
+        payload = pickle.dumps([("gauge", (("_metric_", "old"),), 1, (1.0,))])
+        legacy = _s.pack("<BI", 1, len(payload)) + payload
+        with pytest.raises(ValueError, match="legacy v1"):
+            RecordContainer.deserialize(legacy)
+
+    def test_v1_allowed_when_opted_in(self, monkeypatch):
+        import pickle, struct as _s
+        from filodb_tpu.core.record import RecordContainer
+        monkeypatch.setenv("FILODB_ALLOW_LEGACY_WAL", "1")
+        payload = pickle.dumps([("gauge", (("_metric_", "old"),), 1, (1.0,))])
+        legacy = _s.pack("<BI", 1, len(payload)) + payload
+        c = RecordContainer.deserialize(legacy)
+        assert list(c)[0].timestamp == 1
