@@ -142,17 +142,21 @@ class ShardUpdateSubscriber:
         self.dispatcher = dispatcher
         self.mapper = ShardMapper(num_shards)
         self.last_seq = 0
+        self.epoch = None  # feed-generation token; change forces resync
         self.resyncs = 0
 
     def poll(self) -> int:
-        """One poll cycle; returns events applied."""
+        """One poll cycle; returns events applied. The follower echoes the
+        feed epoch it last saw: a restarted coordinator (new epoch) always
+        answers with a snapshot, even when the stale ack happens to land
+        inside the new feed's sequence range."""
         from filodb_tpu.coordinator.shardmapper import (
             ShardEvent,
             ShardMapper,
             ShardStatus,
         )
-        events, seq, resynced = self.dispatcher.call(
-            "shard_events", self.dataset, self.last_seq)
+        events, seq, resynced, epoch = self.dispatcher.call(
+            "shard_events", self.dataset, self.last_seq, self.epoch)
         if resynced:
             self.mapper = ShardMapper(self.mapper.num_shards)
             self.resyncs += 1
@@ -161,6 +165,7 @@ class ShardUpdateSubscriber:
                                          ShardStatus[status_name], node,
                                          int(progress)))
         self.last_seq = seq
+        self.epoch = epoch
         return len(events)
 
 

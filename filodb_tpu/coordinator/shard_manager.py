@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 
 from filodb_tpu.coordinator.shardmapper import (
@@ -81,6 +82,11 @@ class ShardManager:
 
     def __post_init__(self):
         self.mapper = ShardMapper(self.num_shards)
+        # feed-generation token: a restarted coordinator resets _seq to 0,
+        # and a follower whose ack lands inside the NEW feed's range would
+        # otherwise silently skip events (neither behind nor ahead fires).
+        # Followers echo the epoch; any change forces a snapshot resync.
+        self.epoch = uuid.uuid4().hex[:16]
 
     # -- membership --
 
@@ -165,24 +171,27 @@ class ShardManager:
                 log.exception("shard event subscriber failed")
         return ev
 
-    def events_since(self, since_seq: int):
-        """(events, current_seq, resynced): ordered events after
+    def events_since(self, since_seq: int, epoch: str | None = None):
+        """(events, current_seq, resynced, epoch): ordered events after
         ``since_seq``. The follower resyncs with a full-state snapshot when
-        its ack falls behind the retained window OR is AHEAD of the current
-        sequence (a coordinator restart reset the counter) — the
-        reference's resync path."""
+        its ack falls behind the retained window, is AHEAD of the current
+        sequence, or carries a different feed epoch (a restarted
+        coordinator may have re-emitted >= since_seq events, making the ack
+        numerically plausible but meaningless) — the reference's resync
+        path."""
         with self._ev_lock:
             oldest = self._event_log[0][0] if self._event_log \
                 else self._seq + 1
             behind = since_seq + 1 < oldest and self._seq > since_seq
             ahead = since_seq > self._seq
-            if behind or ahead:
+            stale_epoch = epoch is not None and epoch != self.epoch
+            if behind or ahead or stale_epoch:
                 snapshot = [ShardEvent(s, self.mapper.statuses[s],
                                        self.mapper.owners[s])
                             for s in range(self.num_shards)]
-                return snapshot, self._seq, True
+                return snapshot, self._seq, True, self.epoch
             events = [ev for seq, ev in self._event_log if seq > since_seq]
-            return events, self._seq, False
+            return events, self._seq, False, self.epoch
 
     def subscribe(self, fn) -> None:
         self.subscribers.append(fn)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import socket
 import socketserver
 import threading
@@ -35,6 +36,32 @@ from filodb_tpu.core.record import BytesContainer, RecordContainer, SomeData
 from filodb_tpu.kafka.log import ReplayLog, SegmentedFileLog
 
 log = logging.getLogger(__name__)
+
+# Dataset names come off the wire; they become path components under the
+# broker root, so anything outside this alphabet (especially "/" and "..")
+# is rejected before the filesystem is touched.
+_SAFE_NAME = re.compile(r"[A-Za-z0-9_.-]{1,128}\Z")
+
+# one read reply is materialized fully in memory before send; cap it so a
+# single request can't make the broker slurp an entire shard log
+MAX_READ_BATCH = 4096
+
+
+class LogOpError(RuntimeError):
+    """A server-side ('err', ...) reply — deterministic, not a transport
+    failure. Callers that retry transport errors (ConnectionError/OSError)
+    must NOT retry these forever: the server will keep answering the same
+    way (corrupt log file, rejected name, oversized read...)."""
+
+
+def _validate_target(dataset, shard) -> str | None:
+    if not isinstance(dataset, str) or not _SAFE_NAME.fullmatch(dataset) \
+            or dataset in (".", ".."):
+        return f"invalid dataset name {dataset!r}"
+    if not isinstance(shard, int) or isinstance(shard, bool) or shard < 0 \
+            or shard > 1_000_000:
+        return f"invalid shard {shard!r}"
+    return None
 
 
 class LogServer:
@@ -78,12 +105,21 @@ class LogServer:
         try:
             if kind == "ping":
                 return ("pong",)
+            if kind in ("append", "read", "latest", "truncate", "align"):
+                bad = _validate_target(msg[1], msg[2])
+                if bad is not None:
+                    return ("err", bad)
             if kind == "append":
                 _, dataset, shard, raw = msg
                 off = self._log(dataset, shard).append(BytesContainer(raw))
                 return ("ok", off)
             if kind == "read":
                 _, dataset, shard, from_off, max_n = msg
+                if not isinstance(from_off, int) or not isinstance(max_n, int):
+                    return ("err", "invalid read parameters")
+                max_n = min(max_n, MAX_READ_BATCH)
+                if max_n <= 0:
+                    return ("ok", [])
                 out = []
                 for sd in self._log(dataset, shard).read_from(from_off):
                     out.append((sd.offset, sd.container.serialize()))
@@ -131,7 +167,10 @@ class RemoteLog(ReplayLog):
         self.dataset = dataset
         self.shard = shard
         self.timeout = timeout
-        self.read_batch = read_batch
+        # must not exceed the broker's reply cap: read_from detects end-of-
+        # log by a short batch, so a client asking for more than the server
+        # will ever send would mistake every capped reply for the end
+        self.read_batch = min(read_batch, MAX_READ_BATCH)
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
 
@@ -167,7 +206,7 @@ class RemoteLog(ReplayLog):
             return resp[1]
         if resp[0] == "pong":
             return True
-        raise RuntimeError(f"log op failed: {resp[1]}")
+        raise LogOpError(f"log op failed: {resp[1]}")
 
     def append(self, container: RecordContainer) -> int:
         return self._call("append", self.dataset, self.shard,

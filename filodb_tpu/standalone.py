@@ -91,16 +91,18 @@ class FiloServer:
                             else "recovery"))
         return out
 
-    def _handle_shard_events(self, dataset: str, since_seq: int):
+    def _handle_shard_events(self, dataset: str, since_seq: int,
+                             epoch: str | None = None):
         """Sequenced shard-event feed for member subscribers (reference
         StatusActor ack/resync): events after ``since_seq``, or a full
-        snapshot when the follower fell behind the retained window."""
+        snapshot when the follower fell behind the retained window or its
+        epoch predates a coordinator restart."""
         sm = self.cluster.shard_managers.get(dataset)
         if sm is None:
-            return ([], since_seq, False)
-        events, seq, resynced = sm.events_since(since_seq)
+            return ([], since_seq, False, epoch)
+        events, seq, resynced, ep = sm.events_since(since_seq, epoch)
         return ([(e.shard, e.status.name, e.node, e.progress)
-                 for e in events], seq, resynced)
+                 for e in events], seq, resynced, ep)
 
     def _handle_join(self, name: str, host: str, control_port: int):
         """Coordinator side: a remote member joined (reference

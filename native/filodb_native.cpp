@@ -15,6 +15,7 @@
 #include <atomic>
 #include <deque>
 #include <initializer_list>
+#include <limits>
 #include <new>
 #include <string>
 #include <unordered_map>
@@ -579,9 +580,14 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
         }
         if (p->first_ts < 0) p->first_ts = ts;
         p->ts.push_back(ts);
-        for (uint8_t j = 0; j < nv && j < (uint8_t)p->cols.size(); j++) {
-            double x;
-            std::memcpy(&x, d + o + 1 + j * 9, 8);
+        // Every column must grow in lockstep with ts: a crafted container
+        // whose later record carries fewer values than the partition's
+        // column count would otherwise leave short columns, and seal-time
+        // encoders read ts.size() elements (heap OOB). Missing values pad
+        // with NaN; extra values are dropped.
+        for (size_t j = 0; j < p->cols.size(); j++) {
+            double x = std::numeric_limits<double>::quiet_NaN();
+            if (j < (size_t)nv) std::memcpy(&x, d + o + 1 + j * 9, 8);
             p->cols[j].push_back(x);
         }
         if ((int32_t)p->ts.size() >= c->max_chunk) seal_part(c, *p);

@@ -136,3 +136,88 @@ class TestClusterOverNetworkedLog:
         finally:
             node.kill()
             srv.stop()
+
+
+class TestWireValidation:
+    """Wire-supplied dataset/shard become filesystem path components; the
+    broker must reject anything that could escape its root (ADVICE r2)."""
+
+    def test_path_traversal_dataset_rejected(self, server, tmp_path):
+        from filodb_tpu.kafka.log_server import LogOpError
+        lg = RemoteLog("127.0.0.1", server.port, "../../evil", 0)
+        with pytest.raises(LogOpError, match="invalid dataset"):
+            lg.append(containers(1)[0])
+        # nothing escaped the broker root
+        assert not (tmp_path / "evil").exists()
+        lg.close()
+
+    def test_bad_shard_types_rejected(self, server):
+        from filodb_tpu.kafka.log_server import LogOpError
+        for bad in ("0/../..", -1, 10**9, True):
+            lg = RemoteLog("127.0.0.1", server.port, "ds", bad)
+            with pytest.raises(LogOpError, match="invalid shard"):
+                lg.latest_offset
+            lg.close()
+
+    def test_slash_and_dot_names_rejected(self, server):
+        from filodb_tpu.kafka.log_server import LogOpError
+        for bad in ("a/b", "..", ".", "", "x" * 200):
+            lg = RemoteLog("127.0.0.1", server.port, bad, 0)
+            with pytest.raises(LogOpError, match="invalid dataset"):
+                lg.latest_offset
+            lg.close()
+
+    def test_server_error_is_log_op_error_not_transport(self, server):
+        """Deterministic server-side errors raise LogOpError (a RuntimeError
+        subclass), so retry loops can distinguish them from transport
+        failures and stop spinning (ADVICE r2 low)."""
+        from filodb_tpu.kafka.log_server import LogOpError
+        lg = RemoteLog("127.0.0.1", server.port, "../../x", 3)
+        try:
+            lg.latest_offset
+        except LogOpError as e:
+            assert isinstance(e, RuntimeError)
+        else:
+            raise AssertionError("expected LogOpError")
+        lg.close()
+
+    def test_valid_names_still_work(self, server):
+        lg = RemoteLog("127.0.0.1", server.port, "prod-metrics_v2.1", 42)
+        assert lg.append(containers(1)[0]) == 0
+        assert lg.latest_offset == 0
+        lg.close()
+
+    def test_newline_dataset_rejected(self, server):
+        from filodb_tpu.kafka.log_server import LogOpError
+        lg = RemoteLog("127.0.0.1", server.port, "evil\n", 0)
+        with pytest.raises(LogOpError, match="invalid dataset"):
+            lg.latest_offset
+        lg.close()
+
+    def test_read_batch_capped(self, server):
+        """A huge max_n must not make the broker materialize the whole log
+        in one reply."""
+        from filodb_tpu.kafka.log_server import MAX_READ_BATCH
+        lg = RemoteLog("127.0.0.1", server.port, "ds", 7)
+        for c in containers(3):
+            lg.append(c)
+        batch = lg._call("read", "ds", 7, 0, 10**18)
+        assert len(batch) == 3  # served, but the cap bounds any reply
+        assert MAX_READ_BATCH >= 256  # sane floor for real tailing
+        assert lg._call("read", "ds", 7, 0, -5) == []
+        from filodb_tpu.kafka.log_server import LogOpError
+        with pytest.raises(LogOpError, match="invalid read"):
+            lg._call("read", "ds", 7, "zero", 10)
+        lg.close()
+
+    def test_client_read_batch_clamped_to_server_cap(self, server):
+        """A client read_batch above the broker cap must not break
+        end-of-log detection (short-batch sentinel)."""
+        from filodb_tpu.kafka.log_server import MAX_READ_BATCH
+        lg = RemoteLog("127.0.0.1", server.port, "ds", 9,
+                       read_batch=MAX_READ_BATCH * 2)
+        assert lg.read_batch == MAX_READ_BATCH
+        for c in containers(5):
+            lg.append(c)
+        assert len(list(lg.read_from(0))) == 5
+        lg.close()

@@ -210,3 +210,54 @@ class TestNativeParity:
         for sd in fresh:
             shard.ingest(sd)
         assert shard.num_partitions == 2
+
+
+class TestMalformedContainers:
+    """ADVICE r2 high: a crafted container whose later record carries a
+    different value count than the partition's column count must not leave
+    columns shorter than ts (seal-time encoders read ts.size() elements —
+    heap OOB on the divergent layout)."""
+
+    def _container(self, key, rows):
+        from filodb_tpu.core.record import IngestRecord, RecordContainer
+        c = RecordContainer()
+        for ts, values in rows:
+            c.add(IngestRecord(key, ts, values))
+        return BytesContainer(c.serialize())
+
+    def test_shrinking_value_count_pads_nan(self):
+        key = machine_metrics_series(1)[0]
+        ms = TimeSeriesMemStore(InMemoryColumnStore(), InMemoryMetaStore())
+        shard = ms.setup("ds", 0, StoreConfig(max_chunk_size=2,
+                                              groups_per_shard=1,
+                                              native_ingest=True))
+        # first record establishes 2 columns; second carries only 1 value.
+        # max_chunk_size=2 seals immediately — the encoder walk over
+        # ts.size() elements is exactly the OOB read being regressed.
+        bad = self._container(key, [(1000, (1.0, 2.0)), (2000, (3.0,))])
+        shard.ingest(SomeData(bad, 0))
+        assert shard._native_core is not None
+        part = shard.partitions[0]
+        ts, vals = part.read_samples(0, 10**15)
+        np.testing.assert_array_equal(ts, [1000, 2000])
+        np.testing.assert_array_equal(vals, [1.0, 3.0])
+        # the SECOND column is where the divergence lived: it must have
+        # grown in lockstep (NaN pad), and the sealed encoding of exactly
+        # ts.size() elements must round-trip
+        from filodb_tpu.memory.codecs import decode_any
+        [chunk] = part.chunks
+        col1 = decode_any(chunk.vectors[2])
+        assert len(col1) == 2
+        assert col1[0] == 2.0 and np.isnan(col1[1])
+
+    def test_growing_value_count_drops_extras(self):
+        key = machine_metrics_series(1)[0]
+        ms = TimeSeriesMemStore(InMemoryColumnStore(), InMemoryMetaStore())
+        shard = ms.setup("ds", 0, StoreConfig(max_chunk_size=2,
+                                              groups_per_shard=1,
+                                              native_ingest=True))
+        bad = self._container(key, [(1000, (1.0,)), (2000, (3.0, 9.0))])
+        shard.ingest(SomeData(bad, 0))
+        ts, vals = shard.partitions[0].read_samples(0, 10**15)
+        np.testing.assert_array_equal(ts, [1000, 2000])
+        np.testing.assert_array_equal(vals, [1.0, 3.0])

@@ -12,11 +12,11 @@ class _LocalDispatcher:
     def __init__(self, sm: ShardManager):
         self.sm = sm
 
-    def call(self, kind, dataset, since_seq):
+    def call(self, kind, dataset, since_seq, epoch=None):
         assert kind == "shard_events"
-        events, seq, resynced = self.sm.events_since(since_seq)
+        events, seq, resynced, ep = self.sm.events_since(since_seq, epoch)
         return ([(e.shard, e.status.name, e.node, e.progress)
-                 for e in events], seq, resynced)
+                 for e in events], seq, resynced, ep)
 
 
 class TestAckResync:
@@ -78,6 +78,31 @@ class TestAckResync:
         assert sub.resyncs == 1
         assert sub.mapper.owners == sm2.mapper.owners
 
+    def test_restart_with_plausible_seq_forces_resync(self):
+        # the nastier restart case: the NEW coordinator has already emitted
+        # >= since_seq events, so the ack is numerically inside the new
+        # feed's range — neither 'behind' nor 'ahead' fires. The epoch
+        # token must force the resync.
+        sm1 = ShardManager("ds", 4)
+        sub = ShardUpdateSubscriber("ds", 4, _LocalDispatcher(sm1))
+        sm1.add_member("a")  # 4 events, seq = 4
+        sub.poll()
+        assert sub.last_seq == 4
+        # restart: fresh manager immediately emits 4 events for a DIFFERENT
+        # member, so its seq is also 4 — the stale ack looks current
+        sm2 = ShardManager("ds", 4)
+        sm2.add_member("b")
+        assert sm2.epoch != sm1.epoch
+        sub.dispatcher = _LocalDispatcher(sm2)
+        sub.poll()
+        assert sub.resyncs == 1
+        assert sub.mapper.owners == sm2.mapper.owners
+        assert sub.epoch == sm2.epoch
+        # steady state after adopting the new epoch
+        sm2.shard_active(0, "b")
+        assert sub.poll() == 1
+        assert sub.resyncs == 1
+
     def test_member_mirrors_coordinator_over_wire(self):
         """End to end over the real control transport."""
         from filodb_tpu.coordinator.remote import (
@@ -87,10 +112,10 @@ class TestAckResync:
         sm = ShardManager("ds", 4)
         sm.add_member("n0")
 
-        def handler(dataset, since_seq):
-            events, seq, resynced = sm.events_since(since_seq)
+        def handler(dataset, since_seq, epoch=None):
+            events, seq, resynced, ep = sm.events_since(since_seq, epoch)
             return ([(e.shard, e.status.name, e.node, e.progress)
-                     for e in events], seq, resynced)
+                     for e in events], seq, resynced, ep)
 
         srv = PlanExecutorServer(None, extra_handlers={
             "shard_events": handler}).start()

@@ -1,0 +1,102 @@
+"""Round-long TPU tunnel probe loop.
+
+VERDICT r2 #1: probe the axon TPU tunnel from round *start* on a repeating
+timer, logging every attempt, so the round either lands a real-TPU benchmark
+or carries an auditable probe timeline proving continuous attempts.
+
+Each probe runs in a subprocess with a hard timeout (a hung axon backend init
+must never wedge this loop — and a stuck init blocks ``import jax`` machine-
+wide, so the timeout also bounds collateral stalls for test runs). On the
+first successful device hit the loop immediately runs the full TPU bench
+suite (the tunnel flaps; grab the number while it's up) and records it.
+
+Usage:  python tools/probe_loop.py >/dev/null 2>&1 &
+Stop:   touch tools/probe_stop
+Log:    PROBE_r03.jsonl (one JSON line per attempt)
+"""
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "PROBE_r03.jsonl")
+STOP = os.path.join(ROOT, "tools", "probe_stop")
+SNAPSHOT = os.path.join(ROOT, "BENCH_TPU_SNAPSHOT.json")
+PERIOD_S = int(os.environ.get("PROBE_PERIOD_S", "900"))
+TIMEOUT_S = int(os.environ.get("PROBE_TIMEOUT_S", "90"))
+
+PROBE_CMD = ("import jax; d = jax.devices(); "
+             "import jax.numpy as jnp; "
+             "jnp.arange(4).sum().block_until_ready(); "
+             "print(d[0].platform)")
+
+
+def log_line(rec):
+    rec["at"] = datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def probe_once():
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_CMD],
+            check=True, timeout=TIMEOUT_S, capture_output=True, text=True,
+            cwd=ROOT)
+        plat = out.stdout.strip().splitlines()[-1]
+        log_line({"outcome": "ok", "platform": plat,
+                  "elapsed_s": round(time.time() - t0, 1)})
+        return plat
+    except subprocess.TimeoutExpired:
+        log_line({"outcome": "timeout",
+                  "elapsed_s": round(time.time() - t0, 1)})
+    except subprocess.CalledProcessError as e:
+        tail = (e.stderr or "").strip().splitlines()[-1:] or [""]
+        log_line({"outcome": "error",
+                  "elapsed_s": round(time.time() - t0, 1),
+                  "detail": tail[0][:200]})
+    except Exception as e:  # never die; the timeline must keep going
+        log_line({"outcome": "loop-error", "detail": repr(e)[:200]})
+    return None
+
+
+def run_tpu_bench(platform):
+    """Device is up: run the bench suite now and snapshot the result."""
+    log_line({"outcome": "bench-start", "platform": platform})
+    try:
+        out = subprocess.run(
+            [sys.executable, "bench.py"], cwd=ROOT, timeout=3600,
+            capture_output=True, text=True,
+            env={**os.environ, "FILODB_BENCH_PROBE_ATTEMPTS": "2"})
+        last = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        with open(SNAPSHOT, "a") as f:
+            f.write(last + "\n")
+        log_line({"outcome": "bench-done", "rc": out.returncode,
+                  "stdout_tail": last[:500],
+                  "stderr_tail": out.stderr.strip()[-300:]})
+        return out.returncode == 0 and '"platform": "cpu"' not in last
+    except Exception as e:
+        log_line({"outcome": "bench-error", "detail": repr(e)[:300]})
+        return False
+
+
+def main():
+    log_line({"outcome": "loop-start", "period_s": PERIOD_S,
+              "timeout_s": TIMEOUT_S, "pid": os.getpid()})
+    benched = False
+    while not os.path.exists(STOP):
+        plat = probe_once()
+        if plat is not None and plat != "cpu" and not benched:
+            benched = run_tpu_bench(plat)
+        time.sleep(PERIOD_S)
+    log_line({"outcome": "loop-stop"})
+
+
+if __name__ == "__main__":
+    main()
