@@ -199,47 +199,89 @@ def decode_f32_page_jax(bases, shifts, widths, words):
 
 
 # ---------------------------------------------------------------------------
-# pallas decode kernel
+# pallas decode kernels
+#
+# Mosaic (real-TPU) lowering constraints shape the design (validated on a
+# live v5e, tools/tpu_pallas_check.py):
+#   - rank-1 blocks and (1, N) tiles don't lower → grid steps cover ROWS=8
+#     blocks at a time with (8, 128)-tiled VMEM blocks (native sublane×lane
+#     tile for 32-bit types);
+#   - SMEM only serves scalar reads → per-block width/slope/first scalars
+#     ride as scalar-prefetch operands, read with an unrolled 8-scalar loop;
+#   - lane-dim gather (`take_along_axis`) and per-lane variable shifts DO
+#     lower, so the bit-unpack stays a gather + shift/mask program.
 
-def _ts_kernel(slopes_ref, widths_ref, words_ref, out_ref):
-    # one block per grid cell; refs are block-sliced
-    w = widths_ref[0]
-    words = words_ref[0, :]
-    i = jax.lax.broadcasted_iota(jnp.uint32, (BLOCK,), 0)
-    bit0 = i * jnp.uint32(w)
+ROWS = 8  # blocks decoded per grid step
+
+
+def _unpack_tile(w_col, words, out_dtype=jnp.uint32):
+    """Shared (ROWS, BLOCK) bit-unpack: width-w_col fields from words."""
+    col = jax.lax.broadcasted_iota(jnp.uint32, (ROWS, BLOCK), 1)
+    bit0 = col * w_col
     word_idx = (bit0 >> 5).astype(jnp.int32)
     bit_off = bit0 & 31
-    lo = words[jnp.clip(word_idx, 0, WORDS_PER_BLOCK_MAX - 1)]
-    hi = words[jnp.clip(word_idx + 1, 0, WORDS_PER_BLOCK_MAX - 1)]
-    mask = jnp.where(w >= 32, jnp.uint32(0xFFFFFFFF),
-                     (jnp.uint32(1) << jnp.uint32(w)) - jnp.uint32(1))
+    lo = jnp.take_along_axis(words, word_idx, axis=1)
+    hi = jnp.take_along_axis(
+        words, jnp.minimum(word_idx + 1, WORDS_PER_BLOCK_MAX - 1), axis=1)
+    mask = jnp.where(w_col >= 32, jnp.uint32(0xFFFFFFFF),
+                     (jnp.uint32(1) << w_col) - jnp.uint32(1))
     val = ((lo >> bit_off)
            | jnp.where(bit_off > 0, hi << (32 - bit_off), 0).astype(
                jnp.uint32)) & mask
-    zz = jnp.where(w == 0, jnp.uint32(0), val)
+    return jnp.where(w_col == 0, jnp.uint32(0), val)
+
+
+def _smem_col(ref, base, dtype=None):
+    """Read ROWS consecutive SMEM scalars into an (ROWS, 1) vector."""
+    vals = [ref[base + r] for r in range(ROWS)]
+    v = jnp.stack(vals).reshape(ROWS, 1)
+    return v if dtype is None else v.astype(dtype)
+
+
+def _ts_kernel(slopes_ref, widths_ref, words_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    w_col = _smem_col(widths_ref, g * ROWS, jnp.uint32)
+    slope_col = _smem_col(slopes_ref, g * ROWS)
+    zz = _unpack_tile(w_col, words_ref[...])
     resid = (zz >> 1).astype(jnp.int32) ^ -(zz & 1).astype(jnp.int32)
-    pred = slopes_ref[0] * jax.lax.broadcasted_iota(jnp.int32, (BLOCK,), 0)
-    out_ref[0, :] = pred + resid
+    pred = slope_col * jax.lax.broadcasted_iota(jnp.int32, (ROWS, BLOCK), 1)
+    out_ref[...] = pred + resid
+
+
+def _pad_blocks(arrs, nb):
+    """Pad leading (block) dim of each array to a multiple of ROWS."""
+    nb_pad = -(-nb // ROWS) * ROWS
+    if nb_pad == nb:
+        return arrs, nb_pad
+    return [jnp.pad(a, [(0, nb_pad - nb)] + [(0, 0)] * (a.ndim - 1))
+            for a in arrs], nb_pad
 
 
 def decode_ts_page_pallas(slopes, widths, words, interpret: bool = False):
-    """Pallas grid over blocks: per-block offsets from the block base
-    (reference hot-path decode, on device)."""
+    """Pallas grid over 8-block tiles: per-block offsets from the block base
+    (reference hot-path decode `DeltaDeltaDataReader` semantics, on device)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     nb = slopes.shape[0]
-    return pl.pallas_call(
+    (slopes, widths, words), nb_pad = _pad_blocks(
+        [slopes, widths, words], nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nb_pad // ROWS,),
+        in_specs=[pl.BlockSpec((ROWS, WORDS_PER_BLOCK_MAX),
+                               lambda g, *_: (g, 0))],
+        out_specs=pl.BlockSpec((ROWS, BLOCK), lambda g, *_: (g, 0)),
+    )
+    out = pl.pallas_call(
         _ts_kernel,
-        out_shape=jax.ShapeDtypeStruct((nb, BLOCK), jnp.int32),
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((1,), lambda b: (b,)),
-            pl.BlockSpec((1,), lambda b: (b,)),
-            pl.BlockSpec((1, WORDS_PER_BLOCK_MAX), lambda b: (b, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, BLOCK), lambda b: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, BLOCK), jnp.int32),
+        grid_spec=grid_spec,
         interpret=interpret,
     )(slopes, widths, words)
+    return out[:nb]
 
 
 def page_to_arrays(page: DevicePage):
@@ -249,44 +291,42 @@ def page_to_arrays(page: DevicePage):
 
 
 def _f32_kernel(firsts_ref, shifts_ref, widths_ref, words_ref, out_ref):
-    # one block per grid cell: unpack width-w fields, undo the trailing-zero
-    # shift, XOR against the block-first bit pattern, bitcast to f32
-    w = widths_ref[0]
-    tz = shifts_ref[0]
-    words = words_ref[0, :]
-    i = jax.lax.broadcasted_iota(jnp.uint32, (BLOCK,), 0)
-    bit0 = i * jnp.uint32(w)
-    word_idx = (bit0 >> 5).astype(jnp.int32)
-    bit_off = bit0 & 31
-    lo = words[jnp.clip(word_idx, 0, WORDS_PER_BLOCK_MAX - 1)]
-    hi = words[jnp.clip(word_idx + 1, 0, WORDS_PER_BLOCK_MAX - 1)]
-    mask = jnp.where(w >= 32, jnp.uint32(0xFFFFFFFF),
-                     (jnp.uint32(1) << jnp.uint32(w)) - jnp.uint32(1))
-    val = ((lo >> bit_off)
-           | jnp.where(bit_off > 0, hi << (32 - bit_off), 0).astype(
-               jnp.uint32)) & mask
-    x = jnp.where(w == 0, jnp.uint32(0), val)
-    xored = jnp.where(tz >= 32, jnp.uint32(0), x << jnp.uint32(tz))
-    bits = xored ^ firsts_ref[0]
-    out_ref[0, :] = jax.lax.bitcast_convert_type(bits, jnp.float32)
+    from jax.experimental import pallas as pl
+
+    g = pl.program_id(0)
+    w_col = _smem_col(widths_ref, g * ROWS, jnp.uint32)
+    tz_col = _smem_col(shifts_ref, g * ROWS, jnp.uint32)
+    first_col = jax.lax.bitcast_convert_type(
+        _smem_col(firsts_ref, g * ROWS), jnp.uint32)
+    x = _unpack_tile(w_col, words_ref[...])
+    xored = jnp.where(tz_col >= 32, jnp.uint32(0), x << tz_col)
+    bits = xored ^ first_col
+    out_ref[...] = jax.lax.bitcast_convert_type(bits, jnp.float32)
 
 
 def decode_f32_page_pallas(firsts, shifts, widths, words,
                            interpret: bool = False):
-    """Pallas grid over blocks: XOR-vs-first float decode on device."""
+    """Pallas grid over 8-block tiles: XOR-vs-first float decode on device."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     nb = firsts.shape[0]
-    return pl.pallas_call(
+    # SMEM carries i32 scalars; ship the u32 bit patterns bitcast to i32.
+    firsts_i32 = jax.lax.bitcast_convert_type(
+        jnp.asarray(firsts), jnp.int32)
+    (firsts_i32, shifts, widths, words), nb_pad = _pad_blocks(
+        [firsts_i32, shifts, widths, words], nb)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nb_pad // ROWS,),
+        in_specs=[pl.BlockSpec((ROWS, WORDS_PER_BLOCK_MAX),
+                               lambda g, *_: (g, 0))],
+        out_specs=pl.BlockSpec((ROWS, BLOCK), lambda g, *_: (g, 0)),
+    )
+    out = pl.pallas_call(
         _f32_kernel,
-        out_shape=jax.ShapeDtypeStruct((nb, BLOCK), jnp.float32),
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((1,), lambda b: (b,)),
-            pl.BlockSpec((1,), lambda b: (b,)),
-            pl.BlockSpec((1,), lambda b: (b,)),
-            pl.BlockSpec((1, WORDS_PER_BLOCK_MAX), lambda b: (b, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, BLOCK), lambda b: (b, 0)),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, BLOCK), jnp.float32),
+        grid_spec=grid_spec,
         interpret=interpret,
-    )(firsts, shifts, widths, words)
+    )(firsts_i32, shifts, widths, words)
+    return out[:nb]
