@@ -72,12 +72,33 @@ class QueryService:
         ``(promql, start_sec, step_sec, end_sec)``."""
         import numpy as np
 
-        results = []
+        plans = []
         for q in queries:
             promql, start_sec, step_sec, end_sec = q
             params = TimeStepParams(start_sec, step_sec, end_sec)
-            plan = self._parse_cached(promql, params)
-            results.append(self.execute_logical(plan, materialize=False))
+            plans.append(self._parse_cached(promql, params))
+
+        mesh_results = [None] * len(plans)
+        if self.mesh_engine is not None and self._mesh_eligible():
+            # one device program per shared plan signature (micro-batched
+            # step grids); unsupported plans fall through to the exec path
+            from filodb_tpu.query.model import QueryStats
+            stats_list = [QueryStats() for _ in plans]
+            mesh_results = self.mesh_engine.execute_many(
+                plans, self.memstore, self.dataset, stats_list)
+
+        results = []
+        for i, plan in enumerate(plans):
+            data = mesh_results[i]
+            if data is not None:
+                from filodb_tpu.query.exec.plan import ExecPlan
+                qcontext = QueryContext()
+                ExecPlan._enforce_limits(data, qcontext)
+                stats = stats_list[i]
+                stats.result_series = data.num_series
+                results.append(QueryResult(data, stats, qcontext.query_id))
+            else:
+                results.append(self.execute_logical(plan, materialize=False))
         # Coalesced device→host fetch: stack same-shaped lazy result buffers
         # into one device array per shape and fetch each stack once. A
         # per-query fetch costs a full RTT through the tunnel; one stacked

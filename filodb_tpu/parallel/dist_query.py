@@ -31,31 +31,40 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from filodb_tpu.query.engine.kernels import fdtype
 
 
-def _local_rate_partials(ts, vals, counts_mask, steps, window):
-    """Per-device window partials for the local (P_l, S_l) time block.
-
-    Returns [P_l, K, 6]: n, t_first, v_first_raw, t_last, v_last_raw,
-    internal counter-corrected increase. Missing => n=0 and sentinels.
-    """
-    dt = fdtype()
-    valid = counts_mask
-    v = jnp.where(valid, vals, 0.0).astype(dt)
-
+def _window_bounds(ts, steps, window):
     def bounds(tsp):
         hi = jnp.searchsorted(tsp, steps, side="right")
         lo = jnp.searchsorted(tsp, steps - window, side="right")
         return lo, hi
 
-    lo, hi = jax.vmap(bounds)(ts)
+    return jax.vmap(bounds)(ts)
+
+
+def _local_rate_partials(ts, vals, counts_mask, steps, window,
+                         counter: bool = True):
+    """Per-device window partials for the local (P_l, S_l) time block.
+
+    Returns [P_l, K, 6]: n, t_first, v_first_raw, t_last, v_last_raw,
+    internal (counter-corrected when ``counter``) increase. Missing => n=0
+    and sentinels.
+    """
+    dt = fdtype()
+    valid = counts_mask
+    v = jnp.where(valid, vals, 0.0).astype(dt)
+
+    lo, hi = _window_bounds(ts, steps, window)
     n = (hi - lo).astype(jnp.int32)
     has = hi > lo
 
-    prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-    both = valid & jnp.concatenate(
-        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
-    dropped = (v < prev) & both
-    corr = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
-    cv = v + corr
+    if counter:
+        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+        both = valid & jnp.concatenate(
+            [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+        dropped = (v < prev) & both
+        corr = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
+        cv = v + corr
+    else:
+        cv = v
 
     def g(x, idx):
         return jnp.take_along_axis(x, idx, axis=1)
@@ -71,12 +80,15 @@ def _local_rate_partials(ts, vals, counts_mask, steps, window):
                      axis=-1)
 
 
-def _combine_time_partials(parts, steps, window):
-    """Combine all-gathered time-block partials [dt, P, K, 6] → rate [P, K].
+def _combine_time_partials(parts, steps, window, mode: str = "rate",
+                           counter: bool = True):
+    """Combine all-gathered time-block partials [dt, P, K, 6] → [P, K].
 
     Sequential associative combine over the (static, small) time axis,
     handling counter resets across block boundaries, then Prometheus
-    extrapolation using the global first/last samples.
+    extrapolation using the global first/last samples. ``mode``: "rate",
+    "increase" (extrapolated, not divided by window) or "delta"
+    (non-counter increase, extrapolated).
     """
     dtt = fdtype()
     dt_blocks = parts.shape[0]
@@ -91,9 +103,12 @@ def _combine_time_partials(parts, steps, window):
     for d in range(dt_blocks):  # static unroll; dt is the mesh time size
         nd = parts[d, ..., 0] > 0
         vf, vl, inc = parts[d, ..., 2], parts[d, ..., 4], parts[d, ..., 5]
-        boundary = jnp.where(
-            nd & has_prev,
-            jnp.where(vf < v_prev, vf, vf - v_prev), 0.0)
+        if counter:
+            boundary = jnp.where(
+                nd & has_prev,
+                jnp.where(vf < v_prev, vf, vf - v_prev), 0.0)
+        else:
+            boundary = jnp.where(nd & has_prev, vf - v_prev, 0.0)
         total_inc = total_inc + inc + boundary
         v_first_g = jnp.where(nd & ~has_prev, vf, v_first_g)
         v_prev = jnp.where(nd, vl, v_prev)
@@ -108,32 +123,32 @@ def _combine_time_partials(parts, steps, window):
     avg_dur = sampled / jnp.maximum(n_tot - 1.0, 1.0)
     dur_start = t_first_s - range_start
     dur_end = range_end - t_last_s
-    dur_to_zero = jnp.where(total_inc > 0,
-                            sampled * v_first_g / jnp.maximum(total_inc, 1e-30),
-                            jnp.inf)
-    dur_start = jnp.minimum(dur_start, dur_to_zero)
+    if counter:
+        dur_to_zero = jnp.where(
+            total_inc > 0,
+            sampled * v_first_g / jnp.maximum(total_inc, 1e-30), jnp.inf)
+        dur_start = jnp.minimum(dur_start, dur_to_zero)
     threshold = avg_dur * 1.1
     extend = sampled
     extend = extend + jnp.where(dur_start < threshold, dur_start, avg_dur / 2)
     extend = extend + jnp.where(dur_end < threshold, dur_end, avg_dur / 2)
-    rate = total_inc * extend / jnp.maximum(sampled, 1e-10) \
-        / (window.astype(dtt) / 1000.0)
-    return jnp.where(n_tot >= 2, rate, jnp.nan)
+    ext = total_inc * extend / jnp.maximum(sampled, 1e-10)
+    if mode == "rate":
+        out = ext / (window.astype(dtt) / 1000.0)
+    else:  # increase / delta
+        out = ext
+    return jnp.where(n_tot >= 2, out, jnp.nan)
 
 
 def _local_simple_partials(ts, vals, counts_mask, steps, window):
     """Per-device partials for associative over-time functions:
-    [P_l, K, 5] = sum, count, min, max, last (+inf/-inf/0 sentinels)."""
+    [P_l, K, 7] = sum, count, min, max, last, t_last, sumsq
+    (+inf/-inf/0 sentinels)."""
     dt = fdtype()
     valid = counts_mask
     v = jnp.where(valid, vals, 0.0).astype(dt)
 
-    def bounds(tsp):
-        hi = jnp.searchsorted(tsp, steps, side="right")
-        lo = jnp.searchsorted(tsp, steps - window, side="right")
-        return lo, hi
-
-    lo, hi = jax.vmap(bounds)(ts)
+    lo, hi = _window_bounds(ts, steps, window)
 
     def g(x, idx):
         return jnp.take_along_axis(x, idx, axis=1)
@@ -143,8 +158,10 @@ def _local_simple_partials(ts, vals, counts_mask, steps, window):
             [jnp.zeros(x.shape[:-1] + (1,), x.dtype), jnp.cumsum(x, -1)], -1)
 
     csum = eprefix(v)
+    csum2 = eprefix(v * v)
     cnt = eprefix(valid.astype(dt))
     s = g(csum, hi) - g(csum, lo)
+    s2 = g(csum2, hi) - g(csum2, lo)
     n = g(cnt, hi) - g(cnt, lo)
     # blocked masked min/max (local S is small per device)
     S = ts.shape[1]
@@ -157,7 +174,15 @@ def _local_simple_partials(ts, vals, counts_mask, steps, window):
     last = jnp.where(has, g(v, jnp.maximum(hi - 1, 0)), 0.0)
     t_last = jnp.where(has, g(ts, jnp.maximum(hi - 1, 0)),
                        jnp.int32(-(2**31 - 1))).astype(dt)
-    return jnp.stack([s, n, mn, mx, last, t_last], axis=-1)
+    return jnp.stack([s, n, mn, mx, last, t_last, s2], axis=-1)
+
+
+def _sc_var(p):
+    n = p[..., 1].sum(0)
+    s = p[..., 0].sum(0)
+    s2 = p[..., 6].sum(0)
+    mean = s / jnp.maximum(n, 1.0)
+    return n, jnp.maximum(s2 / jnp.maximum(n, 1.0) - mean * mean, 0.0)
 
 
 _SIMPLE_COMBINE = {
@@ -176,53 +201,94 @@ _SIMPLE_COMBINE = {
         p[..., 1].sum(0) > 0,
         jnp.take_along_axis(p[..., 4], jnp.argmax(p[..., 5], axis=0)[None],
                             axis=0)[0], jnp.nan),
+    "last_sample": lambda p: jnp.where(
+        p[..., 1].sum(0) > 0,
+        jnp.take_along_axis(p[..., 4], jnp.argmax(p[..., 5], axis=0)[None],
+                            axis=0)[0], jnp.nan),
+    "present_over_time": lambda p: jnp.where(p[..., 1].sum(0) > 0, 1.0,
+                                             jnp.nan),
+    "stdvar_over_time": lambda p: jnp.where(
+        _sc_var(p)[0] > 0, _sc_var(p)[1], jnp.nan),
+    "stddev_over_time": lambda p: jnp.where(
+        _sc_var(p)[0] > 0, jnp.sqrt(_sc_var(p)[1]), jnp.nan),
 }
 
 
+def _group_reduce(res, gid_l, num_groups, agg):
+    """[P_l, K] per-series results → [G, K] grouped aggregate (psum/pmin/
+    pmax over the shard axis). NaN = series absent at that step."""
+    present = ~jnp.isnan(res)
+    contrib = jnp.where(present, res, 0.0)
+    if agg in ("min", "max"):
+        sentinel = jnp.inf if agg == "min" else -jnp.inf
+        marked = jnp.where(present, res, sentinel)
+        seg = (jax.ops.segment_min if agg == "min"
+               else jax.ops.segment_max)(marked, gid_l, num_groups)
+        seg = (lax.pmin if agg == "min" else lax.pmax)(seg, "shard")
+        gcnt = lax.psum(jax.ops.segment_sum(
+            present.astype(contrib.dtype), gid_l, num_groups), "shard")
+        return jnp.where(gcnt > 0, seg, jnp.nan)
+    gsum = lax.psum(jax.ops.segment_sum(contrib, gid_l, num_groups), "shard")
+    gcnt = lax.psum(jax.ops.segment_sum(
+        present.astype(contrib.dtype), gid_l, num_groups), "shard")
+    if agg in ("stddev", "stdvar"):
+        gsum2 = lax.psum(jax.ops.segment_sum(contrib * contrib, gid_l,
+                                             num_groups), "shard")
+        mean = gsum / jnp.maximum(gcnt, 1.0)
+        var = jnp.maximum(gsum2 / jnp.maximum(gcnt, 1.0) - mean * mean, 0.0)
+        out = var if agg == "stdvar" else jnp.sqrt(var)
+        return jnp.where(gcnt > 0, out, jnp.nan)
+    if agg == "avg":
+        return jnp.where(gcnt > 0, gsum / jnp.maximum(gcnt, 1.0), jnp.nan)
+    if agg == "count":
+        return jnp.where(gcnt > 0, gcnt, jnp.nan)
+    if agg == "group":
+        return jnp.where(gcnt > 0, 1.0, jnp.nan)
+    return jnp.where(gcnt > 0, gsum, jnp.nan)
+
+
+COUNTER_FNS = {"rate": ("rate", True), "increase": ("increase", True),
+               "delta": ("delta", False)}
+
+# aggs with associative mesh reductions
+MESH_AGG_OPS = ("sum", "avg", "count", "min", "max", "stddev", "stdvar",
+                "group")
+
+
 def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
-                               agg: str = "sum"):
-    """Distributed ``agg(fn(x[w])) by (g)`` over the (shard, time) mesh for
-    the associative over-time family — same SPMD shape as the rate pipeline:
+                               agg: str | None = "sum"):
+    """Distributed ``agg(fn(x[w])) by (g)`` over the (shard, time) mesh —
     time-block partials all-gathered over ``time``, label groups reduced via
-    segment_sum + ``psum`` over ``shard``."""
-    if fn == "rate":
-        return make_distributed_sum_rate(mesh, num_groups)
-    combine = _SIMPLE_COMBINE[fn]
+    segment ops + collectives over ``shard``. ``agg=None`` returns the
+    per-series [P, K] matrix (raw selectors / un-aggregated range functions),
+    sharded over the shard axis."""
+
+    def per_series(ts_l, vals_l, valid_l, steps_r, window_r):
+        if fn in COUNTER_FNS:
+            mode, counter = COUNTER_FNS[fn]
+            parts = _local_rate_partials(ts_l, vals_l, valid_l, steps_r,
+                                         window_r, counter=counter)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 6]
+            return _combine_time_partials(gathered, steps_r, window_r,
+                                          mode=mode, counter=counter)
+        combine = _SIMPLE_COMBINE[fn]
+        parts = _local_simple_partials(ts_l, vals_l, valid_l, steps_r,
+                                       window_r)
+        gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
+        return combine(gathered)
 
     def step(ts, vals, valid, group_ids, steps, window):
         def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
-            parts = _local_simple_partials(ts_l, vals_l, valid_l, steps_r,
-                                           window_r)
-            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 6]
-            res = combine(gathered)
-            present = ~jnp.isnan(res)
-            contrib = jnp.where(present, res, 0.0)
-            if agg in ("min", "max"):
-                sentinel = jnp.inf if agg == "min" else -jnp.inf
-                marked = jnp.where(present, res, sentinel)
-                seg = (jax.ops.segment_min if agg == "min"
-                       else jax.ops.segment_max)(marked, gid_l, num_groups)
-                seg = (lax.pmin if agg == "min" else lax.pmax)(seg, "shard")
-                gcnt = lax.psum(jax.ops.segment_sum(
-                    present.astype(contrib.dtype), gid_l, num_groups),
-                    "shard")
-                return jnp.where(gcnt > 0, seg, jnp.nan)
-            gsum = lax.psum(jax.ops.segment_sum(contrib, gid_l, num_groups),
-                            "shard")
-            gcnt = lax.psum(jax.ops.segment_sum(
-                present.astype(contrib.dtype), gid_l, num_groups), "shard")
-            if agg == "avg":
-                return jnp.where(gcnt > 0, gsum / jnp.maximum(gcnt, 1.0),
-                                 jnp.nan)
-            if agg == "count":
-                return jnp.where(gcnt > 0, gcnt, jnp.nan)
-            return jnp.where(gcnt > 0, gsum, jnp.nan)
+            res = per_series(ts_l, vals_l, valid_l, steps_r, window_r)
+            if agg is None:
+                return res
+            return _group_reduce(res, gid_l, num_groups, agg)
 
         return jax.shard_map(
             kernel, mesh=mesh,
             in_specs=(P("shard", "time"), P("shard", "time"),
                       P("shard", "time"), P("shard"), P(None), P()),
-            out_specs=P(None, None),
+            out_specs=P("shard", None) if agg is None else P(None, None),
             check_vma=False,
         )(ts, vals, valid, group_ids, steps, window)
 
