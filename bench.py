@@ -70,8 +70,7 @@ def _ensure_backend():
     attempts fail, so the bench always reports a number and the JSON shows
     exactly when and how each probe attempt failed."""
     if os.environ.get("FILODB_BENCH_CPU"):
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        _force_cpu()
         return "cpu", [{"outcome": "skipped", "detail": "FILODB_BENCH_CPU"}]
     attempts = int(os.environ.get("FILODB_BENCH_PROBE_ATTEMPTS", "4"))
     timeouts = [120, 240, 300, 300] + [300] * max(0, attempts - 4)
@@ -86,9 +85,19 @@ def _ensure_backend():
                          f"failed ({rec['outcome']})\n")
         if i + 1 < attempts:
             time.sleep(backoffs[min(i, len(backoffs) - 1)])
-    import jax
-    jax.config.update("jax_platforms", "cpu")
+    _force_cpu()
     return "cpu", log
+
+
+def _force_cpu():
+    """CPU fallback that cannot hang on the axon tunnel: the axon PJRT
+    factory (registered at interpreter start by sitecustomize) blocks every
+    backend init while the tunnel is down, even with jax_platforms=cpu —
+    drop it before the first backend initializes."""
+    import jax
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
 
 
 NUM_SHARDS = 8
@@ -118,7 +127,10 @@ def build_service():
                                               groups_per_shard=8))
     n = ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
     assert n == NUM_SERIES * NUM_SAMPLES, n
-    return QueryService(ms, "timeseries", NUM_SHARDS, spread=1), keys
+    # mesh engine first (single SPMD program per query batch; exec-tree
+    # fallback for unsupported shapes) — the TPU-native serving posture
+    return QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                        engine="mesh"), keys
 
 
 def run_queries(svc, n, start_sec, end_sec):

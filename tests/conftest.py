@@ -11,6 +11,7 @@ before any backend is initialized.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -22,6 +23,15 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ["JAX_ENABLE_X64"] = "1"
 
 import jax  # noqa: E402
+
+# The axon PJRT plugin registers itself at interpreter start (sitecustomize,
+# keyed on PALLAS_AXON_POOL_IPS) and its backend init hangs EVERY jax call
+# machine-wide while the TPU tunnel is down — even with JAX_PLATFORMS=cpu.
+# Unit tests must never depend on tunnel health: drop the factory before any
+# backend initializes.
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
