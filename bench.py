@@ -294,6 +294,7 @@ def main():
     end_sec = START_SEC + 1800 + 30 * 60  # 30-min range, 31 steps
 
     run_queries(svc, N_WARMUP, start_sec, end_sec)  # compile + warm caches
+    run_queries_concurrent(svc, N_QUERIES, start_sec, end_sec)  # batch compile
     seq_qps, p50_ms, p99_ms = run_queries(svc, N_QUERIES, start_sec, end_sec)
     conc_qps = run_queries_concurrent(svc, N_QUERIES, start_sec, end_sec)
     qps = max(seq_qps, conc_qps)
