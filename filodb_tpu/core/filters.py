@@ -44,20 +44,33 @@ def _compile_anchored(pattern: str) -> re.Pattern:
     return re.compile(f"^(?:{pattern})$")
 
 
+class _CompiledRegexMixin:
+    """Per-instance compiled-pattern memo: ``matches`` runs once per value
+    in index value-table scans — recompiling (even via the re module's
+    bounded cache) dominates the scan."""
+
+    def _rx(self) -> re.Pattern:
+        rx = self.__dict__.get("_rx_c")
+        if rx is None:
+            rx = _compile_anchored(self.pattern)
+            object.__setattr__(self, "_rx_c", rx)
+        return rx
+
+
 @dataclass(frozen=True)
-class EqualsRegex(Filter):
+class EqualsRegex(Filter, _CompiledRegexMixin):
     pattern: str
 
     def matches(self, value: str) -> bool:
-        return _compile_anchored(self.pattern).match(value) is not None
+        return self._rx().match(value) is not None
 
 
 @dataclass(frozen=True)
-class NotEqualsRegex(Filter):
+class NotEqualsRegex(Filter, _CompiledRegexMixin):
     pattern: str
 
     def matches(self, value: str) -> bool:
-        return _compile_anchored(self.pattern).match(value) is None
+        return self._rx().match(value) is None
 
 
 @dataclass(frozen=True)

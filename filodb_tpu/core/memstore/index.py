@@ -100,6 +100,18 @@ class FrozenLabel:
         return FrozenLabel(voff, vblob, poff, pids)
 
 
+def _filter_cache_key(flt):
+    """Stable per-predicate memo key for value-table scans (None = no memo)."""
+    from filodb_tpu.core.filters import NotEquals, NotEqualsRegex
+    if isinstance(flt, EqualsRegex):
+        return ("re", flt.pattern)
+    if isinstance(flt, NotEqualsRegex):
+        return ("nre", flt.pattern)
+    if isinstance(flt, NotEquals):
+        return ("ne", flt.value)
+    return None
+
+
 def _from_set(s: set[int]) -> np.ndarray:
     a = np.fromiter(s, np.int64, len(s))
     a.sort()
@@ -110,6 +122,8 @@ class PartKeyIndex:
     """Tag index for one shard."""
 
     def __init__(self, schemas=None):
+        import os
+
         # schema registry for lazy blob -> PartKey materialization
         self._schemas = schemas
         # tail tier: label -> value -> set of partIds (new since freeze)
@@ -126,6 +140,23 @@ class PartKeyIndex:
         self._start: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
         self._end: np.ndarray = np.full(_INIT_CAP, INGESTING, np.int64)
         self._count = 0
+        # native postings store (C++ TagIndex): owns label→value→pid postings
+        # for series-create/equals/intersect hot paths; Python keeps times,
+        # tombstones and key blobs. Falls back to the pure-Python tiers when
+        # the toolchain is absent (or FILODB_NO_NATIVE_INDEX is set).
+        self._nt = None
+        if not os.environ.get("FILODB_NO_NATIVE_INDEX"):
+            try:
+                from filodb_tpu.memory.native import TagIndexNative
+                self._nt = TagIndexNative()
+            except Exception:
+                self._nt = None
+        # (label, predicate-key) -> (generation, ids): regex/value-scan memo
+        self._vscan_cache: dict = {}
+        # filters tuple -> (blob, blob addr, npairs): equals-query memo
+        self._pairs_cache: dict = {}
+        # (starts ref, ends ref, starts addr, ends addr, len) memo
+        self._bounds_addr: tuple | None = None
 
     def __len__(self) -> int:
         return self._count
@@ -147,6 +178,15 @@ class PartKeyIndex:
         self._part_keys[part_id] = key
         self._start[part_id] = start_time
         self._end[part_id] = end_time
+        if self._nt is not None:
+            if part_id in self._deleted:
+                # pid re-created after a remove: stale postings for the old
+                # key would resurrect under a new key — purge them first
+                self._nt.purge_pid(part_id)
+            self._deleted.discard(part_id)
+            from filodb_tpu.core.memstore.native_shard import part_key_blob
+            self._nt.add(part_id, part_key_blob(key))
+            return
         self._deleted.discard(part_id)
         for name, value in key.labels:
             self._tail[name][value].add(part_id)
@@ -157,10 +197,32 @@ class PartKeyIndex:
         """Register postings from ``key`` but keep only the canonical blob
         in the key table (materialized lazily on demand): at high
         cardinality per-series PartKey objects dominate resident memory."""
+        if self._nt is not None:
+            self._ensure(part_id)
+            if self._part_keys[part_id] is None:
+                self._count += 1
+            self._start[part_id] = start_time
+            self._end[part_id] = end_time
+            if part_id in self._deleted:
+                self._nt.purge_pid(part_id)
+                self._deleted.discard(part_id)
+            self._nt.add(part_id, blob)
+            self._part_keys[part_id] = blob
+            return
         self.add_part_key(part_id, key, start_time, end_time)
         self._part_keys[part_id] = blob
 
     def remove_part_key(self, part_id: int) -> None:
+        if self._nt is not None:
+            if part_id >= len(self._part_keys) \
+                    or self._part_keys[part_id] is None:
+                return
+            self._deleted.add(part_id)  # postings masked on query
+            self._part_keys[part_id] = None
+            self._start[part_id] = INGESTING
+            self._end[part_id] = INGESTING
+            self._count -= 1
+            return
         key = self.part_key(part_id)
         if key is None:
             return
@@ -202,6 +264,8 @@ class PartKeyIndex:
     # ---- filter evaluation ----------------------------------------------
 
     def _equals_ids(self, col: str, value: str) -> np.ndarray:
+        if self._nt is not None:
+            return self._nt.equals(col, value).astype(np.int64)
         parts = []
         fr = self._frozen.get(col)
         if fr is not None:
@@ -219,8 +283,28 @@ class PartKeyIndex:
             return parts[0]
         return np.unique(np.concatenate(parts))
 
-    def _value_scan_ids(self, col: str, match) -> np.ndarray:
-        """Union postings of every value matching the predicate."""
+    def _value_scan_ids(self, col: str, match,
+                        cache_key=None) -> np.ndarray:
+        """Union postings of every value matching the predicate. Native
+        path memoizes per (label, predicate) keyed on the postings
+        generation — dashboards repeat the same regex scans."""
+        if self._nt is not None:
+            gen = self._nt.generation
+            ck = (col, cache_key) if cache_key is not None else None
+            if ck is not None:
+                hit = self._vscan_cache.get(ck)
+                if hit is not None and hit[0] == gen:
+                    return hit[1]
+            values = self._nt.values(col)
+            vids = np.fromiter(
+                (i for i, v in enumerate(values) if match(v)), np.int32)
+            ids = self._nt.union_values(col, vids).astype(np.int64) \
+                if len(vids) else _EMPTY
+            if ck is not None:
+                if len(self._vscan_cache) >= 128:
+                    self._vscan_cache.pop(next(iter(self._vscan_cache)))
+                self._vscan_cache[ck] = (gen, ids)
+            return ids
         parts = []
         fr = self._frozen.get(col)
         if fr is not None:
@@ -248,10 +332,13 @@ class PartKeyIndex:
             return np.unique(np.concatenate(parts))
         # EqualsRegex that can't match an absent label ("" doesn't match):
         # the per-label value scan is a sound positive filter
-        return self._value_scan_ids(f.column, flt.matches)
+        return self._value_scan_ids(f.column, flt.matches,
+                                    cache_key=_filter_cache_key(flt))
 
     def _label_all_ids(self, col: str) -> np.ndarray:
         """Every pid that has ANY value for this label."""
+        if self._nt is not None:
+            return self._nt.label_all(col).astype(np.int64)
         parts = []
         fr = self._frozen.get(col)
         if fr is not None and len(fr.pids):
@@ -296,23 +383,54 @@ class PartKeyIndex:
     ) -> list[int]:
         """Intersect filter postings, then apply the time overlap predicate
         (reference ``partIdsFromFilters:494``). Set ops while everything is
-        in the mutable tail; sorted-array ops once a frozen tier exists."""
-        if not self._frozen:
+        in the mutable tail; sorted-array ops once a frozen tier exists;
+        pure-Equals batches intersect natively (galloping, one C++ call)."""
+        if self._nt is not None and not self._deleted and filters \
+                and all(type(f.filter) is Equals for f in filters):
+            # all-Equals fast path: intersection + time predicate in one
+            # native call (the dominant query shape — shard-key lookups);
+            # encoded pair buffers and raw bounds addresses are cached
+            key = tuple((f.column, f.filter.value) for f in filters)
+            ent = self._pairs_cache.get(key)
+            if ent is None:
+                from filodb_tpu.memory.native import TagIndexNative
+                blob = TagIndexNative.encode_pairs(list(key))
+                ent = (blob, TagIndexNative.addr_of(blob), len(key))
+                if len(self._pairs_cache) >= 256:
+                    self._pairs_cache.pop(next(iter(self._pairs_cache)))
+                self._pairs_cache[key] = ent
+            ba = self._bounds_addr
+            if ba is None or ba[0] is not self._start:
+                ba = self._bounds_addr = (
+                    self._start, self._end, self._start.ctypes.data,
+                    self._end.ctypes.data, len(self._start))
+            return self._nt.query_equals(ent[1], ent[2], ba[2], ba[3],
+                                         ba[4], start_time, end_time)
+        if self._nt is None and not self._frozen:
             return self._part_ids_set_path(filters, start_time, end_time)
         result: np.ndarray | None = None
         negatives: list[ColumnFilter] = []
+        eq_pairs: list[tuple[str, str]] = []
+        others: list[ColumnFilter] = []
         for f in filters:
             flt = f.filter
-            positive = isinstance(flt, (Equals, In)) or (
-                isinstance(flt, EqualsRegex) and not flt.matches(""))
-            if positive:
-                ids = self._ids_for_filter(f)
-                result = ids if result is None \
-                    else np.intersect1d(result, ids, assume_unique=True)
-                if not len(result):
-                    return []
+            if self._nt is not None and isinstance(flt, Equals):
+                eq_pairs.append((f.column, flt.value))
+            elif isinstance(flt, (Equals, In)) or (
+                    isinstance(flt, EqualsRegex) and not flt.matches("")):
+                others.append(f)
             else:
                 negatives.append(f)
+        if eq_pairs:
+            result = self._nt.intersect_equals(eq_pairs).astype(np.int64)
+            if not len(result):
+                return []
+        for f in others:
+            ids = self._ids_for_filter(f)
+            result = ids if result is None \
+                else np.intersect1d(result, ids, assume_unique=True)
+            if not len(result):
+                return []
         if result is None:
             result = self._all_live_ids()
         if self._deleted and len(result):
@@ -326,7 +444,9 @@ class PartKeyIndex:
             # when the filter matches "".
             if not len(result):
                 break
-            matched = self._value_scan_ids(f.column, f.filter.matches)
+            matched = self._value_scan_ids(
+                f.column, f.filter.matches,
+                cache_key=_filter_cache_key(f.filter))
             keep = result[np.isin(result, matched)] if len(matched) \
                 else result[:0]
             if f.filter.matches(""):
@@ -338,7 +458,7 @@ class PartKeyIndex:
         if not len(result):
             return []
         ok = (self._start[result] <= end_time) & (self._end[result] >= start_time)
-        return [int(i) for i in result[ok]]
+        return result[ok].tolist()
 
     def _part_ids_set_path(self, filters, start_time, end_time) -> list[int]:
         result: set[int] | None = None
@@ -376,6 +496,8 @@ class PartKeyIndex:
     # ---- label introspection --------------------------------------------
 
     def label_names(self) -> list[str]:
+        if self._nt is not None:
+            return sorted(set(self._nt.labels()))
         names = {k for k, v in self._tail.items() if any(v.values())}
         names |= set(self._frozen.keys())
         return sorted(names)
@@ -383,6 +505,9 @@ class PartKeyIndex:
     def label_values(self, label: str,
                      filters: list[ColumnFilter] | None = None,
                      start_time: int = 0, end_time: int = INGESTING) -> list[str]:
+        if self._nt is not None:
+            return self._label_values_native(label, filters, start_time,
+                                             end_time)
         fr = self._frozen.get(label)
         tail = self._tail.get(label)
         if fr is None and not tail:
@@ -414,6 +539,34 @@ class PartKeyIndex:
                     out.add(value)
         return sorted(out)
 
+    def _label_values_native(self, label, filters, start_time,
+                             end_time) -> list[str]:
+        values = self._nt.values(label)
+        if not values:
+            return []
+        if not filters:
+            if not self._deleted:
+                return sorted(set(values))
+            dead = _from_set(self._deleted)
+            out = set()
+            for v in values:
+                sl = self._nt.equals(label, v).astype(np.int64)
+                if len(sl) and not np.isin(sl, dead,
+                                           assume_unique=True).all():
+                    out.add(v)
+            return sorted(out)
+        ids = np.asarray(
+            self.part_ids_from_filters(filters, start_time, end_time),
+            np.int64)
+        if not len(ids):
+            return []
+        out = set()
+        for v in values:
+            sl = self._nt.equals(label, v).astype(np.int64)
+            if len(sl) and np.isin(sl, ids).any():
+                out.add(v)
+        return sorted(out)
+
     # ---- snapshot support -----------------------------------------------
 
     def frozen_labels(self):
@@ -421,6 +574,14 @@ class PartKeyIndex:
         deletions applied — the snapshot writer's view. A frozen label with
         no tail additions and no deletions is yielded as-is (re-serialized
         wholesale, no per-value work)."""
+        if self._nt is not None:
+            dead = np.asarray(sorted(self._deleted), np.int32) \
+                if self._deleted else np.empty(0, np.int32)
+            for name in sorted(set(self._nt.labels())):
+                voff, vblob, poff, pids = self._nt.export_label(name, dead)
+                if len(voff) > 1:
+                    yield name, FrozenLabel(voff, vblob, poff, pids)
+            return
         dead = _from_set(self._deleted) if self._deleted else None
         labels = set(self._tail.keys()) | set(self._frozen.keys())
         for name in sorted(labels):
@@ -450,4 +611,9 @@ class PartKeyIndex:
                 yield name, FrozenLabel.build(pairs)
 
     def load_frozen(self, label: str, frozen: FrozenLabel) -> None:
+        if self._nt is not None:
+            self._nt.load_label(label, frozen.voff,
+                                bytes(frozen.vblob), frozen.poff,
+                                frozen.pids)
+            return
         self._frozen[label] = frozen

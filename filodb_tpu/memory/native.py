@@ -145,6 +145,48 @@ def _load():
         lib.part_evict_flushed.restype = i32
         lib.part_seed_floor.argtypes = [vp, i32, i64]
         lib.part_free.argtypes = [vp, i32]
+        # TagIndex (native part-key inverted index hot paths)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        cp = ctypes.c_char_p
+        lib.tagindex_create.restype = vp
+        lib.tagindex_destroy.argtypes = [vp]
+        lib.tagindex_add.argtypes = [vp, i32, u8p, i32]
+        lib.tagindex_add.restype = i32
+        lib.tagindex_purge_pid.argtypes = [vp, i32]
+        lib.tagindex_add_batch.argtypes = [vp, ctypes.POINTER(i32), i64,
+                                           u8p, i64p]
+        lib.tagindex_add_batch.restype = i32
+        lib.tagindex_equals.argtypes = [vp, cp, i64, cp, i64, i32p, i64]
+        lib.tagindex_equals.restype = i64
+        # raw-address args: the equals fast path passes cached integer
+        # pointers to skip per-call ctypes marshalling
+        lib.tagindex_query_equals.argtypes = [vp, ctypes.c_void_p, i32,
+                                              ctypes.c_void_p,
+                                              ctypes.c_void_p,
+                                              i64, i64, i64,
+                                              ctypes.c_void_p, i64]
+        lib.tagindex_query_equals.restype = i64
+        lib.tagindex_intersect_equals.argtypes = [vp, u8p, i32, i32p, i64]
+        lib.tagindex_intersect_equals.restype = i64
+        lib.tagindex_label_all.argtypes = [vp, cp, i64, i32p, i64]
+        lib.tagindex_label_all.restype = i64
+        lib.tagindex_values_size.argtypes = [vp, cp, i64]
+        lib.tagindex_values_size.restype = i64
+        lib.tagindex_values.argtypes = [vp, cp, i64, u8p]
+        lib.tagindex_union_values.argtypes = [vp, cp, i64, i32p, i64, i32p,
+                                              i64]
+        lib.tagindex_union_values.restype = i64
+        lib.tagindex_num_labels.argtypes = [vp]
+        lib.tagindex_num_labels.restype = i64
+        lib.tagindex_labels_size.argtypes = [vp]
+        lib.tagindex_labels_size.restype = i64
+        lib.tagindex_labels.argtypes = [vp, u8p]
+        lib.tagindex_export_sizes.argtypes = [vp, cp, i64, i32p, i64, i64p]
+        lib.tagindex_export_sizes.restype = i64
+        lib.tagindex_export_label.argtypes = [vp, u32p, u8p, i64p, i32p]
+        lib.tagindex_load_label.argtypes = [vp, cp, i64, u32p, i64, u8p, i64,
+                                            i64p, i32p, i64]
         _lib = lib
         HAVE_NATIVE = True
         return lib
@@ -264,3 +306,228 @@ class NativeArena:
             self.close()
         except Exception:
             pass
+
+
+class TagIndexNative:
+    """Handle on a C++ TagIndex — the postings store behind PartKeyIndex
+    (reference ``PartKeyLuceneIndex`` postings + query hot paths,
+    ``PartKeyLuceneIndex.scala:455,494``). Times and tombstones stay on the
+    Python side; this holds label→value→pid postings only."""
+
+    __slots__ = ("_lib", "_h", "_buf", "_buf_addr", "_lock", "_pend",
+                 "generation")
+
+    _FLUSH_AT = 4096
+
+    def __init__(self):
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._h = self._lib.tagindex_create()
+        self._buf = np.empty(4096, np.int32)
+        self._buf_addr = self._buf.ctypes.data
+        # ctypes releases the GIL and the C++ maps are not concurrent-safe
+        # (ingest thread writes while query threads read) — serialize calls,
+        # the native analog of ChunkMap's read/write latch
+        self._lock = threading.Lock()
+        # buffered adds, flushed in one native batch call on any read (the
+        # Lucene analog: IndexWriter RAM buffer + NRT refresh — here with
+        # strict read-your-writes, PartKeyLuceneIndex.startFlushThread:167).
+        # One list of (pid, blob) tuples: a single GIL-atomic append per add
+        # lets the single-writer ingest thread skip the lock entirely.
+        self._pend: list[tuple[int, bytes]] = []
+        # bumps on every postings mutation; callers key value-scan caches
+        self.generation = 0
+
+    def close(self):
+        if self._h:
+            self._lib.tagindex_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def add(self, pid: int, key_blob: bytes) -> None:
+        self._pend.append((pid, key_blob))
+        self.generation += 1
+        if len(self._pend) >= self._FLUSH_AT:
+            with self._lock:
+                self._flush()
+
+    def _flush(self) -> None:
+        """Push buffered adds into the native index (caller holds _lock)."""
+        if not self._pend:
+            return
+        pend, self._pend = self._pend, []  # atomic swap vs concurrent adds
+        pids = np.fromiter((p for p, _ in pend), np.int32, len(pend))
+        blob = b"".join(b for _, b in pend)
+        offs = np.zeros(len(pend) + 1, np.int64)
+        np.cumsum([len(b) for _, b in pend], out=offs[1:])
+        rc = self._lib.tagindex_add_batch(
+            self._h, _as_ptr(pids, ctypes.c_int32), len(pids),
+            ctypes.cast(blob, ctypes.POINTER(ctypes.c_uint8)),
+            _as_ptr(offs, ctypes.c_int64))
+        if rc != 0:
+            raise ValueError("malformed part-key blob in batch")
+
+    def purge_pid(self, pid: int) -> None:
+        with self._lock:
+            self._flush()
+            self.generation += 1
+            self._lib.tagindex_purge_pid(self._h, pid)
+
+    def _out(self, fn, *args) -> np.ndarray:
+        n = fn(self._h, *args, _as_ptr(self._buf, ctypes.c_int32),
+               len(self._buf))
+        if n < 0:
+            self._buf = np.empty(int(-n) + 64, np.int32)
+            self._buf_addr = self._buf.ctypes.data
+            n = fn(self._h, *args, _as_ptr(self._buf, ctypes.c_int32),
+                   len(self._buf))
+        return self._buf[: int(n)].copy()
+
+    def equals(self, label: str, value: str) -> np.ndarray:
+        with self._lock:
+            self._flush()
+            lb, vb = label.encode(), value.encode()
+            return self._out(self._lib.tagindex_equals, lb, len(lb), vb, len(vb))
+
+    @staticmethod
+    def encode_pairs(pairs: list[tuple[str, str]]) -> bytes:
+        import struct
+        buf = bytearray()
+        for k, v in pairs:
+            kb, vb = k.encode(), v.encode()
+            buf += struct.pack("<H", len(kb)) + kb
+            buf += struct.pack("<H", len(vb)) + vb
+        return bytes(buf)
+
+    @staticmethod
+    def addr_of(buf) -> int:
+        """Stable raw address of a bytes object / numpy array (caller must
+        keep the object alive for as long as the address is used)."""
+        if isinstance(buf, bytes):
+            return ctypes.cast(buf, ctypes.c_void_p).value or 0
+        return buf.ctypes.data
+
+    def intersect_equals(self, pairs: list[tuple[str, str]]) -> np.ndarray:
+        with self._lock:
+            self._flush()
+            bb = self.encode_pairs(pairs)
+            return self._out(
+                lambda h, o, c: self._lib.tagindex_intersect_equals(
+                    h, ctypes.cast(bb, ctypes.POINTER(ctypes.c_uint8)),
+                    len(pairs), o, c))
+
+    def query_equals(self, pairs_addr: int, npairs: int,
+                     starts_addr: int, ends_addr: int, bounds_len: int,
+                     start_t: int, end_t: int) -> list[int]:
+        """Full equals fast path: postings intersection + time predicate in
+        one native call; returns live pids as a list. Callers pass raw
+        addresses (``addr_of``) and must keep the backing objects alive."""
+        with self._lock:
+            if self._pend:
+                self._flush()
+            n = self._lib.tagindex_query_equals(
+                self._h, pairs_addr, npairs, starts_addr, ends_addr,
+                bounds_len, start_t, end_t, self._buf_addr, len(self._buf))
+            if n < 0:
+                self._buf = np.empty(int(-n) + 64, np.int32)
+                self._buf_addr = self._buf.ctypes.data
+                n = self._lib.tagindex_query_equals(
+                    self._h, pairs_addr, npairs, starts_addr, ends_addr,
+                    bounds_len, start_t, end_t, self._buf_addr,
+                    len(self._buf))
+            return self._buf[: int(n)].tolist()
+
+    def label_all(self, label: str) -> np.ndarray:
+        with self._lock:
+            self._flush()
+            lb = label.encode()
+            return self._out(self._lib.tagindex_label_all, lb, len(lb))
+
+    def values(self, label: str) -> list[str]:
+        with self._lock:
+            self._flush()
+            lb = label.encode()
+            sz = self._lib.tagindex_values_size(self._h, lb, len(lb))
+            if sz == 0:
+                return []
+            raw = np.empty(int(sz), np.uint8)
+            self._lib.tagindex_values(self._h, lb, len(lb),
+                                      _as_ptr(raw, ctypes.c_uint8))
+            out = []
+            data = raw.tobytes()
+            off = 0
+            while off < len(data):
+                n = int.from_bytes(data[off : off + 4], "little")
+                off += 4
+                out.append(data[off : off + n].decode())
+                off += n
+            return out
+
+    def union_values(self, label: str, vids: np.ndarray) -> np.ndarray:
+        with self._lock:
+            self._flush()
+            lb = label.encode()
+            vids = np.ascontiguousarray(vids, np.int32)
+            return self._out(
+                lambda h, o, c: self._lib.tagindex_union_values(
+                    h, lb, len(lb), _as_ptr(vids, ctypes.c_int32), len(vids),
+                    o, c))
+
+    def labels(self) -> list[str]:
+        with self._lock:
+            self._flush()
+            sz = self._lib.tagindex_labels_size(self._h)
+            if sz == 0:
+                return []
+            raw = np.empty(int(sz), np.uint8)
+            self._lib.tagindex_labels(self._h, _as_ptr(raw, ctypes.c_uint8))
+            out = []
+            data = raw.tobytes()
+            off = 0
+            while off < len(data):
+                n = int.from_bytes(data[off : off + 4], "little")
+                off += 4
+                out.append(data[off : off + n].decode())
+                off += n
+            return out
+
+    def export_label(self, label: str, deleted: np.ndarray):
+        """(voff, vblob, poff, pids) snapshot arrays for one label, with
+        ``deleted`` (sorted int32) pids dropped. Empty labels yield nv=0."""
+        with self._lock:
+            self._flush()
+            lb = label.encode()
+            deleted = np.ascontiguousarray(deleted, np.int32)
+            sizes = np.empty(3, np.int64)
+            self._lib.tagindex_export_sizes(
+                self._h, lb, len(lb), _as_ptr(deleted, ctypes.c_int32),
+                len(deleted), _as_ptr(sizes, ctypes.c_int64))
+            nv, vlen, npids = (int(x) for x in sizes)
+            voff = np.empty(nv + 1, np.uint32)
+            vblob = np.empty(vlen, np.uint8)
+            poff = np.empty(nv + 1, np.int64)
+            pids = np.empty(npids, np.int32)
+            self._lib.tagindex_export_label(
+                self._h, _as_ptr(voff, ctypes.c_uint32),
+                _as_ptr(vblob, ctypes.c_uint8), _as_ptr(poff, ctypes.c_int64),
+                _as_ptr(pids, ctypes.c_int32))
+            return voff, vblob.tobytes(), poff, pids
+
+    def load_label(self, label: str, voff, vblob: bytes, poff, pids) -> None:
+        with self._lock:
+            lb = label.encode()
+            voff = np.ascontiguousarray(voff, np.uint32)
+            poff = np.ascontiguousarray(poff, np.int64)
+            pids = np.ascontiguousarray(pids, np.int32)
+            self._lib.tagindex_load_label(
+                self._h, lb, len(lb), _as_ptr(voff, ctypes.c_uint32),
+                len(voff) - 1,
+                ctypes.cast(vblob, ctypes.POINTER(ctypes.c_uint8)), len(vblob),
+                _as_ptr(poff, ctypes.c_int64), _as_ptr(pids, ctypes.c_int32),
+                len(pids))

@@ -9,6 +9,7 @@
 //
 // Build: make -C native   (produces libfilodb_native.so)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -899,6 +900,526 @@ void part_free(void* cp, int32_t pid) {
     p.cols.shrink_to_fit();
     p.sealed.clear();
     p.sealed.shrink_to_fit();
+}
+
+// ---------------------------------------------------------------------------
+// TagIndex: native part-key inverted index hot paths.
+//
+// Counterpart of the reference's PartKeyLuceneIndex postings + query ops
+// (core/src/main/scala/filodb.core/memstore/PartKeyLuceneIndex.scala:455,494)
+// and its JMH PartKeyIndexBenchmark. Two tiers, mirroring the Python
+// structure in filodb_tpu/core/memstore/index.py:
+//   - frozen: per label, a sorted value table (offset-indexed bytes) and a
+//     flat pid array — bulk-loaded from index snapshots, binary-searched;
+//   - tail: per label, value -> pid vector (pids ascend with creation order).
+// Liveness/tombstones and [start,end] time bounds stay on the Python side
+// (numpy masks); this structure is postings only.
+
+namespace {
+
+struct FrozenLab {
+    std::vector<uint32_t> voff;  // [nv+1]
+    std::string vblob;
+    std::vector<int64_t> poff;   // [nv+1]
+    std::vector<int32_t> pids;   // sorted within each value slice
+
+    int64_t nv() const {
+        return voff.empty() ? 0 : (int64_t)voff.size() - 1;
+    }
+    int64_t find(const char* v, int64_t len) const {
+        int64_t lo = 0, hi = nv();
+        while (lo < hi) {
+            int64_t mid = (lo + hi) / 2;
+            const char* mv = vblob.data() + voff[mid];
+            int64_t ml = (int64_t)voff[mid + 1] - voff[mid];
+            int cmp = std::memcmp(mv, v, ml < len ? ml : len);
+            bool less = cmp < 0 || (cmp == 0 && ml < len);
+            if (less) lo = mid + 1; else hi = mid;
+        }
+        if (lo < nv()) {
+            const char* mv = vblob.data() + voff[lo];
+            int64_t ml = (int64_t)voff[lo + 1] - voff[lo];
+            if (ml == len && std::memcmp(mv, v, len) == 0) return lo;
+        }
+        return -1;
+    }
+};
+
+struct TagLab {
+    FrozenLab frozen;
+    std::unordered_map<std::string, std::vector<int32_t>> tail;
+};
+
+struct TagIndex {
+    std::unordered_map<std::string, int32_t> label_ids;
+    std::vector<std::string> label_names;
+    std::vector<TagLab> labs;
+    // merged-export staging (sizes call builds; export call copies+clears)
+    FrozenLab exp_tmp;
+    std::vector<int32_t> scratch;
+
+    TagLab* find_lab(const char* name, int64_t len) {
+        auto it = label_ids.find(std::string(name, len));
+        return it == label_ids.end() ? nullptr : &labs[it->second];
+    }
+    TagLab& get_lab(const std::string& name) {
+        auto it = label_ids.find(name);
+        if (it != label_ids.end()) return labs[it->second];
+        label_ids.emplace(name, (int32_t)labs.size());
+        label_names.push_back(name);
+        labs.emplace_back();
+        return labs.back();
+    }
+};
+
+// merge two sorted unique ranges into out (unique)
+static int64_t merge2(const int32_t* a, int64_t na, const int32_t* b,
+                      int64_t nb, int32_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        int32_t x = a[i], y = b[j];
+        if (x < y) { out[k++] = x; i++; }
+        else if (y < x) { out[k++] = y; j++; }
+        else { out[k++] = x; i++; j++; }
+    }
+    while (i < na) out[k++] = a[i++];
+    while (j < nb) out[k++] = b[j++];
+    return k;
+}
+
+// postings of (lab, value) merged across tiers into vec (sorted unique)
+static void equals_into(TagLab* lab, const char* v, int64_t vl,
+                        std::vector<int32_t>& vec) {
+    vec.clear();
+    const int32_t* fp = nullptr;
+    int64_t fn = 0;
+    int64_t vi = lab->frozen.find(v, vl);
+    if (vi >= 0) {
+        fp = lab->frozen.pids.data() + lab->frozen.poff[vi];
+        fn = lab->frozen.poff[vi + 1] - lab->frozen.poff[vi];
+    }
+    auto it = lab->tail.find(std::string(v, vl));
+    const int32_t* tp = nullptr;
+    int64_t tn = 0;
+    if (it != lab->tail.end()) {
+        tp = it->second.data();
+        tn = (int64_t)it->second.size();
+    }
+    vec.resize(fn + tn);
+    vec.resize(merge2(fp, fn, tp, tn, vec.data()));
+}
+
+static int64_t copy_out(const std::vector<int32_t>& vec, int32_t* out,
+                        int64_t cap) {
+    int64_t n = (int64_t)vec.size();
+    if (n > cap) return -n;  // caller re-calls with a bigger buffer
+    std::memcpy(out, vec.data(), n * sizeof(int32_t));
+    return n;
+}
+
+}  // namespace
+
+void* tagindex_create() { return new TagIndex(); }
+void tagindex_destroy(void* h) { delete static_cast<TagIndex*>(h); }
+
+// key blob: [u16 schema][u16 nl][(u16 kl, k bytes)(u16 vl, v bytes)]*
+// (canonical part-key layout shared with ShardCore records)
+int32_t tagindex_add(void* h, int32_t pid, const uint8_t* key, int32_t len) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    if (len < 4) return -1;
+    int64_t o = 2;
+    uint16_t nl = rd_u16(key + o);
+    o += 2;
+    for (uint16_t i = 0; i < nl; i++) {
+        if (o + 2 > len) return -1;
+        uint16_t kl = rd_u16(key + o);
+        o += 2;
+        if (o + kl + 2 > len) return -1;
+        std::string name((const char*)key + o, kl);
+        o += kl;
+        uint16_t vl = rd_u16(key + o);
+        o += 2;
+        if (o + vl > len) return -1;
+        TagLab& lab = ix->get_lab(name);
+        auto& vec = lab.tail[std::string((const char*)key + o, vl)];
+        o += vl;
+        if (vec.empty() || vec.back() < pid) {
+            vec.push_back(pid);
+        } else if (vec.back() != pid) {  // out-of-order (restore/readd)
+            auto it = std::lower_bound(vec.begin(), vec.end(), pid);
+            if (it == vec.end() || *it != pid) vec.insert(it, pid);
+        }
+    }
+    return 0;
+}
+
+// remove pid from every posting list (rare: pid re-created after eviction
+// with a different key; normal removals are Python-side tombstones).
+// Frozen arrays are physically compacted to keep every slice sorted+unique.
+void tagindex_purge_pid(void* h, int32_t pid) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    for (auto& lab : ix->labs) {
+        for (auto& kv : lab.tail) {
+            auto& vec = kv.second;
+            auto it = std::lower_bound(vec.begin(), vec.end(), pid);
+            if (it != vec.end() && *it == pid) vec.erase(it);
+        }
+        auto& fr = lab.frozen;
+        bool hit = false;
+        for (int64_t vi = 0; vi < fr.nv() && !hit; vi++) {
+            const int32_t* b = fr.pids.data() + fr.poff[vi];
+            const int32_t* e = fr.pids.data() + fr.poff[vi + 1];
+            const int32_t* it = std::lower_bound(b, e, pid);
+            hit = it != e && *it == pid;
+        }
+        if (!hit) continue;
+        int64_t w = 0;
+        std::vector<int64_t> npoff(1, 0);
+        for (int64_t vi = 0; vi < fr.nv(); vi++) {
+            for (int64_t k = fr.poff[vi]; k < fr.poff[vi + 1]; k++)
+                if (fr.pids[k] != pid) fr.pids[w++] = fr.pids[k];
+            npoff.push_back(w);
+        }
+        fr.pids.resize(w);
+        fr.poff = std::move(npoff);
+    }
+}
+
+int64_t tagindex_equals(void* h, const char* labn, int64_t ll,
+                        const char* v, int64_t vl, int32_t* out,
+                        int64_t cap) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    if (!lab) return 0;
+    equals_into(lab, v, vl, ix->scratch);
+    return copy_out(ix->scratch, out, cap);
+}
+
+// pairs: [(u16 kl, k)(u16 vl, v)]*; intersection of equals postings.
+// Zero-materialization: each filter's postings stay as its (frozen, tail)
+// sorted range pair; the smallest filter's merged enumeration is membership-
+// checked against every other filter's two ranges with resumable cursors.
+int64_t tagindex_intersect_equals(void* h, const uint8_t* pairs,
+                                  int32_t npairs, int32_t* out, int64_t cap) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    struct Ranges {
+        const int32_t* fp; int64_t fn;  // frozen slice
+        const int32_t* tp; int64_t tn;  // tail vector
+        int64_t fi = 0, ti = 0;         // resumable cursors
+        int64_t total() const { return fn + tn; }
+        bool contains(int32_t x) {
+            // ascending probes: cursors only move forward
+            int64_t step = 1;
+            while (fi + step < fn && fp[fi + step] < x) step <<= 1;
+            int64_t hi2 = fi + step < fn ? fi + step : fn;
+            fi = std::lower_bound(fp + fi, fp + hi2, x) - fp;
+            if (fi < fn && fp[fi] == x) return true;
+            step = 1;
+            while (ti + step < tn && tp[ti + step] < x) step <<= 1;
+            hi2 = ti + step < tn ? ti + step : tn;
+            ti = std::lower_bound(tp + ti, tp + hi2, x) - tp;
+            return ti < tn && tp[ti] == x;
+        }
+    };
+    std::vector<Ranges> rs(npairs);
+    int64_t o = 0;
+    for (int32_t i = 0; i < npairs; i++) {
+        uint16_t kl = rd_u16(pairs + o);
+        o += 2;
+        const char* k = (const char*)pairs + o;
+        o += kl;
+        uint16_t vl = rd_u16(pairs + o);
+        o += 2;
+        const char* v = (const char*)pairs + o;
+        o += vl;
+        TagLab* lab = ix->find_lab(k, kl);
+        if (!lab) return 0;
+        Ranges& r = rs[i];
+        r.fp = nullptr; r.fn = 0; r.tp = nullptr; r.tn = 0;
+        int64_t vi = lab->frozen.find(v, vl);
+        if (vi >= 0) {
+            r.fp = lab->frozen.pids.data() + lab->frozen.poff[vi];
+            r.fn = lab->frozen.poff[vi + 1] - lab->frozen.poff[vi];
+        }
+        auto it = lab->tail.find(std::string(v, vl));
+        if (it != lab->tail.end()) {
+            r.tp = it->second.data();
+            r.tn = (int64_t)it->second.size();
+        }
+        if (r.total() == 0) return 0;
+    }
+    // smallest filter drives the enumeration
+    int32_t si = 0;
+    for (int32_t i = 1; i < npairs; i++)
+        if (rs[i].total() < rs[si].total()) si = i;
+    Ranges& s = rs[si];
+    std::vector<int32_t>& res = ix->scratch;
+    res.clear();
+    int64_t fi = 0, ti = 0;
+    while (fi < s.fn || ti < s.tn) {
+        int32_t x;
+        if (fi < s.fn && (ti >= s.tn || s.fp[fi] <= s.tp[ti])) {
+            x = s.fp[fi];
+            if (ti < s.tn && s.tp[ti] == x) ti++;
+            fi++;
+        } else {
+            x = s.tp[ti++];
+        }
+        if (x == INT32_MIN) continue;  // purge sentinel
+        bool all = true;
+        for (int32_t i = 0; i < npairs && all; i++)
+            if (i != si) all = rs[i].contains(x);
+        if (all) res.push_back(x);
+    }
+    return copy_out(res, out, cap);
+}
+
+// batch add: pids[n], concatenated key blobs with offsets[n+1]
+int32_t tagindex_add_batch(void* h, const int32_t* pids, int64_t n,
+                           const uint8_t* blobs, const int64_t* offsets) {
+    for (int64_t i = 0; i < n; i++) {
+        int32_t rc = tagindex_add(h, pids[i], blobs + offsets[i],
+                                  (int32_t)(offsets[i + 1] - offsets[i]));
+        if (rc != 0) return rc;
+    }
+    return 0;
+}
+
+// one-shot: equals intersection + time-overlap predicate
+// (starts[pid] <= end_t && ends[pid] >= start_t), the full
+// partIdsFromFilters fast path in a single native call.
+int64_t tagindex_query_equals(void* h, const uint8_t* pairs, int32_t npairs,
+                              const int64_t* starts, const int64_t* ends,
+                              int64_t bounds_len, int64_t start_t,
+                              int64_t end_t, int32_t* out, int64_t cap) {
+    int64_t n = tagindex_intersect_equals(h, pairs, npairs, out, cap);
+    if (n < 0) return n;  // caller re-buffers; scratch still holds result
+    int64_t w = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int32_t pid = out[i];
+        // pids beyond the caller's bounds snapshot (added concurrently)
+        // are not visible to this query
+        if (pid < bounds_len && starts[pid] <= end_t
+            && ends[pid] >= start_t)
+            out[w++] = pid;
+    }
+    return w;
+}
+
+// union of every posting of a label ("has this label at all")
+int64_t tagindex_label_all(void* h, const char* labn, int64_t ll,
+                           int32_t* out, int64_t cap) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    if (!lab) return 0;
+    std::vector<int32_t>& res = ix->scratch;
+    res.clear();
+    res.insert(res.end(), lab->frozen.pids.begin(), lab->frozen.pids.end());
+    for (auto& kv : lab->tail)
+        res.insert(res.end(), kv.second.begin(), kv.second.end());
+    std::sort(res.begin(), res.end());
+    res.erase(std::unique(res.begin(), res.end()), res.end());
+    if (!res.empty() && res.front() == INT32_MIN)
+        res.erase(res.begin());
+    return copy_out(res, out, cap);
+}
+
+// value enumeration: frozen values first (vid 0..nv-1), then tail values in
+// map order (vid nv..). Stable between a values() call and a following
+// union_values() call as long as no adds happen in between.
+int64_t tagindex_values_size(void* h, const char* labn, int64_t ll) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    if (!lab) return 0;
+    int64_t sz = 0;
+    for (int64_t vi = 0; vi < lab->frozen.nv(); vi++)
+        sz += 4 + (lab->frozen.voff[vi + 1] - lab->frozen.voff[vi]);
+    for (auto& kv : lab->tail) sz += 4 + (int64_t)kv.first.size();
+    return sz;
+}
+
+void tagindex_values(void* h, const char* labn, int64_t ll, uint8_t* out) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    if (!lab) return;
+    uint8_t* p = out;
+    for (int64_t vi = 0; vi < lab->frozen.nv(); vi++) {
+        uint32_t n = lab->frozen.voff[vi + 1] - lab->frozen.voff[vi];
+        std::memcpy(p, &n, 4);
+        p += 4;
+        std::memcpy(p, lab->frozen.vblob.data() + lab->frozen.voff[vi], n);
+        p += n;
+    }
+    for (auto& kv : lab->tail) {
+        uint32_t n = (uint32_t)kv.first.size();
+        std::memcpy(p, &n, 4);
+        p += 4;
+        std::memcpy(p, kv.first.data(), n);
+        p += n;
+    }
+}
+
+// union postings of the vids listed (vid space as enumerated above)
+int64_t tagindex_union_values(void* h, const char* labn, int64_t ll,
+                              const int32_t* vids, int64_t n, int32_t* out,
+                              int64_t cap) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    if (!lab) return 0;
+    int64_t nfrozen = lab->frozen.nv();
+    std::vector<int32_t>& res = ix->scratch;
+    res.clear();
+    std::vector<const std::vector<int32_t>*> tails;
+    tails.reserve(lab->tail.size());
+    for (auto& kv : lab->tail) tails.push_back(&kv.second);
+    for (int64_t i = 0; i < n; i++) {
+        int64_t vi = vids[i];
+        if (vi < nfrozen) {
+            res.insert(res.end(),
+                       lab->frozen.pids.begin() + lab->frozen.poff[vi],
+                       lab->frozen.pids.begin() + lab->frozen.poff[vi + 1]);
+        } else if (vi - nfrozen < (int64_t)tails.size()) {
+            const auto& t = *tails[vi - nfrozen];
+            res.insert(res.end(), t.begin(), t.end());
+        }
+    }
+    std::sort(res.begin(), res.end());
+    res.erase(std::unique(res.begin(), res.end()), res.end());
+    if (!res.empty() && res.front() == INT32_MIN)
+        res.erase(res.begin());
+    return copy_out(res, out, cap);
+}
+
+int64_t tagindex_num_labels(void* h) {
+    return (int64_t)static_cast<TagIndex*>(h)->label_names.size();
+}
+
+int64_t tagindex_labels_size(void* h) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    int64_t sz = 0;
+    for (auto& n : ix->label_names) sz += 4 + (int64_t)n.size();
+    return sz;
+}
+
+void tagindex_labels(void* h, uint8_t* out) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    uint8_t* p = out;
+    for (auto& nm : ix->label_names) {
+        uint32_t n = (uint32_t)nm.size();
+        std::memcpy(p, &n, 4);
+        p += 4;
+        std::memcpy(p, nm.data(), n);
+        p += n;
+    }
+}
+
+// ---- snapshot export/load -------------------------------------------------
+// Export merges frozen + tail, drops `deleted` pids (sorted array) and the
+// INT32_MIN purge sentinels, and produces the snapshot array layout.
+// Two-phase: sizes() builds into exp_tmp, export() copies it out.
+
+int64_t tagindex_export_sizes(void* h, const char* labn, int64_t ll,
+                              const int32_t* deleted, int64_t ndel,
+                              int64_t* out3) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab* lab = ix->find_lab(labn, ll);
+    FrozenLab& t = ix->exp_tmp;
+    t.voff.assign(1, 0);
+    t.vblob.clear();
+    t.poff.assign(1, 0);
+    t.pids.clear();
+    if (lab) {
+        auto keep = [&](int32_t pid) {
+            if (pid == INT32_MIN) return false;
+            if (!ndel) return true;
+            const int32_t* e = deleted + ndel;
+            const int32_t* it = std::lower_bound(deleted, e, pid);
+            return !(it != e && *it == pid);
+        };
+        // ordered value walk: frozen table is sorted; tail keys must be
+        // sorted and merged with it
+        std::vector<std::pair<std::string, const std::vector<int32_t>*>>
+            tails;
+        tails.reserve(lab->tail.size());
+        for (auto& kv : lab->tail) tails.emplace_back(kv.first, &kv.second);
+        std::sort(tails.begin(), tails.end(),
+                  [](const auto& a, const auto& b) {
+                      return a.first < b.first;
+                  });
+        int64_t fi = 0, ti = 0;
+        int64_t nf = lab->frozen.nv();
+        std::vector<int32_t> merged;
+        while (fi < nf || ti < (int64_t)tails.size()) {
+            std::string fv;
+            bool use_f = false, use_t = false;
+            if (fi < nf) {
+                fv.assign(lab->frozen.vblob.data() + lab->frozen.voff[fi],
+                          lab->frozen.voff[fi + 1] - lab->frozen.voff[fi]);
+            }
+            if (fi < nf && ti < (int64_t)tails.size()) {
+                int c = fv.compare(tails[ti].first);
+                use_f = c <= 0;
+                use_t = c >= 0;
+            } else if (fi < nf) {
+                use_f = true;
+            } else {
+                use_t = true;
+            }
+            const std::string& vname = use_f ? fv : tails[ti].first;
+            merged.clear();
+            if (use_f) {
+                for (int64_t k = lab->frozen.poff[fi];
+                     k < lab->frozen.poff[fi + 1]; k++) {
+                    int32_t pid = lab->frozen.pids[k];
+                    if (keep(pid)) merged.push_back(pid);
+                }
+                fi++;
+            }
+            if (use_t) {
+                size_t base = merged.size();
+                for (int32_t pid : *tails[ti].second)
+                    if (keep(pid)) merged.push_back(pid);
+                if (base && merged.size() > base)
+                    std::inplace_merge(merged.begin(),
+                                       merged.begin() + base, merged.end());
+                ti++;
+            }
+            merged.erase(std::unique(merged.begin(), merged.end()),
+                         merged.end());
+            if (merged.empty()) continue;
+            t.vblob += vname;
+            t.voff.push_back((uint32_t)t.vblob.size());
+            t.pids.insert(t.pids.end(), merged.begin(), merged.end());
+            t.poff.push_back((int64_t)t.pids.size());
+        }
+    }
+    out3[0] = t.nv();
+    out3[1] = (int64_t)t.vblob.size();
+    out3[2] = (int64_t)t.pids.size();
+    return 0;
+}
+
+void tagindex_export_label(void* h, uint32_t* voff, uint8_t* vblob,
+                           int64_t* poff, int32_t* pids) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    FrozenLab& t = ix->exp_tmp;
+    std::memcpy(voff, t.voff.data(), t.voff.size() * 4);
+    std::memcpy(vblob, t.vblob.data(), t.vblob.size());
+    std::memcpy(poff, t.poff.data(), t.poff.size() * 8);
+    std::memcpy(pids, t.pids.data(), t.pids.size() * 4);
+}
+
+void tagindex_load_label(void* h, const char* labn, int64_t ll,
+                         const uint32_t* voff, int64_t nv,
+                         const uint8_t* vblob, int64_t vlen,
+                         const int64_t* poff, const int32_t* pids,
+                         int64_t npids) {
+    TagIndex* ix = static_cast<TagIndex*>(h);
+    TagLab& lab = ix->get_lab(std::string(labn, ll));
+    lab.frozen.voff.assign(voff, voff + nv + 1);
+    lab.frozen.vblob.assign((const char*)vblob, vlen);
+    lab.frozen.poff.assign(poff, poff + nv + 1);
+    lab.frozen.pids.assign(pids, pids + npids);
 }
 
 }  // extern "C"
