@@ -243,6 +243,34 @@ class PartKeyIndex:
     def update_end_time(self, part_id: int, end_time: int) -> None:
         self._end[part_id] = end_time
 
+    def set_start_time(self, part_id: int, start_time: int) -> None:
+        self._start[part_id] = start_time
+
+    def pid_for_exact_key(self, key: PartKey, blob: bytes,
+                          exclude: int = -1) -> int | None:
+        """Find a live pid whose part key is byte-identical to ``blob``
+        (evicted-series identity restore). Label-equals intersection
+        narrows candidates; blob equality rejects superset-label matches."""
+        from filodb_tpu.core.filters import Equals
+        filters = [ColumnFilter(k, Equals(v)) for k, v in key.labels]
+        for pid in self.part_ids_from_filters(filters, 0, INGESTING):
+            if pid == exclude:
+                continue
+            stored = self._part_keys[pid] \
+                if pid < len(self._part_keys) else None
+            if stored is None:
+                continue
+            if isinstance(stored, bytes):
+                if stored == blob:
+                    return pid
+            else:
+                from filodb_tpu.core.memstore.native_shard import (
+                    part_key_blob,
+                )
+                if part_key_blob(stored) == blob:
+                    return pid
+        return None
+
     def start_time(self, part_id: int) -> int:
         return int(self._start[part_id])
 

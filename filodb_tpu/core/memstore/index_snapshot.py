@@ -146,6 +146,10 @@ def save_snapshot(shard, chunk_token: int = -1, pk_token: int = -1,
     card = json.dumps(shard.cardinality.to_state()).encode()
     out += struct.pack("<I", len(card))
     out += card
+    # evicted-partkey bloom (appended section; absent in older snapshots)
+    bloom = json.dumps(shard.evicted_keys.state()).encode()
+    out += struct.pack("<I", len(bloom))
+    out += bloom
     return bytes(out)
 
 
@@ -270,5 +274,12 @@ def load_snapshot(shard, data: bytes) -> dict:
     shard.cardinality.load_state(
         json.loads(data[off : off + card_len].decode()))
     off += card_len
+    if off + 4 <= len(data):  # evicted-partkey bloom (newer snapshots)
+        from filodb_tpu.utils.bloom import BloomFilter
+        (bl,) = struct.unpack_from("<I", data, off)
+        off += 4
+        shard.evicted_keys = BloomFilter.from_state(
+            json.loads(data[off : off + bl].decode()))
+        off += bl
     return {"pids": n, "snapshot_ms": snapshot_ms,
             "chunk_token": chunk_token, "pk_token": pk_token}

@@ -312,6 +312,14 @@ class NativeBackedPartition:
             return int(self._lib.part_evict_flushed(self._core._core,
                                                     self.part_id))
 
+    def has_unpersisted_data(self) -> bool:
+        """True while buffer samples or un-flushed sealed chunks remain
+        (call after ``evict_flushed_chunks``, which drops flushed ones)."""
+        with self._core.lock:
+            core, pid = self._core._core, self.part_id
+            return bool(self._lib.part_buf_count(core, pid)) \
+                or bool(self._lib.part_num_sealed(core, pid))
+
     @property
     def chunk_nbytes(self) -> int:
         """Encoded chunk bytes without materializing Chunk objects."""

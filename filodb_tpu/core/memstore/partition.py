@@ -220,6 +220,12 @@ class TimeSeriesPartition:
                 cols.append(data[: b.n])
         return encode_chunk(self.schema, b.ts[: b.n], cols, 0xFFF)
 
+    def has_unpersisted_data(self) -> bool:
+        """True while buffer samples or un-flushed chunks remain — such a
+        partition must not be fully evicted (call after
+        ``evict_flushed_chunks``, which leaves only un-flushed chunks)."""
+        return self._buf.n > 0 or bool(self.chunks)
+
     def evict_flushed_chunks(self) -> int:
         """Drop already-persisted chunks from memory (they remain readable via
         on-demand paging). Reference: block reclaim / partition eviction."""

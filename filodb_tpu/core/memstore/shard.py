@@ -51,6 +51,10 @@ class ShardStats:
         default_factory=lambda: Counter("partitions_created"))
     partitions_purged: Counter = field(
         default_factory=lambda: Counter("partitions_purged"))
+    partitions_evicted: Counter = field(
+        default_factory=lambda: Counter("partitions_evicted"))
+    partitions_restored: Counter = field(
+        default_factory=lambda: Counter("partitions_restored"))
     chunks_flushed: Counter = field(default_factory=lambda: Counter("chunks_flushed"))
     flushes_done: Counter = field(default_factory=lambda: Counter("flushes_done"))
     num_partitions: Gauge = field(default_factory=lambda: Gauge("num_partitions"))
@@ -108,6 +112,12 @@ class TimeSeriesShard:
         # pids of host-backed (non-native) partitions, e.g. histograms —
         # lets shard-wide accounting avoid walking every lazy partition
         self._host_pids: set[int] = set()
+        # evicted-part-key bloom (reference TimeSeriesShard.scala:457): a
+        # positive answer at series-create time means the key MAY have been
+        # evicted — restore its identity instead of minting a fresh one
+        from filodb_tpu.utils.bloom import BloomFilter
+        self.evicted_keys = BloomFilter(
+            store_config.evicted_pk_bloom_filter_capacity)
         if store_config.native_ingest \
                 and not store_config.trace_part_key_substrings \
                 and not store_config.device_pages:
@@ -182,13 +192,63 @@ class TimeSeriesShard:
             self._host_pids.add(pid)
         self._by_key[key] = pid
         self.index.add_part_key(pid, key, first_ts)
+        if self.evicted_keys.count:
+            from filodb_tpu.core.memstore.native_shard import part_key_blob
+            self._maybe_restore_evicted(pid, key, part_key_blob(key), part)
         self._dirty_part_keys.add(pid)
         self.stats.partitions_created.inc()
         self.stats.num_partitions.set(len(self.index))
         return part
 
+    def _maybe_restore_evicted(self, pid: int, key: PartKey, blob: bytes,
+                               part) -> None:
+        """A series whose key hits the evicted-partkey bloom may be a
+        previously-evicted series coming back: transfer the original
+        startTime onto the new pid, retire the old index entry, and seed
+        the dedup floor from the old endTime so replayed history can't
+        double-ingest (reference TimeSeriesShard.scala:457 bloom +
+        partkey restore)."""
+        if blob not in self.evicted_keys:
+            return
+        old = self.index.pid_for_exact_key(key, blob, exclude=pid)
+        if old is None:
+            return  # bloom false positive
+        old_start = self.index.start_time(old)
+        old_end = self.index.end_time(old)
+        if old_start < self.index.start_time(pid):
+            self.index.set_start_time(pid, old_start)
+        self.index.remove_part_key(old)
+        if old < len(self.partitions):
+            self.partitions[old] = None
+        if old_end < 2**62:
+            part.seed_dedup_floor(old_end)
+        self._dirty_part_keys.add(pid)
+        self.stats.partitions_restored.inc()
+
     def partition(self, part_id: int) -> TimeSeriesPartition | None:
-        return self.partitions[part_id] if part_id < len(self.partitions) else None
+        if part_id >= len(self.partitions):
+            return None
+        p = self.partitions[part_id]
+        if p is None and self.index.part_key(part_id) is not None:
+            # evicted partition, still indexed: materialize an empty shell —
+            # reads page chunks back from the column store via ODP
+            # (reference PagedReadablePartition over an evicted partId)
+            return self._paged_shell(part_id)
+        return p
+
+    def _paged_shell(self, part_id: int) -> TimeSeriesPartition | None:
+        key = self.index.part_key(part_id)
+        if key is None:
+            return None
+        schema = self.schemas[key.schema]
+        shell = TimeSeriesPartition(part_id, key, schema,
+                                    self.config.max_chunk_size,
+                                    self.shard_num,
+                                    device_pages=self.config.device_pages)
+        self.partitions[part_id] = shell  # cache; last-wins under races
+        if self._native_core is not None:
+            self._host_pids.add(part_id)
+        return shell
 
     @property
     def num_partitions(self) -> int:
@@ -252,6 +312,8 @@ class TimeSeriesShard:
             self.partitions.append(part)
             self.cardinality.series_created(key.label_map)
             self.index.add_part_key_blob(pid, key, blob, part.first_ts)
+            if self.evicted_keys.count:
+                self._maybe_restore_evicted(pid, key, blob, part)
             self._dirty_part_keys.add(pid)
             self.stats.partitions_created.inc()
         self.stats.num_partitions.set(len(self.index))
@@ -537,6 +599,64 @@ class TimeSeriesShard:
         part = self.partitions[part_id]
         return part.evict_flushed_chunks() if part else 0
 
+    def evict_partition(self, part_id: int) -> bool:
+        """Fully evict one partition under memory pressure (reference
+        ``TimeSeriesShard.scala:1611`` evictForHeadroom): only when every
+        sample is persisted; frees the partition object and its native slot
+        while KEEPING the index entry (endTime set) so queries can still
+        reach the series via a paged shell + ODP; records the key in the
+        evicted-partkey bloom so a later re-ingest restores the series
+        identity. Caller holds ``write_lock``."""
+        from filodb_tpu.core.memstore.native_shard import part_key_blob
+
+        part = self.partitions[part_id]
+        if part is None:
+            return False
+        part.evict_flushed_chunks()
+        if part.has_unpersisted_data():
+            return False  # unpersisted data remains; not evictable
+        key = part.part_key
+        latest = self.index.end_time(part_id)
+        idx_end = latest if latest < 2**62 else part.latest_ts
+        if idx_end != -1 and idx_end < 2**62:
+            self.index.update_end_time(part_id, idx_end)
+        self.evicted_keys.add(part_key_blob(key))
+        self._by_key.pop(key, None)
+        self._host_pids.discard(part_id)
+        self.partitions[part_id] = None
+        if self._native_core is not None:
+            with self._native_core.lock:
+                self._native_core._lib.part_free(
+                    self._native_core._core, part_id)
+        self.cardinality.series_stopped(key.label_map)
+        self.stats.partitions_evicted.inc()
+        return True
+
+    def evict_cold_partitions(self, max_evict: int,
+                              now_ms: int | None = None,
+                              min_idle_ms: int = 0) -> int:
+        """Evict up to ``max_evict`` fully-persisted partitions, coldest
+        (oldest latest-sample) first — the reference's time-ordered
+        reclaim (``BlockManager.scala:124`` time-ordered block lists)."""
+        cands = []
+        for pid, p in enumerate(self.partitions):
+            if p is None:
+                continue
+            latest = p.latest_ts
+            if now_ms is not None and min_idle_ms \
+                    and latest != -1 and latest > now_ms - min_idle_ms:
+                continue
+            cands.append((latest if latest != -1 else 0, pid))
+        cands.sort()
+        evicted = 0
+        with self.write_lock:
+            for _, pid in cands:
+                if evicted >= max_evict:
+                    break
+                if self.evict_partition(pid):
+                    evicted += 1
+        return evicted
+
     def chunk_bytes(self) -> int:
         total = 0
         if self._native_core is not None:
@@ -582,6 +702,13 @@ class TimeSeriesShard:
             if n:
                 used -= before - sum(c.nbytes for c in p.chunks)
                 evicted += n
+        if used > budget:
+            # chunk eviction alone didn't reach the budget: fall back to
+            # whole-partition eviction of the coldest fully-persisted series
+            # (frees write buffers + native slots; queries keep working via
+            # paged shells + ODP)
+            headroom = max(len(self.index) // 20, 64)
+            self.evict_cold_partitions(headroom)
         return evicted
 
     def mark_part_ended(self, part_id: int, end_time: int) -> None:
