@@ -36,6 +36,8 @@ START = 1_600_000_000
 
 def _force_cpu():
     import jax
+    import jax._src.xla_bridge as xb
+    xb._backend_factories.pop("axon", None)  # hangs when tunnel is down
     jax.config.update("jax_platforms", "cpu")
 
 

@@ -37,6 +37,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
+        import jax._src.xla_bridge as xb
+        xb._backend_factories.pop("axon", None)  # hangs when tunnel is down
         jax.config.update("jax_platforms", "cpu")
 
     from filodb_tpu.coordinator.query_service import QueryService

@@ -28,11 +28,16 @@ START = 1_600_000_000
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=1,
+                    help="server processes sharing the port (SO_REUSEPORT "
+                         "log-replica serving plane)")
     ap.add_argument("--seconds", type=float, default=15.0)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
+        import jax._src.xla_bridge as xb
+        xb._backend_factories.pop("axon", None)  # hangs when tunnel is down
         jax.config.update("jax_platforms", "cpu")
 
     from filodb_tpu.client import FiloClient
@@ -46,6 +51,7 @@ def main(argv=None):
     with open(cfg, "w") as f:
         json.dump({
             "node_name": "bench", "data_dir": os.path.join(tmp, "d"),
+            "wal_dir": os.path.join(tmp, "wal"),
             "http_port": 0, "gateway_port": 0,
             "datasets": {"timeseries": {
                 "num_shards": 4, "spread": 1,
@@ -53,6 +59,7 @@ def main(argv=None):
                           "retention_ms": 10**15}}},
         }, f)
     server = FiloServer(ServerConfig.load(cfg)).start()
+    extra_procs = []
     try:
         keys = counter_series(100, metric="heap_usage", ns="App-2")
         for sd in counter_stream(keys, 720, start_ms=START * 1000, seed=1):
@@ -66,6 +73,68 @@ def main(argv=None):
             if r and float(r[0]["value"][1]) == 100:
                 break
             time.sleep(0.2)
+
+        if args.workers > 1:
+            # extra worker processes: each runs a full server on the SAME
+            # port via SO_REUSEPORT, reading the same data dir/WAL (the
+            # log-replica serving plane). The primary re-binds with
+            # reuse_port so the kernel can balance across all of them.
+            import subprocess
+            port = server.http.port
+            with open(cfg) as f:
+                base = json.load(f)
+            for w in range(args.workers - 1):
+                wcfg = dict(base)
+                wcfg["node_name"] = f"worker-{w}"
+                wcfg["data_dir"] = os.path.join(tmp, f"wd{w}")
+                wcfg["http_port"] = port
+                wcfg["http_reuse_port"] = True
+                wpath = os.path.join(tmp, f"w{w}.json")
+                with open(wpath, "w") as f:
+                    json.dump(wcfg, f)
+                code = (
+                    "import jax, sys;"
+                    "import jax._src.xla_bridge as xb;"
+                    "xb._backend_factories.pop('axon', None);"
+                    "jax.config.update('jax_platforms', 'cpu');"
+                    "from filodb_tpu.config import ServerConfig;"
+                    "from filodb_tpu.standalone import FiloServer;"
+                    f"s = FiloServer(ServerConfig.load({wpath!r})).start();"
+                    "import time;"
+                    "print('WORKER_READY', flush=True);"
+                    "time.sleep(10**9)")
+                env = {k: v for k, v in os.environ.items()
+                       if k != "PALLAS_AXON_POOL_IPS"}
+                env["JAX_PLATFORMS"] = "cpu"
+                pr = subprocess.Popen(
+                    [sys.executable, "-c", code], env=env,
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    stdout=subprocess.PIPE, text=True)
+                extra_procs.append(pr)
+            # rebind the primary with reuse_port on the same port
+            server.http.stop()
+            from filodb_tpu.http.server import FiloHttpServer
+            server.http = FiloHttpServer(
+                server.http.services, port=port,
+                cluster=server.http.cluster,
+                shard_maps=server.http.shard_maps,
+                reuse_port=True).start()
+            for pr in extra_procs:
+                line = pr.stdout.readline()
+                assert "WORKER_READY" in line, line
+            # wait for every worker to finish ingesting (query via the
+            # shared port until all answers stabilize at full count)
+            deadline = time.monotonic() + 120
+            stable = 0
+            while time.monotonic() < deadline and stable < args.workers * 3:
+                r = FiloClient(port=port).query("count(heap_usage)",
+                                                START + 7100)
+                if r and float(r[0]["value"][1]) == 100:
+                    stable += 1
+                else:
+                    stable = 0
+                    time.sleep(0.5)
 
         queries = [
             ("range", 'sum(rate(heap_usage{_ws_="demo",_ns_="App-2"}[5m]))',
@@ -84,34 +153,51 @@ def main(argv=None):
             else:
                 c0.query(q, a)
 
-        stop = threading.Event()
-        counts = [0] * args.clients
-        lats: list[list[float]] = [[] for _ in range(args.clients)]
+        # client load runs in separate PROCESSES: in-process client threads
+        # would share the server's GIL and measure the bench, not the server
+        import multiprocessing as mp
 
-        def worker(i):
-            client = FiloClient(port=server.http.port)
+        def client_proc(i, port, seconds, warm_seconds, out_q):
+            import time as _t
+
+            client = FiloClient(port=port)
             rng = np.random.default_rng(i)
-            while not stop.is_set():
+            deadline_warm = _t.monotonic() + warm_seconds
+            while _t.monotonic() < deadline_warm:  # unmeasured warm phase
                 kind, q, a, b, step = queries[rng.integers(len(queries))]
-                t0 = time.perf_counter()
                 if kind == "range":
                     client.query_range(q, a, b, step)
                 else:
                     client.query(q, a)
-                lats[i].append(time.perf_counter() - t0)
-                counts[i] += 1
+            lat = []
+            deadline = _t.monotonic() + seconds
+            while _t.monotonic() < deadline:
+                kind, q, a, b, step = queries[rng.integers(len(queries))]
+                t0 = _t.perf_counter()
+                if kind == "range":
+                    client.query_range(q, a, b, step)
+                else:
+                    client.query(q, a)
+                lat.append(_t.perf_counter() - t0)
+            out_q.put(lat)
 
-        threads = [threading.Thread(target=worker, args=(i,), daemon=True)
-                   for i in range(args.clients)]
-        t_start = time.perf_counter()
-        for t in threads:
-            t.start()
-        time.sleep(args.seconds)
-        stop.set()
-        for t in threads:
-            t.join(timeout=5)
-        wall = time.perf_counter() - t_start
-        all_lats = np.array([x for lt in lats for x in lt])
+        ctx = mp.get_context("fork")
+        out_q = ctx.Queue()
+        warm_s = 4.0 if args.workers <= 1 else 4.0 + 4.0 * args.workers
+        procs = [ctx.Process(target=client_proc,
+                             args=(i, server.http.port, args.seconds,
+                                   warm_s, out_q), daemon=True)
+                 for i in range(args.clients)]
+        for pr in procs:
+            pr.start()
+        t_start = time.perf_counter() + warm_s
+        per_client = [out_q.get(timeout=args.seconds + warm_s + 60)
+                      for _ in procs]
+        for pr in procs:
+            pr.join(timeout=10)
+        wall = args.seconds
+        counts = [len(lt) for lt in per_client]
+        all_lats = np.array([x for lt in per_client for x in lt])
         print(json.dumps({
             "metric": "http_serving_throughput",
             "value": round(sum(counts) / wall, 2),
@@ -121,6 +207,8 @@ def main(argv=None):
             "p99_ms": round(float(np.percentile(all_lats, 99)) * 1000, 2),
         }))
     finally:
+        for pr in extra_procs:
+            pr.terminate()
         server.shutdown()
 
 

@@ -33,7 +33,7 @@ DEFAULTS = {
             "spread": 1,
             # "engine": "mesh" lowers supported aggregations onto the
             # (shard × time) device mesh on single-node deployments
-            "engine": "exec",
+            "engine": "mesh",
             "store": {
                 "flush_interval_ms": 3_600_000,
                 "max_chunk_size": 400,
@@ -58,6 +58,7 @@ class ServerConfig:
     wal_server_port: int = 0    # serve this node's WAL over TCP (broker)
     wal_remote: str | None = None  # "host:port" — use a remote log server
     http_port: int = 8080
+    http_reuse_port: bool = False  # SO_REUSEPORT multi-process serving
     gateway_port: int = 0
     executor_port: int = 0
     seeds: list[str] = field(default_factory=list)
@@ -88,14 +89,16 @@ class ServerConfig:
                 min_num_nodes=d.get("min_num_nodes", 1), store=store,
                 downsample=d.get("downsample"))
             spreads[name] = d.get("spread", 1)
-            engines[name] = d.get("engine", "exec")
+            engines[name] = d.get("engine", "mesh")
         return ServerConfig(
             node_name=cfg["node_name"], data_dir=cfg["data_dir"],
             wal_dir=cfg.get("wal_dir"),
             wal_fsync=cfg.get("wal_fsync", False),
             wal_server_port=cfg.get("wal_server_port", 0),
             wal_remote=cfg.get("wal_remote"),
-            http_port=cfg["http_port"], gateway_port=cfg["gateway_port"],
+            http_port=cfg["http_port"],
+            http_reuse_port=cfg.get("http_reuse_port", False),
+            gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),
             datasets=datasets, spreads=spreads, downsample=downsample,

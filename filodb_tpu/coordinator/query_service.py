@@ -233,3 +233,65 @@ class QueryService:
                 if pk is not None:
                     out.append(pk.label_map)
         return out
+
+
+class QueryBatcher:
+    """Coalesces concurrent in-flight queries into ``query_range_many``
+    batches — the serving-side analog of inference micro-batching, and the
+    TPU-native answer to the reference's per-query actor dispatch
+    (``QueryActor.scala:233-237``): under load the mesh engine evaluates a
+    whole batch as one device program, and results fetch in one coalesced
+    transfer.
+
+    Handler threads submit and wait; one dispatcher thread drains whatever
+    is queued (no artificial batching delay — an idle server answers a lone
+    query at single-query latency)."""
+
+    def __init__(self, svc: QueryService, max_batch: int = 64):
+        import queue
+        import threading
+
+        self.svc = svc
+        self.max_batch = max_batch
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="query-batcher")
+        self._thread.start()
+
+    def query_range(self, promql: str, start_sec: int, step_sec: int,
+                    end_sec: int):
+        import threading
+
+        item = {"params": (promql, start_sec, step_sec, end_sec),
+                "event": threading.Event(), "result": None, "error": None}
+        self._q.put(item)
+        item["event"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        return item["result"]
+
+    def _loop(self):
+        import queue
+
+        while True:
+            items = [self._q.get()]
+            try:
+                while len(items) < self.max_batch:
+                    items.append(self._q.get_nowait())
+            except queue.Empty:
+                pass
+            try:
+                results = self.svc.query_range_many(
+                    [it["params"] for it in items])
+                for it, r in zip(items, results):
+                    it["result"] = r
+            except Exception:
+                # isolate the failing query: run each alone so errors are
+                # attributed to their own request
+                for it in items:
+                    try:
+                        it["result"] = self.svc.query_range(*it["params"])
+                    except Exception as e:  # noqa: BLE001
+                        it["error"] = e
+            for it in items:
+                it["event"].set()

@@ -9,6 +9,7 @@ when converting histogram RangeVectors to the Prom wire model).
 
 from __future__ import annotations
 
+import json
 import math
 
 import numpy as np
@@ -73,6 +74,77 @@ def matrix_json(result: QueryResult) -> dict:
     return {"status": "success",
             "data": {"resultType": "matrix", "result": series},
             "queryStats": _stats_json(result)}
+
+
+def _labels_json_str(key) -> str:
+    """Serialized metric-label object, memoized per key instance (keys
+    repeat across queries and series)."""
+    s = key.__dict__.get("_json_str")
+    if s is None:
+        s = json.dumps(_labels_json(key), separators=(",", ":"))
+        object.__setattr__(key, "_json_str", s)
+    return s
+
+
+def _value_strings(vals: np.ndarray) -> np.ndarray:
+    """Shortest-round-trip value strings, vectorized (numpy's float→str is
+    the same shortest-repr algorithm as Python's repr); Prom spellings for
+    the specials."""
+    sv = vals.astype("U24")
+    if not np.isfinite(vals).all():
+        sv = np.where(np.isposinf(vals), "+Inf", sv)
+        sv = np.where(np.isneginf(vals), "-Inf", sv)
+        sv = np.where(np.isnan(vals), "NaN", sv)
+    return sv
+
+
+def matrix_json_str(result: QueryResult) -> str:
+    """Prom matrix response rendered straight to a JSON string — numpy
+    formats every sample value in one vectorized pass instead of a
+    per-value Python loop (the reference leans on Jackson streaming for the
+    same reason, ``PromCirceSupport``)."""
+    m = result.result
+    if m.is_histogram:
+        m = _flatten_histograms(m)
+    m.materialize()
+    vals = np.asarray(m.values, np.float64)
+    ok = ~np.isnan(vals)
+    sv = _value_strings(vals)
+    ts_str = [repr(t / 1000.0) for t in m.steps_ms.tolist()]
+    parts = []
+    for i, key in enumerate(m.keys):
+        idx = np.flatnonzero(ok[i])
+        if not len(idx):
+            continue
+        row = sv[i]
+        body = ",".join(f'[{ts_str[k]},"{row[k]}"]' for k in idx.tolist())
+        parts.append('{"metric":%s,"values":[%s]}'
+                     % (_labels_json_str(key), body))
+    stats = json.dumps(_stats_json(result), separators=(",", ":"))
+    return ('{"status":"success","data":{"resultType":"matrix","result":[%s'
+            ']},"queryStats":%s}' % (",".join(parts), stats))
+
+
+def vector_json_str(result: QueryResult) -> str:
+    """Prom vector response rendered straight to a JSON string."""
+    m = result.result
+    if m.is_histogram:
+        m = _flatten_histograms(m)
+    m.materialize()
+    if not m.num_steps or not m.num_series:
+        return ('{"status":"success","data":{"resultType":"vector",'
+                '"result":[]}}')
+    k = m.num_steps - 1
+    vals = np.asarray(m.values[:, k], np.float64)
+    ok = ~np.isnan(vals)
+    sv = _value_strings(vals)
+    t = repr(float(m.steps_ms[k]) / 1000.0)
+    parts = [
+        '{"metric":%s,"value":[%s,"%s"]}' % (_labels_json_str(m.keys[i]),
+                                             t, sv[i])
+        for i in np.flatnonzero(ok).tolist()]
+    return ('{"status":"success","data":{"resultType":"vector","result":'
+            '[%s]}}' % ",".join(parts))
 
 
 def vector_json(result: QueryResult) -> dict:

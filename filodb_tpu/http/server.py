@@ -33,18 +33,43 @@ from filodb_tpu.utils.metrics import render_prometheus
 log = logging.getLogger(__name__)
 
 
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    """SO_REUSEPORT variant: N server processes bind the same port and the
+    kernel load-balances connections across them — the multi-process
+    serving plane (each worker is a log-tailing read replica), sidestepping
+    the GIL the way the reference scales its Akka-HTTP dispatcher pool
+    (``http/src/main/scala/filodb/http/FiloHttpServer.scala:23``)."""
+
+    def server_bind(self):
+        import socket
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
 class FiloHttpServer:
     def __init__(self, services: dict[str, QueryService], host="127.0.0.1",
-                 port=8080, cluster=None, shard_maps=None):
+                 port=8080, cluster=None, shard_maps=None,
+                 reuse_port: bool = False):
         self.services = services
         self.cluster = cluster
         # member mode: dataset -> mirrored ShardMapper (StatusActor
         # subscription) so members answer cluster-status queries locally
         self.shard_maps = shard_maps or {}
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        cls = _ReusePortHTTPServer if reuse_port else ThreadingHTTPServer
+        self.httpd = cls((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # per-service micro-batchers: concurrent handler threads coalesce
+        # into one engine batch (see coordinator.query_service.QueryBatcher)
+        self._batchers: dict[int, object] = {}
+
+    def batched(self, svc: QueryService):
+        b = self._batchers.get(id(svc))
+        if b is None:
+            from filodb_tpu.coordinator.query_service import QueryBatcher
+            b = self._batchers[id(svc)] = QueryBatcher(svc)
+        return b
 
     def start(self) -> "FiloHttpServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -69,11 +94,18 @@ def _parse_time(s: str) -> float:
 
 def _make_handler(server: FiloHttpServer):
     class Handler(BaseHTTPRequestHandler):
+        # keep-alive: HTTP/1.0 would pay a TCP connect + handler thread
+        # spawn per request (the reference serves over a pooled Akka-HTTP
+        # pipeline for the same reason, FiloHttpServer.scala:23)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # quiet
             log.debug(fmt, *args)
 
-        def _send(self, code: int, payload: dict):
-            body = json.dumps(payload).encode()
+        def _send(self, code: int, payload):
+            # str payloads are pre-rendered JSON (vectorized fast path)
+            body = payload.encode() if isinstance(payload, str) \
+                else json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -142,8 +174,8 @@ def _make_handler(server: FiloHttpServer):
                 start = int(_parse_time(qs["start"][0]))
                 end = int(_parse_time(qs["end"][0]))
                 step = int(float(qs.get("step", ["60"])[0]))
-                r = svc.query_range(query, start, step, end)
-                return self._send(200, promjson.matrix_json(r))
+                r = server.batched(svc).query_range(query, start, step, end)
+                return self._send(200, promjson.matrix_json_str(r))
             if rest == ["query"]:
                 query = qs["query"][0]
                 if "time" in qs:
@@ -152,8 +184,8 @@ def _make_handler(server: FiloHttpServer):
                     # Prometheus defaults instant queries to server time
                     import time as _time
                     t = int(_time.time())
-                r = svc.query_instant(query, t)
-                return self._send(200, promjson.vector_json(r))
+                r = server.batched(svc).query_range(query, t, 0, t)
+                return self._send(200, promjson.vector_json_str(r))
             if rest == ["series"]:
                 matches = qs.get("match[]", [])
                 start = int(_parse_time(qs.get("start", ["0"])[0]))

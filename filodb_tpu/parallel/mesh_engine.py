@@ -335,8 +335,8 @@ class MeshQueryEngine:
         Gp = _pow2(max(G, 1))
 
         # per-plan step grids, each padded to a power of two for compile
-        # reuse, concatenated into one flat grid (window evaluations are
-        # independent per step — batching queries = concatenating steps)
+        # reuse (window evaluations are independent per step — batching
+        # queries = concatenating steps)
         all_steps = []
         spans = []
         for lo in lows:
@@ -348,7 +348,6 @@ class MeshQueryEngine:
             rel[K:] = rel[K - 1]
             spans.append((Kp, K, steps_ms))
             all_steps.append(rel)
-        flat_steps = np.concatenate(all_steps)
 
         if placed is None:
             gids_full = np.zeros(batch.ts.shape[0], np.int32)
@@ -370,24 +369,40 @@ class MeshQueryEngine:
             self._fns[key] = step_fn
 
         import jax.numpy as jnp
+        win_d = jnp.asarray(np.int32(low0.window))
         ts_d, vals_d, valid_d, gid_d = placed
-        out = step_fn(ts_d, vals_d, valid_d, gid_d, jnp.asarray(flat_steps),
-                      jnp.asarray(np.int32(low0.window)))
 
-        # split the flat [G|P, ΣKp] result back into per-plan matrices;
-        # values stay lazy on device — the service boundary materializes
-        results = []
-        col = 0
-        for lo, (Kp, K, steps_ms) in zip(lows, spans):
-            vals = out[: (G if agg else len(keys)), col : col + K]
-            col += Kp
-            if agg is None:
-                rkeys = keys if lo.keep_metric \
-                    else [k.drop_metric() for k in keys]
-            else:
-                rkeys = out_keys
-            m = StepMatrix(list(rkeys), vals, steps_ms)
-            results.append(self._apply_post(m, lo))
+        # Fixed call shapes: compile storms would otherwise follow the batch
+        # size (every distinct ΣKp is a fresh program). Queries grouped by
+        # Kp run in chunks of exactly 1 or GROUP (grids repeated to fill),
+        # so each (signature, Kp) compiles at most twice ever.
+        GROUP = 8
+        by_kp: dict[int, list[int]] = {}
+        for i, (Kp, _, _) in enumerate(spans):
+            by_kp.setdefault(Kp, []).append(i)
+        results: list = [None] * len(lows)
+        nrows = G if agg else len(keys)
+        for Kp, idxs in by_kp.items():
+            pos = 0
+            while pos < len(idxs):
+                chunk = idxs[pos : pos + GROUP]
+                pos += GROUP
+                size = 1 if len(chunk) == 1 else GROUP
+                grids = [all_steps[i] for i in chunk]
+                grids += [grids[-1]] * (size - len(chunk))
+                out = step_fn(ts_d, vals_d, valid_d, gid_d,
+                              jnp.asarray(np.concatenate(grids)), win_d)
+                for j, i in enumerate(chunk):
+                    lo = lows[i]
+                    _, K, steps_ms = spans[i]
+                    vals = out[:nrows, j * Kp : j * Kp + K]
+                    if agg is None:
+                        rkeys = keys if lo.keep_metric \
+                            else [k.drop_metric() for k in keys]
+                    else:
+                        rkeys = out_keys
+                    m = StepMatrix(list(rkeys), vals, steps_ms)
+                    results[i] = self._apply_post(m, lo)
         return results
 
     def _cache_put(self, ckey, entry):

@@ -198,7 +198,7 @@ class FiloServer:
                 self.cluster.setup_dataset(ing_cfg, logs)
                 services[name] = self.cluster.query_service(
                     name, cfg.spreads.get(name, 1),
-                    engine=cfg.engines.get(name, "exec"))
+                    engine=cfg.engines.get(name, "mesh"))
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
             self.cluster.start_failure_detector()
@@ -209,7 +209,8 @@ class FiloServer:
         self.http = FiloHttpServer(services, port=cfg.http_port,
                                    cluster=self.cluster
                                    if not cfg.seeds else None,
-                                   shard_maps=shard_maps).start()
+                                   shard_maps=shard_maps,
+                                   reuse_port=cfg.http_reuse_port).start()
         if cfg.gateway_port:
             first = next(iter(cfg.datasets.values()))
             sink = ContainerSink(
@@ -396,7 +397,7 @@ class FiloServer:
                 self.cluster._on_event(dataset, ev)
             svc = self.cluster.query_service(
                 dataset, cfg.spreads.get(dataset, 1),
-                engine=cfg.engines.get(dataset, "exec"))
+                engine=cfg.engines.get(dataset, "mesh"))
             self.http.services[dataset] = svc
             self.cluster.on_heartbeat.append(
                 lambda n=dataset: poll_remote_statuses(self.cluster, n))
