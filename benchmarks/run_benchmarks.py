@@ -314,6 +314,84 @@ def bench_encoding():
             "compression_ratio": round(ratio, 3), "unit": "samples/sec"}
 
 
+def bench_query_odp():
+    """On-demand-paging query throughput (reference
+    ``jmh/.../QueryOnDemandBenchmark.scala``): data lives only in the
+    column store; queries page chunks back in. ``cold`` clears the paged
+    cache every query (pure ODP path incl. store reads + decode); ``warm``
+    reuses the demand-paged cache."""
+    import tempfile
+
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.core.store.localstore import (
+        LocalDiskColumnStore,
+        LocalDiskMetaStore,
+    )
+    from filodb_tpu.testing.data import counter_series, counter_stream
+
+    tmp = tempfile.mkdtemp(prefix="filodb-odp-")
+    cs = LocalDiskColumnStore(tmp + "/store")
+    ms = TimeSeriesMemStore(cs, LocalDiskMetaStore(tmp + "/meta"))
+    n_shards = 2
+    for s in range(n_shards):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=400,
+                                              groups_per_shard=4,
+                                              flush_interval_ms=0))
+    keys = counter_series(100, metric="heap_usage", ns="App-2")
+    stream = counter_stream(keys, 720, start_ms=START * 1000, seed=11)
+    ingest_routed(ms, "timeseries", stream, n_shards, spread=1)
+    for shard in ms.shards_for("timeseries"):
+        shard.flush_all()
+        shard.evict_cold_partitions(max_evict=10**9)  # all data now cold
+    svc = QueryService(ms, "timeseries", n_shards, spread=1)
+    q = 'sum(rate(heap_usage{_ws_="demo",_ns_="App-2"}[5m]))'
+    a, b = START + 1800, START + 3600
+
+    def run(m, clear):
+        for shard in ms.shards_for("timeseries"):
+            shard.batch_cache.clear()
+            shard.odp_cache._lru.clear()
+        svc.query_range(q, a, 60, b)  # warm compile
+        t0 = time.perf_counter()
+        for _ in range(m):
+            if clear:
+                for shard in ms.shards_for("timeseries"):
+                    shard.batch_cache.clear()
+                    shard.odp_cache._lru.clear()
+            r = svc.query_range(q, a, 60, b)
+            assert r.result.num_series == 1
+        return m / (time.perf_counter() - t0)
+
+    return {"metric": "query_odp", "cold_qps": round(run(50, True), 1),
+            "warm_qps": round(run(200, False), 1), "unit": "queries/sec"}
+
+
+def bench_dict_string():
+    """Dict-string column codec micro (reference
+    ``jmh/.../DictStringBenchmark.scala``)."""
+    from filodb_tpu.memory import codecs
+
+    rng = np.random.default_rng(1)
+    vocab = [f"value-{i}" for i in range(64)]
+    vals = [vocab[i] for i in rng.integers(0, 64, 10_000)]
+    n = 50
+    t0 = time.perf_counter()
+    for _ in range(n):
+        enc = codecs.encode_dict_string(vals)
+    enc_rate = n * len(vals) / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        codecs.decode_dict_string(enc)
+    dec_rate = n * len(vals) / (time.perf_counter() - t0)
+    return {"metric": "dict_string",
+            "encode_strings_per_sec": round(enc_rate),
+            "decode_strings_per_sec": round(dec_rate),
+            "encoded_bytes": len(enc), "unit": "ops/sec"}
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -324,6 +402,8 @@ ALL = {
     "partkey_index": bench_partkey_index,
     "gateway": bench_gateway,
     "encoding": bench_encoding,
+    "query_odp": bench_query_odp,
+    "dict_string": bench_dict_string,
 }
 
 

@@ -64,6 +64,20 @@ _KEYWORDS = {"and", "or", "unless", "by", "without", "on", "ignoring",
              "group_left", "group_right", "offset", "bool", "atan2"}
 
 
+# required scalar-parameter counts for instant functions (exact, or
+# (min, max) range) — the reference parser validates arity in the grammar;
+# here it's a table check at plan construction
+_INSTANT_FN_PARAMS = {
+    "clamp": 2, "clamp_max": 1, "clamp_min": 1,
+    "histogram_quantile": 1, "histogram_max_quantile": 1,
+    "round": (0, 1),
+    "abs": 0, "ceil": 0, "floor": 0, "exp": 0, "ln": 0, "log2": 0,
+    "log10": 0, "sqrt": 0, "sgn": 0, "deg": 0, "rad": 0,
+    "acos": 0, "asin": 0, "atan": 0, "cos": 0, "cosh": 0, "sin": 0,
+    "sinh": 0, "tan": 0, "tanh": 0,
+}
+
+
 @dataclass
 class Token:
     kind: str
@@ -156,6 +170,8 @@ class Parser:
         return self.toks[min(self.i + ahead, len(self.toks) - 1)]
 
     def next(self) -> Token:
+        if self.i >= len(self.toks):
+            raise ParseError("unexpected end of query")
         t = self.toks[self.i]
         self.i += 1
         return t
@@ -575,6 +591,13 @@ class Parser:
                 fargs.append(a.value if isinstance(a, _Scalar) else a)
             if vec is None:
                 raise ParseError(f"{name} needs a vector argument")
+            need = _INSTANT_FN_PARAMS.get(name)
+            if need is not None:
+                lo_n, hi_n = need if isinstance(need, tuple) else (need, need)
+                if not lo_n <= len(fargs) <= hi_n:
+                    raise ParseError(
+                        f"{name} expects {need} parameter(s), "
+                        f"got {len(fargs)}")
             return lp.ApplyInstantFunction(self._finalize(vec), name,
                                            tuple(fargs))
 
@@ -591,10 +614,20 @@ class Parser:
             vec = self._finalize(args[0])
             fargs = tuple(a.value for a in args[1:]
                           if isinstance(a, (_Str, _Scalar)))
+            if name == "label_replace" and len(fargs) != 4:
+                raise ParseError("label_replace expects "
+                                 "(v, dst, replacement, src, regex)")
+            if name == "label_join" and len(fargs) < 2:
+                raise ParseError("label_join expects "
+                                 "(v, dst, sep, src...)")
             return lp.ApplyMiscellaneousFunction(vec, name, fargs)
         if name == "scalar":
+            if not args:
+                raise ParseError("scalar expects one vector argument")
             return lp.ScalarVaryingDoublePlan(self._finalize(args[0]))
         if name == "vector":
+            if not args:
+                raise ParseError("vector expects one scalar argument")
             sc = args[0]
             if isinstance(sc, _Scalar):
                 sc = lp.ScalarFixedDoublePlan(sc.value, p.start_ms,

@@ -395,3 +395,187 @@ class TestCorpus:
     def test_rejects(self, query):
         with pytest.raises(ParseError):
             parse_query(query, P)
+
+
+# ---------------------------------------------------------------------------
+# Plan-structure goldens (reference ParserSpec pins LogicalPlan toString for
+# hundreds of queries; these pin the structural parse of representative
+# shapes — selector filters, windows, offsets, grouping, joins, subqueries)
+
+def _plan_str(p):
+    import dataclasses
+    name = type(p).__name__
+    if not dataclasses.is_dataclass(p):
+        return repr(p)
+    parts = []
+    for f in dataclasses.fields(p):
+        v = getattr(p, f.name)
+        if f.name in ("start", "end", "step", "range_start", "range_end"):
+            continue  # absolute times vary with query params
+        if dataclasses.is_dataclass(v) and not isinstance(v,
+                                                          (int, float, str)):
+            parts.append(f"{f.name}={_plan_str(v)}")
+        elif isinstance(v, tuple) and v and dataclasses.is_dataclass(v[0]):
+            parts.append(
+                f"{f.name}=({','.join(_plan_str(x) for x in v)})")
+        elif v not in (None, (), 0, "", False):
+            parts.append(f"{f.name}={v!r}")
+    return f"{name}({','.join(parts)})"
+
+
+PLAN_GOLDENS = [
+    ('sum(rate(http_requests_total{job="api"}[5m]))',
+     "Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='http_requests_total')),ColumnFilter(column='job',filter=Equals(value='api'))),lookback=300000),window=300000,function='rate'))"),
+    ('sum(rate(foo[5m])) by (job, instance)',
+     "Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='rate'),by=('job', 'instance'))"),
+    ('sum without (instance) (rate(foo[5m]))',
+     "Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='rate'),without=('instance',))"),
+    ('topk(5, sum(rate(foo[1m])) by (app))',
+     "Aggregate(op='topk',vector=Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=60000),window=60000,function='rate'),by=('app',)),params=(5.0,))"),
+    ('histogram_quantile(0.99, sum(rate(req_latency_bucket[5m])) by (le))',
+     "ApplyInstantFunction(vector=Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='req_latency_bucket'))),lookback=300000),window=300000,function='rate'),by=('le',)),function='histogram_quantile',args=(0.99,))"),
+    ('rate(foo[5m] offset 1h)',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000,offset=3600000),window=300000,function='rate',offset=3600000)"),
+    ('foo offset 5m',
+     "PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000,offset=300000),offset=300000)"),
+    ('foo @ 1609746000',
+     "PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),at_ms=1609746000000)"),
+    ('avg_over_time(foo[10m:1m])',
+     "SubqueryWithWindowing(inner=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='avg_over_time',subquery_window=600000,subquery_step=60000)"),
+    ('max_over_time(rate(foo[5m])[30m:5m])',
+     "SubqueryWithWindowing(inner=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='rate'),function='max_over_time',subquery_window=1800000,subquery_step=300000)"),
+    ('foo / on (job) bar',
+     "BinaryJoin(lhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),op='/',rhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='bar'))),lookback=300000)),cardinality='one-to-one',on=('job',))"),
+    ('foo * ignoring (instance) group_left bar',
+     "BinaryJoin(lhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),op='*',rhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='bar'))),lookback=300000)),cardinality='many-to-one',ignoring=('instance',))"),
+    ('foo and bar',
+     "BinaryJoin(lhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),op='and',rhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='bar'))),lookback=300000)),cardinality='many-to-many')"),
+    ('foo unless on (x) bar',
+     "BinaryJoin(lhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),op='unless',rhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='bar'))),lookback=300000)),cardinality='many-to-many',on=('x',))"),
+    ('abs(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='abs')"),
+    ('clamp_max(foo, 10)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='clamp_max',args=(10.0,))"),
+    ('label_replace(foo, "dst", "$1", "src", "(.*)")',
+     "ApplyMiscellaneousFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='label_replace',args=('dst', '$1', 'src', '(.*)'))"),
+    ('quantile(0.9, foo)',
+     "Aggregate(op='quantile',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),params=(0.9,))"),
+    ('count_values("ver", foo)',
+     "Aggregate(op='count_values',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),params=('ver',))"),
+    ('scalar(foo) * 2',
+     "ScalarBinaryOperation(op='*',lhs=ScalarVaryingDoublePlan(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='scalar'),rhs=2.0)"),
+    ('vector(1)',
+     'VectorPlan(scalar=ScalarFixedDoublePlan(value=1.0))'),
+    ('time()',
+     "ScalarTimeBasedPlan(function='time')"),
+    ('predict_linear(foo[1h], 3600)',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=3600000),window=3600000,function='predict_linear',params=(3600.0,))"),
+    ('-foo',
+     "ScalarVectorBinaryOperation(op='*',scalar=ScalarFixedDoublePlan(value=-1.0),vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),scalar_is_lhs=True)"),
+    ('foo > bool 2',
+     "ScalarVectorBinaryOperation(op='>',scalar=ScalarFixedDoublePlan(value=2.0),vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),bool_mode=True)"),
+    ('2 < foo',
+     "ScalarVectorBinaryOperation(op='<',scalar=ScalarFixedDoublePlan(value=2.0),vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),scalar_is_lhs=True)"),
+    ('absent(foo{job="x"})',
+     "ApplyAbsentFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo')),ColumnFilter(column='job',filter=Equals(value='x'))),lookback=300000)),filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo')),ColumnFilter(column='job',filter=Equals(value='x'))))"),
+    ('sort_desc(foo)',
+     "ApplySortFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),descending=True)"),
+    ('changes(foo[10m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=600000),window=600000,function='changes')"),
+    ('resets(foo[1h])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=3600000),window=3600000,function='resets')"),
+    ('irate(foo[1m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=60000),window=60000,function='irate')"),
+    ('delta(gauge[30m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='gauge'))),lookback=1800000),window=1800000,function='delta')"),
+    ('idelta(gauge[5m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='gauge'))),lookback=300000),window=300000,function='idelta')"),
+    ('stddev(foo) by (a)',
+     "Aggregate(op='stddev',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),by=('a',))"),
+    ('stdvar(foo)',
+     "Aggregate(op='stdvar',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)))"),
+    ('group(foo) by (ns)',
+     "Aggregate(op='group',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),by=('ns',))"),
+    ('min_over_time(foo[5m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='min_over_time')"),
+    ('quantile_over_time(0.5, foo[10m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=600000),window=600000,function='quantile_over_time',params=(0.5,))"),
+    ('holt_winters(foo[1d], 0.3, 0.1)',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=86400000),window=86400000,function='holt_winters',params=(0.3, 0.1))"),
+    ('timestamp(foo)',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='timestamp')"),
+    ('day_of_week()',
+     "ApplyInstantFunction(vector=VectorPlan(scalar=ScalarTimeBasedPlan(function='time')),function='day_of_week')"),
+    ('hour(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='hour')"),
+    ('month(vector(1))',
+     "ApplyInstantFunction(vector=VectorPlan(scalar=ScalarFixedDoublePlan(value=1.0)),function='month')"),
+    ('http_requests_total::sum',
+     "PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='http_requests_total'))),lookback=300000,column='sum'))"),
+    ('foo[5m:30s]',
+     "_Subquery(inner=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),window=300000)"),
+    ('rate(foo{bar=~"b.+"}[5i])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo')),ColumnFilter(column='bar',filter=EqualsRegex(pattern='b.+'))),lookback=300000),window=300000,function='rate')"),
+    ('sum(rate(foo[5m])) / sum(rate(bar[5m]))',
+     "BinaryJoin(lhs=Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='rate')),op='/',rhs=Aggregate(op='sum',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='bar'))),lookback=300000),window=300000,function='rate')),cardinality='one-to-one')"),
+    ('ceil(avg(foo))',
+     "ApplyInstantFunction(vector=Aggregate(op='avg',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000))),function='ceil')"),
+    ('exp(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='exp')"),
+    ('ln(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='ln')"),
+    ('log2(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='log2')"),
+    ('sqrt(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='sqrt')"),
+    ('floor(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='floor')"),
+    ('round(foo, 0.5)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='round',args=(0.5,))"),
+    ('sgn(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='sgn')"),
+    ('deg(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='deg')"),
+    ('rad(foo)',
+     "ApplyInstantFunction(vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),function='rad')"),
+    ('last_over_time(foo[5m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='last_over_time')"),
+    ('present_over_time(foo[5m])',
+     "PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000),window=300000,function='present_over_time')"),
+    ('count(up == 1)',
+     "Aggregate(op='count',vector=ScalarVectorBinaryOperation(op='==',scalar=ScalarFixedDoublePlan(value=1.0),vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='up'))),lookback=300000))))"),
+    ('avg(rate(foo[2m])) by (job)',
+     "Aggregate(op='avg',vector=PeriodicSeriesWithWindowing(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=120000),window=120000,function='rate'),by=('job',))"),
+    ('bottomk(3, foo)',
+     "Aggregate(op='bottomk',vector=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),params=(3.0,))"),
+    ('foo or vector(0)',
+     "BinaryJoin(lhs=PeriodicSeries(raw=RawSeries(filters=(ColumnFilter(column='_metric_',filter=Equals(value='foo'))),lookback=300000)),op='or',rhs=VectorPlan(scalar=ScalarFixedDoublePlan()),cardinality='many-to-many')"),
+]
+
+
+EXTRA_INVALID = [
+    # operator/grammar misuse (reference ParserSpec parseError coverage)
+    'foo{bar=}', 'foo{bar', 'foo{=~"x"}', 'foo{bar!}',
+    'rate(foo[5m)', 'rate(foo 5m])', 'rate(foo[5x])', 'rate(foo[])',
+    'foo[5m] + bar', 'rate(foo)', 'sum()',
+    'topk(foo)', 'quantile(foo)', 'clamp_max(foo)',
+    'foo offset', 'foo offset bar', 'foo @ bar',
+    'and foo', 'foo or', 'foo unless unless bar',
+    'sum by (foo',  'sum by foo (x)',
+    'histogram_quantile(, foo)',
+    '(foo', 'foo)', '',
+    'foo=~"b"', '1[5m]',
+    'label_replace(foo)', 'vector()', 'scalar()',
+]
+
+
+class TestPlanStructure:
+    @pytest.mark.parametrize("query,expected", PLAN_GOLDENS,
+                             ids=[q for q, _ in PLAN_GOLDENS])
+    def test_plan_structure(self, query, expected):
+        assert _plan_str(parse_query(query, P)) == expected
+
+    @pytest.mark.parametrize("query", EXTRA_INVALID)
+    def test_extra_rejects(self, query):
+        with pytest.raises(ParseError):
+            parse_query(query, P)
