@@ -353,14 +353,14 @@ def bench_query_odp():
     def run(m, clear):
         for shard in ms.shards_for("timeseries"):
             shard.batch_cache.clear()
-            shard.odp_cache._lru.clear()
+            shard.odp_cache.clear()
         svc.query_range(q, a, 60, b)  # warm compile
         t0 = time.perf_counter()
         for _ in range(m):
             if clear:
                 for shard in ms.shards_for("timeseries"):
                     shard.batch_cache.clear()
-                    shard.odp_cache._lru.clear()
+                    shard.odp_cache.clear()
             r = svc.query_range(q, a, 60, b)
             assert r.result.num_series == 1
         return m / (time.perf_counter() - t0)

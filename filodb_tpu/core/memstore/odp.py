@@ -30,6 +30,10 @@ class DemandPagedChunkCache:
         self.max_chunks = max_chunks
         self._lru: OrderedDict[tuple[int, int], object] = OrderedDict()
 
+    def clear(self) -> None:
+        """Drop all cached chunks (benchmarks use this to force cold reads)."""
+        self._lru.clear()
+
     def get_or_load(self, shard: TimeSeriesShard, part: TimeSeriesPartition,
                     start: int, end: int) -> list:
         """Chunks from the column store overlapping [start, end] that are not

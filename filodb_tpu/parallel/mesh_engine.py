@@ -299,18 +299,35 @@ class MeshQueryEngine:
         else:
             placed = None
             parts = []
+            extra_by_obj: dict[int, list] = {}
             for shard in shards:
+                sparts = []
                 for pid in shard.lookup_partitions(list(low0.filters),
                                                    chunk_start, chunk_end):
                     p = shard.partition(pid)
                     if p is not None:
-                        parts.append(p)
+                        sparts.append(p)
+                # on-demand paging: cold chunks (flushed + evicted-from-RAM,
+                # or pre-restart data recovered only to the column store) are
+                # merged exactly like the exec path does (plan.py) — keyed by
+                # object identity because part_ids repeat across shards
+                if sparts and shard.config.demand_paging_enabled:
+                    from filodb_tpu.core.memstore.odp import page_partitions
+                    extra = page_partitions(shard, sparts, chunk_start,
+                                            chunk_end, shard.odp_cache)
+                    if extra:
+                        for p in sparts:
+                            ec = extra.get(p.part_id)
+                            if ec:
+                                extra_by_obj[id(p)] = ec
+                parts.extend(sparts)
             if not parts:
                 self._cache_put(ckey, (version, None, [], None, [], None))
                 return [StepMatrix.empty(steps_array(lo.start, lo.step,
                                                      lo.end))
                         for lo in lows]
-            batch = build_batch(parts, chunk_start, chunk_end)
+            batch = build_batch(parts, chunk_start, chunk_end,
+                                extra_by_obj=extra_by_obj or None)
             if batch.is_histogram:
                 return [None] * len(lows)  # hist stays on the exec path
             if stats is not None:

@@ -74,7 +74,10 @@ _INSTANT_FN_PARAMS = {
     "abs": 0, "ceil": 0, "floor": 0, "exp": 0, "ln": 0, "log2": 0,
     "log10": 0, "sqrt": 0, "sgn": 0, "deg": 0, "rad": 0,
     "acos": 0, "asin": 0, "atan": 0, "cos": 0, "cosh": 0, "sin": 0,
-    "sinh": 0, "tan": 0, "tanh": 0,
+    "sinh": 0, "tan": 0, "tanh": 0, "asinh": 0, "acosh": 0, "atanh": 0,
+    "hour": 0, "minute": 0, "month": 0, "year": 0, "day_of_month": 0,
+    "day_of_week": 0, "day_of_year": 0, "days_in_month": 0,
+    "timestamp": 0,
 }
 
 
@@ -584,11 +587,18 @@ class Parser:
             vec = None
             fargs: list = []
             for a in args:
-                if isinstance(a, (_Selector, lp.LogicalPlan, _Subquery)):
-                    if vec is None and not isinstance(a, _Scalar):
-                        vec = a
-                        continue
-                fargs.append(a.value if isinstance(a, _Scalar) else a)
+                if isinstance(a, _Scalar):
+                    fargs.append(a.value)
+                elif vec is None and isinstance(
+                        a, (_Selector, lp.LogicalPlan, _Subquery)):
+                    vec = a
+                else:
+                    # a second vector, or a string where a scalar parameter
+                    # belongs: reject at parse time (the reference grammar
+                    # types function params as scalars)
+                    raise ParseError(
+                        f"{name}: expected scalar parameter, got "
+                        f"{type(a).__name__}")
             if vec is None:
                 raise ParseError(f"{name} needs a vector argument")
             need = _INSTANT_FN_PARAMS.get(name)

@@ -70,18 +70,23 @@ class SeriesBatch:
 def build_batch(partitions: list[TimeSeriesPartition], start: int, end: int,
                 value_col: int | None = None, pad_series: bool = True,
                 pad_samples: bool = True,
-                extra_chunks: dict[int, list] | None = None) -> SeriesBatch:
+                extra_chunks: dict[int, list] | None = None,
+                extra_by_obj: dict[int, list] | None = None) -> SeriesBatch:
     """Decode chunks overlapping [start, end] into a SeriesBatch.
 
     ``start`` already includes the lookback/window extension; ``base_ts`` is
     set to ``start`` so all in-range offsets are non-negative.
-    ``extra_chunks`` maps part_id → ODP-paged chunks to merge.
+    ``extra_chunks`` maps part_id → ODP-paged chunks to merge (single-shard
+    callers); ``extra_by_obj`` maps ``id(partition)`` → chunks for callers
+    batching across shards, where part_ids are not unique.
     """
     per_ts: list[np.ndarray] = []
     per_vals: list = []
     les = None
     for p in partitions:
-        extra = extra_chunks.get(p.part_id) if extra_chunks else None
+        extra = extra_by_obj.get(id(p)) if extra_by_obj else None
+        if extra is None and extra_chunks:
+            extra = extra_chunks.get(p.part_id)
         ts, vals = p.read_samples(start, end, value_col, extra_chunks=extra)
         if isinstance(vals, HistogramColumn):
             les = vals.les if les is None or len(vals.les) > len(les) else les

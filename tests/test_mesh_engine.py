@@ -310,3 +310,39 @@ class TestMeshWidenedCoverage:
         eng = m.mesh_engine
         assert eng.hits >= 1 and eng.misses >= 1
         assert 0.0 < eng.hit_rate < 1.0
+
+
+class TestMeshODP:
+    """Cold data must reach the mesh path via on-demand paging, exactly as
+    it reaches the exec path (regression: after a restart, replayed shards
+    hold only post-checkpoint tails — the mesh engine returned NaN for all
+    flushed history until it learned to call ``page_partitions``)."""
+
+    def test_mesh_reads_evicted_chunks(self, tmp_path):
+        from filodb_tpu.core.store.localstore import (
+            LocalDiskColumnStore,
+            LocalDiskMetaStore,
+        )
+
+        cs = LocalDiskColumnStore(str(tmp_path / "data"))
+        meta = LocalDiskMetaStore(str(tmp_path / "data"))
+        ms = TimeSeriesMemStore(cs, meta)
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=50,
+                                              groups_per_shard=4))
+        keys = machine_metrics_series(4)
+        shard = ms.get_shard("timeseries", 0)
+        for sd in gauge_stream(keys, 300, start_ms=START * 1000):
+            shard.ingest(sd)
+        shard.flush_all(ingestion_time=1)
+        assert sum(shard.evict_partition_chunks(p.part_id)
+                   for p in shard.partitions if p) > 0
+
+        exec_svc = QueryService(ms, "timeseries", 1, spread=0)
+        mesh_svc = QueryService(ms, "timeseries", 1, spread=0, engine="mesh")
+        q = 'count_over_time(heap_usage[55m])'
+        re = exec_svc.query_range(q, START + 3000, 60, START + 3000)
+        rm = mesh_svc.query_range(q, START + 3000, 60, START + 3000)
+        assert_same(re, rm)
+        assert rm.result.num_series == 4
+        np.testing.assert_array_equal(np.asarray(rm.result.values)[:, 0],
+                                      300.0)
