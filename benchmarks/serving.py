@@ -53,6 +53,10 @@ def main(argv=None):
             "node_name": "bench", "data_dir": os.path.join(tmp, "d"),
             "wal_dir": os.path.join(tmp, "wal"),
             "http_port": 0, "gateway_port": 0,
+            # headline measures real serving: the rendered-response cache is
+            # off (it would trivially absorb this bench's fixed query mix);
+            # a second short phase measures it separately (cached_qps)
+            "http_response_cache": False,
             "datasets": {"timeseries": {
                 "num_shards": 4, "spread": 1,
                 "store": {"max_chunk_size": 400, "groups_per_shard": 4,
@@ -198,6 +202,26 @@ def main(argv=None):
         wall = args.seconds
         counts = [len(lt) for lt in per_client]
         all_lats = np.array([x for lt in per_client for x in lt])
+
+        # second phase: rendered-response cache on (the query-frontend
+        # pattern) — the dashboard-refresh workload where the same panel
+        # queries repeat against unchanged data
+        cached_qps = None
+        if args.workers <= 1:
+            from filodb_tpu.http.server import ResponseCache
+            server.http.response_cache = ResponseCache()
+            out_q2 = ctx.Queue()
+            procs2 = [ctx.Process(target=client_proc,
+                                  args=(i, server.http.port, 5.0, 2.0,
+                                        out_q2), daemon=True)
+                      for i in range(args.clients)]
+            for pr in procs2:
+                pr.start()
+            per_client2 = [out_q2.get(timeout=60) for _ in procs2]
+            for pr in procs2:
+                pr.join(timeout=10)
+            cached_qps = round(sum(len(lt) for lt in per_client2) / 5.0, 2)
+
         print(json.dumps({
             "metric": "http_serving_throughput",
             "value": round(sum(counts) / wall, 2),
@@ -205,6 +229,7 @@ def main(argv=None):
             "clients": args.clients,
             "p50_ms": round(float(np.percentile(all_lats, 50)) * 1000, 2),
             "p99_ms": round(float(np.percentile(all_lats, 99)) * 1000, 2),
+            "response_cache_qps": cached_qps,
         }))
     finally:
         for pr in extra_procs:

@@ -9,10 +9,10 @@ queries can also be requested as numpy matrices.
 
 from __future__ import annotations
 
+import http.client
 import json
 import urllib.parse
-import urllib.request
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -27,22 +27,49 @@ class FiloClient:
     port: int = 8080
     dataset: str = "timeseries"
     timeout_s: float = 60.0
+    # persistent keep-alive connection (NOT thread-safe: share a client
+    # across threads and requests interleave — use one client per thread,
+    # as the serving benchmark and reference Client facades do)
+    _conn: http.client.HTTPConnection | None = field(
+        default=None, repr=False, compare=False)
 
     # -- http plumbing --
 
+    def _request(self, path_qs: str) -> tuple[int, bytes]:
+        """One GET over the cached keep-alive connection; reconnects once
+        on a stale socket (server restarted / idle timeout)."""
+        for attempt in (0, 1):
+            if self._conn is None:
+                self._conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout_s)
+            try:
+                self._conn.request("GET", path_qs)
+                resp = self._conn.getresponse()
+                body = resp.read()
+                if resp.will_close:
+                    self._conn.close()
+                    self._conn = None
+                return resp.status, body
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self._conn.close()
+                self._conn = None
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
     def _get(self, path: str, **params) -> dict:
         qs = urllib.parse.urlencode(params, doseq=True)
-        url = f"http://{self.host}:{self.port}{path}" + (f"?{qs}" if qs
-                                                         else "")
+        status, raw = self._request(path + (f"?{qs}" if qs else ""))
         try:
-            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-                body = json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            try:
-                body = json.loads(e.read())
-            except Exception:
-                raise FiloClientError(f"HTTP {e.code}") from e
-            raise FiloClientError(body.get("error", str(body))) from e
+            body = json.loads(raw)
+        except Exception as e:
+            if status >= 400:
+                raise FiloClientError(f"HTTP {status}") from e
+            raise
+        if status >= 400:
+            raise FiloClientError(
+                body.get("error", str(body)) if isinstance(body, dict)
+                else str(body))
         if isinstance(body, dict) and body.get("status") == "error":
             raise FiloClientError(body.get("error", "unknown error"))
         return body

@@ -59,6 +59,8 @@ class ServerConfig:
     wal_remote: str | None = None  # "host:port" — use a remote log server
     http_port: int = 8080
     http_reuse_port: bool = False  # SO_REUSEPORT multi-process serving
+    http_impl: str = "fast"  # "fast" event loop | "threaded" stdlib server
+    http_response_cache: bool = True  # data_version-keyed rendered-JSON cache
     gateway_port: int = 0
     executor_port: int = 0
     seeds: list[str] = field(default_factory=list)
@@ -98,6 +100,8 @@ class ServerConfig:
             wal_remote=cfg.get("wal_remote"),
             http_port=cfg["http_port"],
             http_reuse_port=cfg.get("http_reuse_port", False),
+            http_impl=cfg.get("http_impl", "fast"),
+            http_response_cache=cfg.get("http_response_cache", True),
             gateway_port=cfg["gateway_port"],
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),

@@ -11,9 +11,14 @@ Counterpart of reference ``FiloHttpServer.scala`` route composition
 - ``GET /api/v1/cluster/{dataset}/status`` (shard statuses)
 - ``GET /__health``, ``GET /metrics`` (Prometheus exposition)
 
-Threaded stdlib server: queries run on the request thread; the memstore's
-read path is immutable-snapshot based so no global lock is needed (mirrors
-the reference's reader/ingester separation).
+Two server fronts share one ``HttpDispatcher`` (all routing/rendering):
+
+- ``FiloHttpServer`` — threaded stdlib server (one thread per connection);
+  queries run on the request thread through the ``QueryBatcher``.
+- ``filodb_tpu.http.fastserver.FastHttpServer`` — single-threaded selector
+  event loop that coalesces every hot query parsed in one readiness pass
+  into a single ``query_range_many`` engine batch (the serving-side analog
+  of inference micro-batching, and the default standalone front end).
 """
 
 from __future__ import annotations
@@ -32,6 +37,288 @@ from filodb_tpu.utils.metrics import render_prometheus
 
 log = logging.getLogger(__name__)
 
+JSON_CT = "application/json"
+
+
+class ResponseCache:
+    """Rendered-response cache for hot query endpoints, invalidated by the
+    dataset's ingest data_version (the query-frontend pattern: Prometheus
+    deployments put an equivalent cache — Thanos/Cortex query-frontend — in
+    front of the reference; here it is built in). Keys are the RESOLVED
+    query parameters, so an instant query defaulting to server time never
+    aliases across seconds. A version bump (any ingest into any shard of
+    the dataset) orphans every entry for that service."""
+
+    def __init__(self, cap: int = 1024):
+        from collections import OrderedDict
+        self.cap = cap
+        self.hits = 0
+        self.misses = 0
+        self._lru: "OrderedDict[tuple, tuple[int, bytes]]" = OrderedDict()
+        # the threaded front mutates from concurrent handler threads
+        self._lock = threading.Lock()
+
+    def get(self, key: tuple, version: int) -> bytes | None:
+        with self._lock:
+            entry = self._lru.get(key)
+            if entry is None or entry[0] != version:
+                self.misses += 1
+                return None
+            self._lru.move_to_end(key)
+            self.hits += 1
+            return entry[1]
+
+    def put(self, key: tuple, version: int, body: bytes) -> None:
+        with self._lock:
+            while len(self._lru) >= self.cap:
+                self._lru.popitem(last=False)
+            self._lru[key] = (version, body)
+
+
+def service_version(svc) -> int:
+    """Cache-invalidation stamp: total ingest progress across the
+    dataset's shards (bumps on every applied write)."""
+    return sum(s.data_version for s in svc.memstore.shards_for(svc.dataset))
+
+
+def parse_time(s: str) -> float:
+    """Unix seconds (float) or RFC3339 (Grafana sends either)."""
+    try:
+        return float(s)
+    except ValueError:
+        import datetime as dt
+        return dt.datetime.fromisoformat(s.replace("Z", "+00:00")) \
+            .timestamp()
+
+
+class HttpDispatcher:
+    """All route handling, shared by the threaded and event-loop fronts.
+
+    ``handle`` never raises: every outcome is a ``(status, headers, body)``
+    triple, with errors rendered as Prom-style JSON error envelopes."""
+
+    def __init__(self, app: "FiloHttpServer"):
+        self.app = app
+
+    # -- entry --
+
+    def handle(self, command: str, path: str, raw: bytes = b"",
+               content_type: str = "") -> tuple[int, dict, bytes]:
+        try:
+            url = urlparse(path)
+            qs = parse_qs(url.query)
+            parts = [p for p in url.path.split("/") if p]
+            if command == "POST":
+                if parts[-1:] == ["read"]:
+                    return self._remote_read(parts, raw)
+                if raw and "x-www-form-urlencoded" in content_type:
+                    for k, v in parse_qs(raw.decode()).items():
+                        qs.setdefault(k, v)
+            return self._dispatch(parts, qs)
+        except (ParseError, ValueError) as e:
+            return self._json(400, promjson.error_json(str(e)))
+        except QueryLimitExceeded as e:
+            return self._json(422, promjson.error_json(str(e), "query_limit"))
+        except Exception as e:  # pragma: no cover
+            log.exception("request failed")
+            return self._json(500, promjson.error_json(str(e), "internal"))
+
+    # -- helpers --
+
+    @staticmethod
+    def _json(code: int, payload) -> tuple[int, dict, bytes]:
+        body = payload.encode() if isinstance(payload, str) \
+            else json.dumps(payload).encode()
+        return code, {"Content-Type": JSON_CT}, body
+
+    # -- routing --
+
+    def _dispatch(self, parts: list[str], qs: dict):
+        if parts == ["__health"]:
+            return self._json(200, {"status": "healthy"})
+        if parts == ["metrics"]:
+            return (200, {"Content-Type": "text/plain; version=0.0.4"},
+                    render_prometheus().encode())
+        if len(parts) >= 4 and parts[0] == "promql" \
+                and parts[2] == "api" and parts[3] == "v1":
+            dataset = parts[1]
+            svc = self.app.services.get(dataset)
+            if svc is None:
+                return self._json(404, promjson.error_json(
+                    f"unknown dataset {dataset}"))
+            return self._prom_api(svc, parts[4:], qs)
+        if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1" \
+                and parts[2] == "cluster":
+            return self._cluster_api(parts[3:], qs)
+        return self._json(404, promjson.error_json("not found", "not_found"))
+
+    # -- Prom API --
+
+    @staticmethod
+    def range_params(qs: dict) -> tuple[str, int, int, int]:
+        """(query, start, step, end) for a query_range request."""
+        return (qs["query"][0], int(parse_time(qs["start"][0])),
+                int(float(qs.get("step", ["60"])[0])),
+                int(parse_time(qs["end"][0])))
+
+    @staticmethod
+    def instant_params(qs: dict) -> tuple[str, int]:
+        """(query, time) for an instant query request."""
+        if "time" in qs:
+            t = int(parse_time(qs["time"][0]))
+        else:
+            # Prometheus defaults instant queries to server time
+            import time as _time
+            t = int(_time.time())
+        return qs["query"][0], t
+
+    def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
+        cache = self.app.response_cache
+        if rest == ["query_range"]:
+            query, start, step, end = self.range_params(qs)
+            key = (id(svc), "range", query, start, step, end)
+            version = service_version(svc) if cache is not None else 0
+            if cache is not None:
+                body = cache.get(key, version)
+                if body is not None:
+                    return 200, {"Content-Type": JSON_CT}, body
+            r = self.app.batched(svc).query_range(query, start, step, end)
+            out = self._json(200, promjson.matrix_json_str(r))
+            if cache is not None:
+                cache.put(key, version, out[2])
+            return out
+        if rest == ["query"]:
+            query, t = self.instant_params(qs)
+            key = (id(svc), "instant", query, t)
+            version = service_version(svc) if cache is not None else 0
+            if cache is not None:
+                body = cache.get(key, version)
+                if body is not None:
+                    return 200, {"Content-Type": JSON_CT}, body
+            r = self.app.batched(svc).query_range(query, t, 0, t)
+            out = self._json(200, promjson.vector_json_str(r))
+            if cache is not None:
+                cache.put(key, version, out[2])
+            return out
+        if rest == ["series"]:
+            matches = qs.get("match[]", [])
+            start = int(parse_time(qs.get("start", ["0"])[0]))
+            end = int(parse_time(qs.get("end", ["9999999999"])[0]))
+            out = []
+            for mtext in matches:
+                plan = parse_query(mtext, TimeStepParams(start, 0, end))
+                raw = getattr(plan, "raw", None)
+                filters = raw.filters if raw is not None else ()
+                for lm in svc.series(list(filters), start, end):
+                    out.append({("__name__" if k == "_metric_" else k): v
+                                for k, v in lm.items()})
+            return self._json(200, {"status": "success", "data": out})
+        if rest == ["labels"]:
+            names = [("__name__" if n == "_metric_" else n)
+                     for n in svc.memstore.label_names(svc.dataset)]
+            return self._json(200, {"status": "success", "data": names})
+        if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
+            label = unquote(rest[1])
+            if label == "__name__":
+                label = "_metric_"
+            vals = svc.memstore.label_values(svc.dataset, label)
+            return self._json(200, {"status": "success", "data": vals})
+        return self._json(404, promjson.error_json("unknown endpoint"))
+
+    def _remote_read(self, parts: list[str], body: bytes):
+        """Prometheus remote-read (protobuf; reference remote-storage
+        protocol endpoint in PrometheusApiRoute)."""
+        from filodb_tpu.http import remote_read as rr
+        if len(parts) < 2 or parts[0] != "promql":
+            return self._json(404, promjson.error_json("not found"))
+        svc = self.app.services.get(parts[1])
+        if svc is None:
+            return self._json(404, promjson.error_json(
+                f"unknown dataset {parts[1]}"))
+        data = rr.maybe_decompress(body)
+        try:
+            queries = rr.decode_read_request(data)
+        except Exception:
+            return self._json(501 if not rr.HAVE_SNAPPY else 400,
+                              promjson.error_json(
+                                  "could not decode read request "
+                                  "(snappy unavailable?)"))
+        results = []
+        for q in queries:
+            series = []
+            for shard in svc.memstore.shards_for(svc.dataset):
+                for pid in shard.lookup_partitions(
+                        q["filters"], q["start_ms"], q["end_ms"]):
+                    part = shard.partition(pid)
+                    if part is None:
+                        continue
+                    ts, vals = part.read_samples(q["start_ms"], q["end_ms"])
+                    import numpy as _np
+                    if len(ts) and not isinstance(vals, _np.ndarray):
+                        continue  # histograms not in remote-read v1
+                    series.append((list(part.part_key.labels), ts, vals))
+            results.append(series)
+        payload = rr.maybe_compress(rr.encode_read_response(results))
+        return (200, {"Content-Type": "application/x-protobuf",
+                      "Content-Encoding":
+                          "snappy" if rr.HAVE_SNAPPY else "identity"},
+                payload)
+
+    # -- cluster admin --
+
+    def _cluster_api(self, rest: list[str], qs: dict):
+        cluster = self.app.cluster
+        if not rest:
+            return self._json(200, {"status": "success",
+                                    "data": list(self.app.services)})
+        dataset = rest[0]
+        if len(rest) == 2 and rest[1] in ("startshards", "stopshards") \
+                and cluster is not None:
+            # reference ClusterApiRoute start/stop shards commands
+            from filodb_tpu.coordinator.shardmapper import (
+                ShardEvent,
+                ShardStatus,
+            )
+            shards = [int(s) for s in
+                      qs.get("shards", [""])[0].split(",") if s]
+            node = qs.get("node", [None])[0]
+            sm = cluster.shard_managers.get(dataset)
+            if sm is None:
+                return self._json(404, promjson.error_json(
+                    f"unknown dataset {dataset}"))
+            done = []
+            for shard in shards:
+                if rest[1] == "stopshards":
+                    owner = sm.mapper.node_for(shard)
+                    if owner and owner in cluster.nodes:
+                        cluster.nodes[owner].stop_shard(dataset, shard)
+                        sm._publish(ShardEvent(shard, ShardStatus.STOPPED,
+                                               None))
+                        done.append(shard)
+                else:
+                    target = node or next(iter(cluster.nodes), None)
+                    if target:
+                        ev = ShardEvent(shard, ShardStatus.ASSIGNED, target)
+                        sm._publish(ev)
+                        cluster._on_event(dataset, ev)
+                        done.append(shard)
+            return self._json(200, {"status": "success", "data": done})
+        if len(rest) == 2 and rest[1] == "status":
+            if cluster is not None:
+                data = cluster.shard_statuses(dataset)
+            elif dataset in self.app.shard_maps:
+                # member: serve the coordinator's state from the local
+                # mirror (sequenced subscription with resync)
+                data = self.app.shard_maps[dataset]().snapshot()
+            else:
+                svc = self.app.services.get(dataset)
+                data = [{"shard": s.shard_num, "status": "active",
+                         "numPartitions": s.num_partitions}
+                        for s in svc.memstore.shards_for(dataset)] \
+                    if svc else []
+            return self._json(200, {"status": "success", "data": data})
+        return self._json(404, promjson.error_json("unknown cluster endpoint"))
+
 
 class _ReusePortHTTPServer(ThreadingHTTPServer):
     """SO_REUSEPORT variant: N server processes bind the same port and the
@@ -49,12 +336,14 @@ class _ReusePortHTTPServer(ThreadingHTTPServer):
 class FiloHttpServer:
     def __init__(self, services: dict[str, QueryService], host="127.0.0.1",
                  port=8080, cluster=None, shard_maps=None,
-                 reuse_port: bool = False):
+                 reuse_port: bool = False, response_cache: bool = True):
         self.services = services
         self.cluster = cluster
         # member mode: dataset -> mirrored ShardMapper (StatusActor
         # subscription) so members answer cluster-status queries locally
         self.shard_maps = shard_maps or {}
+        self.response_cache = ResponseCache() if response_cache else None
+        self.dispatcher = HttpDispatcher(self)
         handler = _make_handler(self)
         cls = _ReusePortHTTPServer if reuse_port else ThreadingHTTPServer
         self.httpd = cls((host, port), handler)
@@ -82,16 +371,6 @@ class FiloHttpServer:
         self.httpd.server_close()
 
 
-def _parse_time(s: str) -> float:
-    """Unix seconds (float) or RFC3339 (Grafana sends either)."""
-    try:
-        return float(s)
-    except ValueError:
-        import datetime as dt
-        return dt.datetime.fromisoformat(s.replace("Z", "+00:00")) \
-            .timestamp()
-
-
 def _make_handler(server: FiloHttpServer):
     class Handler(BaseHTTPRequestHandler):
         # keep-alive: HTTP/1.0 would pay a TCP connect + handler thread
@@ -102,16 +381,6 @@ def _make_handler(server: FiloHttpServer):
         def log_message(self, fmt, *args):  # quiet
             log.debug(fmt, *args)
 
-        def _send(self, code: int, payload):
-            # str payloads are pre-rendered JSON (vectorized fast path)
-            body = payload.encode() if isinstance(payload, str) \
-                else json.dumps(payload).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
         def do_GET(self):
             self._route()
 
@@ -119,195 +388,21 @@ def _make_handler(server: FiloHttpServer):
             self._route()
 
         def _route(self):
-            try:
-                url = urlparse(self.path)
-                qs = parse_qs(url.query)
-                parts = [p for p in url.path.split("/") if p]
-                if self.command == "POST":
+            raw = b""
+            if self.command == "POST":
+                try:
                     ln = int(self.headers.get("Content-Length") or 0)
-                    raw = self.rfile.read(ln) if ln else b""
-                    if parts[-1:] == ["read"]:
-                        return self._remote_read(parts, raw)
-                    if raw:
-                        ctype = self.headers.get("Content-Type", "")
-                        if "x-www-form-urlencoded" in ctype:
-                            for k, v in parse_qs(raw.decode()).items():
-                                qs.setdefault(k, v)
-                self._dispatch(parts, qs)
-            except (ParseError, ValueError) as e:
-                self._send(400, promjson.error_json(str(e)))
-            except QueryLimitExceeded as e:
-                self._send(422, promjson.error_json(str(e), "query_limit"))
-            except Exception as e:  # pragma: no cover
-                log.exception("request failed")
-                self._send(500, promjson.error_json(str(e), "internal"))
-
-        def _dispatch(self, parts: list[str], qs: dict):
-            if parts == ["__health"]:
-                return self._send(200, {"status": "healthy"})
-            if parts == ["metrics"]:
-                body = render_prometheus().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            if len(parts) >= 4 and parts[0] == "promql" \
-                    and parts[2] == "api" and parts[3] == "v1":
-                dataset = parts[1]
-                svc = server.services.get(dataset)
-                if svc is None:
-                    return self._send(404, promjson.error_json(
-                        f"unknown dataset {dataset}"))
-                return self._prom_api(svc, parts[4:], qs)
-            if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1" \
-                    and parts[2] == "cluster":
-                return self._cluster_api(parts[3:], qs)
-            self._send(404, promjson.error_json("not found", "not_found"))
-
-        # -- Prom API --
-
-        def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
-            if rest == ["query_range"]:
-                query = qs["query"][0]
-                start = int(_parse_time(qs["start"][0]))
-                end = int(_parse_time(qs["end"][0]))
-                step = int(float(qs.get("step", ["60"])[0]))
-                r = server.batched(svc).query_range(query, start, step, end)
-                return self._send(200, promjson.matrix_json_str(r))
-            if rest == ["query"]:
-                query = qs["query"][0]
-                if "time" in qs:
-                    t = int(_parse_time(qs["time"][0]))
-                else:
-                    # Prometheus defaults instant queries to server time
-                    import time as _time
-                    t = int(_time.time())
-                r = server.batched(svc).query_range(query, t, 0, t)
-                return self._send(200, promjson.vector_json_str(r))
-            if rest == ["series"]:
-                matches = qs.get("match[]", [])
-                start = int(_parse_time(qs.get("start", ["0"])[0]))
-                end = int(_parse_time(qs.get("end", ["9999999999"])[0]))
-                out = []
-                for mtext in matches:
-                    plan = parse_query(mtext, TimeStepParams(start, 0, end))
-                    raw = getattr(plan, "raw", None)
-                    filters = raw.filters if raw is not None else ()
-                    for lm in svc.series(list(filters), start, end):
-                        out.append({("__name__" if k == "_metric_" else k): v
-                                    for k, v in lm.items()})
-                return self._send(200, {"status": "success", "data": out})
-            if rest == ["labels"]:
-                names = [("__name__" if n == "_metric_" else n)
-                         for n in svc.memstore.label_names(svc.dataset)]
-                return self._send(200, {"status": "success", "data": names})
-            if len(rest) == 3 and rest[0] == "label" and rest[2] == "values":
-                label = unquote(rest[1])
-                if label == "__name__":
-                    label = "_metric_"
-                vals = svc.memstore.label_values(svc.dataset, label)
-                return self._send(200, {"status": "success", "data": vals})
-            self._send(404, promjson.error_json("unknown endpoint"))
-
-        def _remote_read(self, parts: list[str], body: bytes):
-            """Prometheus remote-read (protobuf; reference remote-storage
-            protocol endpoint in PrometheusApiRoute)."""
-            from filodb_tpu.http import remote_read as rr
-            if len(parts) < 2 or parts[0] != "promql":
-                return self._send(404, promjson.error_json("not found"))
-            svc = server.services.get(parts[1])
-            if svc is None:
-                return self._send(404, promjson.error_json(
-                    f"unknown dataset {parts[1]}"))
-            data = rr.maybe_decompress(body)
-            try:
-                queries = rr.decode_read_request(data)
-            except Exception:
-                return self._send(501 if not rr.HAVE_SNAPPY else 400,
-                                  promjson.error_json(
-                                      "could not decode read request "
-                                      "(snappy unavailable?)"))
-            results = []
-            for q in queries:
-                series = []
-                for shard in svc.memstore.shards_for(svc.dataset):
-                    for pid in shard.lookup_partitions(
-                            q["filters"], q["start_ms"], q["end_ms"]):
-                        part = shard.partition(pid)
-                        if part is None:
-                            continue
-                        ts, vals = part.read_samples(q["start_ms"],
-                                                     q["end_ms"])
-                        import numpy as _np
-                        if len(ts) and not isinstance(vals, _np.ndarray):
-                            continue  # histograms not in remote-read v1
-                        series.append((list(part.part_key.labels), ts, vals))
-                results.append(series)
-            payload = rr.maybe_compress(rr.encode_read_response(results))
-            self.send_response(200)
-            self.send_header("Content-Type", "application/x-protobuf")
-            self.send_header("Content-Encoding",
-                             "snappy" if rr.HAVE_SNAPPY else "identity")
-            self.send_header("Content-Length", str(len(payload)))
+                except ValueError:
+                    ln = 0
+                raw = self.rfile.read(ln) if ln else b""
+            code, headers, body = server.dispatcher.handle(
+                self.command, self.path, raw,
+                self.headers.get("Content-Type", ""))
+            self.send_response(code)
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
             self.end_headers()
-            self.wfile.write(payload)
-
-        # -- cluster admin --
-
-        def _cluster_api(self, rest: list[str], qs: dict):
-            cluster = server.cluster
-            if not rest:
-                return self._send(200, {"status": "success",
-                                        "data": list(server.services)})
-            dataset = rest[0]
-            if len(rest) == 2 and rest[1] in ("startshards", "stopshards") \
-                    and cluster is not None:
-                # reference ClusterApiRoute start/stop shards commands
-                from filodb_tpu.coordinator.shardmapper import (
-                    ShardEvent,
-                    ShardStatus,
-                )
-                shards = [int(s) for s in
-                          qs.get("shards", [""])[0].split(",") if s]
-                node = qs.get("node", [None])[0]
-                sm = cluster.shard_managers.get(dataset)
-                if sm is None:
-                    return self._send(404, promjson.error_json(
-                        f"unknown dataset {dataset}"))
-                done = []
-                for shard in shards:
-                    if rest[1] == "stopshards":
-                        owner = sm.mapper.node_for(shard)
-                        if owner and owner in cluster.nodes:
-                            cluster.nodes[owner].stop_shard(dataset, shard)
-                            sm._publish(ShardEvent(shard, ShardStatus.STOPPED,
-                                                   None))
-                            done.append(shard)
-                    else:
-                        target = node or next(iter(cluster.nodes), None)
-                        if target:
-                            ev = ShardEvent(shard, ShardStatus.ASSIGNED,
-                                            target)
-                            sm._publish(ev)
-                            cluster._on_event(dataset, ev)
-                            done.append(shard)
-                return self._send(200, {"status": "success", "data": done})
-            if len(rest) == 2 and rest[1] == "status":
-                if cluster is not None:
-                    data = cluster.shard_statuses(dataset)
-                elif dataset in server.shard_maps:
-                    # member: serve the coordinator's state from the local
-                    # mirror (sequenced subscription with resync)
-                    data = server.shard_maps[dataset]().snapshot()
-                else:
-                    svc = server.services.get(dataset)
-                    data = [{"shard": s.shard_num, "status": "active",
-                             "numPartitions": s.num_partitions}
-                            for s in svc.memstore.shards_for(dataset)] \
-                        if svc else []
-                return self._send(200, {"status": "success", "data": data})
-            self._send(404, promjson.error_json("unknown cluster endpoint"))
+            self.wfile.write(body)
 
     return Handler

@@ -206,11 +206,17 @@ class FiloServer:
             name: (lambda n=name: self.shard_subscribers[n].mapper)
             for name in getattr(self, "shard_subscribers", {})
         }
-        self.http = FiloHttpServer(services, port=cfg.http_port,
-                                   cluster=self.cluster
-                                   if not cfg.seeds else None,
-                                   shard_maps=shard_maps,
-                                   reuse_port=cfg.http_reuse_port).start()
+        if cfg.http_impl == "fast":
+            from filodb_tpu.http.fastserver import FastHttpServer
+            http_cls = FastHttpServer
+        else:
+            http_cls = FiloHttpServer
+        self.http = http_cls(services, port=cfg.http_port,
+                             cluster=self.cluster
+                             if not cfg.seeds else None,
+                             shard_maps=shard_maps,
+                             reuse_port=cfg.http_reuse_port,
+                             response_cache=cfg.http_response_cache).start()
         if cfg.gateway_port:
             first = next(iter(cfg.datasets.values()))
             sink = ContainerSink(

@@ -20,8 +20,10 @@ from filodb_tpu.testing.data import counter_series, counter_stream
 START = 1_600_000_000
 
 
-@pytest.fixture(scope="module")
-def server():
+@pytest.fixture(scope="module", params=["threaded", "fast"])
+def server(request):
+    """Every API test runs against BOTH fronts: the threaded stdlib server
+    and the selector event-loop server (shared HttpDispatcher routing)."""
     ms = TimeSeriesMemStore()
     for s in range(4):
         ms.setup("timeseries", s, StoreConfig(max_chunk_size=100))
@@ -29,7 +31,11 @@ def server():
     ingest_routed(ms, "timeseries",
                   counter_stream(keys, 400, start_ms=START * 1000), 4, 1)
     svc = QueryService(ms, "timeseries", 4, spread=1)
-    srv = FiloHttpServer({"timeseries": svc}, port=0).start()
+    if request.param == "fast":
+        from filodb_tpu.http.fastserver import FastHttpServer
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+    else:
+        srv = FiloHttpServer({"timeseries": svc}, port=0).start()
     yield srv
     srv.stop()
 
@@ -246,3 +252,85 @@ class TestTimeFormats:
                          end=end.isoformat().replace("+00:00", "Z"), step=60)
         assert code == 200
         assert len(body["data"]["result"]) == 5
+
+
+class TestResponseCache:
+    """The rendered-response cache must serve identical bytes on repeat and
+    drop entries the moment any shard of the dataset applies a write."""
+
+    @pytest.fixture()
+    def fast(self):
+        from filodb_tpu.http.fastserver import FastHttpServer
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        keys = counter_series(3, metric="http_requests_total")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(keys, 200, start_ms=START * 1000), 1, 0)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        yield srv, ms, keys
+        srv.stop()
+
+    def test_hit_and_invalidate(self, fast):
+        srv, ms, keys = fast
+        q = dict(query="count(http_requests_total)", time=START + 1500)
+        _, r1 = get(srv, "/promql/timeseries/api/v1/query", **q)
+        h0 = srv.response_cache.hits
+        _, r2 = get(srv, "/promql/timeseries/api/v1/query", **q)
+        assert r1 == r2
+        assert srv.response_cache.hits == h0 + 1
+
+        # a write to the dataset orphans the entry: new series must appear
+        more = counter_series(5, metric="http_requests_total")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(more, 200, start_ms=START * 1000), 1, 0)
+        _, r3 = get(srv, "/promql/timeseries/api/v1/query", **q)
+        assert float(r3["data"]["result"][0]["value"][1]) == 5.0
+
+    def test_instant_without_time_not_aliased(self, fast):
+        srv, _, _ = fast
+        # resolved-params keying: two bare instant queries in different
+        # seconds must not collide (regression guard for raw-path keying)
+        from filodb_tpu.http.server import HttpDispatcher
+        q1, t1 = HttpDispatcher.instant_params({"query": ["up"]})
+        import time as _t
+        _t.sleep(1.1)
+        q2, t2 = HttpDispatcher.instant_params({"query": ["up"]})
+        assert (q1, t1) != (q2, t2)
+
+
+class TestFastServerPipelining:
+    def test_cold_then_hot_in_one_segment(self):
+        """Regression: a flushed cold response must not shift the slot a
+        pending hot (batched) request writes into."""
+        import socket as _socket
+
+        from filodb_tpu.http.fastserver import FastHttpServer
+
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        keys = counter_series(2, metric="http_requests_total")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(keys, 100, start_ms=START * 1000), 1, 0)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        try:
+            q = urllib.parse.urlencode(dict(
+                query="count(http_requests_total)", time=START + 500))
+            req = (b"GET /__health HTTP/1.1\r\nHost: x\r\n\r\n"
+                   b"GET /promql/timeseries/api/v1/query?" + q.encode()
+                   + b" HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+            with _socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=10) as s:
+                s.sendall(req)
+                buf = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf = buf + chunk
+            assert buf.count(b"HTTP/1.1 200") == 2
+            assert b"healthy" in buf
+            assert b'"2.0"' in buf  # count(http_requests_total) == 2
+        finally:
+            srv.stop()
