@@ -88,15 +88,12 @@ class QueryService:
                 plans, self.memstore, self.dataset, stats_list)
 
         results = []
+        mesh_idx = []
         for i, plan in enumerate(plans):
             data = mesh_results[i]
             if data is not None:
-                from filodb_tpu.query.exec.plan import ExecPlan
-                qcontext = QueryContext()
-                ExecPlan._enforce_limits(data, qcontext)
-                stats = stats_list[i]
-                stats.result_series = data.num_series
-                results.append(QueryResult(data, stats, qcontext.query_id))
+                mesh_idx.append(i)
+                results.append(QueryResult(data, stats_list[i], None))
             else:
                 results.append(self.execute_logical(plan, materialize=False))
         # Coalesced device→host fetch: stack same-shaped lazy result buffers
@@ -114,6 +111,18 @@ class QueryService:
                                             for i in idxs]))
             for j, i in enumerate(idxs):
                 results[i].result.values = stacked[j]
+                # apply any compaction deferred while values were on device
+                results[i].result.materialize()
+        # limits + stats AFTER materialization, so deferred compaction has
+        # dropped empty series first (enforcing on the pre-compaction count
+        # rejected queries the sequential path accepted)
+        from filodb_tpu.query.exec.plan import ExecPlan
+        for i in mesh_idx:
+            data = results[i].result.materialize()
+            qcontext = QueryContext()
+            ExecPlan._enforce_limits(data, qcontext)
+            results[i].stats.result_series = data.num_series
+            results[i].query_id = qcontext.query_id
         return results
 
     def _parse_cached(self, promql: str, params: TimeStepParams):
@@ -152,7 +161,9 @@ class QueryService:
                 data = self.mesh_engine.execute(self.memstore, self.dataset,
                                                 plan, stats)
             if data is not None:  # None = shape the kernels don't cover
-                # same resource guard as the exec path
+                # materialize first so deferred compaction applies, then the
+                # same resource guard as the exec path (on the real count)
+                data.materialize()
                 from filodb_tpu.query.exec.plan import ExecPlan
                 ExecPlan._enforce_limits(data, qcontext)
                 stats.wall_time_s = time.perf_counter() - t0

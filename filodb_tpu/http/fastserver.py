@@ -31,6 +31,7 @@ from filodb_tpu.http.server import (
     JSON_CT,
     HttpDispatcher,
     ResponseCache,
+    response_cache_key,
     service_version,
 )
 from filodb_tpu.promql.parser import ParseError
@@ -264,6 +265,11 @@ class FastHttpServer:
                 elif lower.startswith(b"connection:"):
                     v = lower.split(b":", 1)[1].strip()
                     keep = v != b"close" if keep else v == b"keep-alive"
+            if clen < 0:
+                # a negative length would rewind the request boundary into
+                # the current header block — classic smuggling vector
+                self._close(conn)
+                return
             if clen > _MAX_BODY:
                 self._reject(conn, 413, "request body too large")
                 return
@@ -281,7 +287,8 @@ class FastHttpServer:
             if req is not None:
                 cache = self.response_cache
                 if cache is not None:
-                    req.ckey = (id(req.svc), req.kind, *req.params)
+                    req.ckey = response_cache_key(req.svc, req.kind,
+                                                  req.params)
                     req.version = service_version(req.svc)
                     body = cache.get(req.ckey, req.version)
                     if body is not None:

@@ -81,6 +81,16 @@ def service_version(svc) -> int:
     return sum(s.data_version for s in svc.memstore.shards_for(svc.dataset))
 
 
+def response_cache_key(svc, kind: str, params: tuple) -> tuple:
+    """Canonical response-cache key, shared by both fronts so entries are
+    keyed identically regardless of which server parsed the request.
+    ``params`` is (query, start, step, end) for ranges; instant queries
+    key on (query, resolved_time) — extra positions are ignored."""
+    if kind == "instant":
+        return (id(svc), "instant", params[0], params[1])
+    return (id(svc), "range", *params)
+
+
 def parse_time(s: str) -> float:
     """Unix seconds (float) or RFC3339 (Grafana sends either)."""
     try:
@@ -172,34 +182,31 @@ class HttpDispatcher:
             t = int(_time.time())
         return qs["query"][0], t
 
-    def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
+    def _cached_query(self, svc: QueryService, kind: str, params: tuple):
+        """Hot query with the rendered-response cache around it."""
         cache = self.app.response_cache
+        key = version = None
+        if cache is not None:
+            key = response_cache_key(svc, kind, params)
+            version = service_version(svc)
+            body = cache.get(key, version)
+            if body is not None:
+                return 200, {"Content-Type": JSON_CT}, body
+        r = self.app.batched(svc).query_range(*params)
+        rendered = promjson.matrix_json_str(r) if kind == "range" \
+            else promjson.vector_json_str(r)
+        out = self._json(200, rendered)
+        if cache is not None:
+            cache.put(key, version, out[2])
+        return out
+
+    def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
         if rest == ["query_range"]:
-            query, start, step, end = self.range_params(qs)
-            key = (id(svc), "range", query, start, step, end)
-            version = service_version(svc) if cache is not None else 0
-            if cache is not None:
-                body = cache.get(key, version)
-                if body is not None:
-                    return 200, {"Content-Type": JSON_CT}, body
-            r = self.app.batched(svc).query_range(query, start, step, end)
-            out = self._json(200, promjson.matrix_json_str(r))
-            if cache is not None:
-                cache.put(key, version, out[2])
-            return out
+            params = self.range_params(qs)
+            return self._cached_query(svc, "range", params)
         if rest == ["query"]:
             query, t = self.instant_params(qs)
-            key = (id(svc), "instant", query, t)
-            version = service_version(svc) if cache is not None else 0
-            if cache is not None:
-                body = cache.get(key, version)
-                if body is not None:
-                    return 200, {"Content-Type": JSON_CT}, body
-            r = self.app.batched(svc).query_range(query, t, 0, t)
-            out = self._json(200, promjson.vector_json_str(r))
-            if cache is not None:
-                cache.put(key, version, out[2])
-            return out
+            return self._cached_query(svc, "instant", (query, t, 0, t))
         if rest == ["series"]:
             matches = qs.get("match[]", [])
             start = int(parse_time(qs.get("start", ["0"])[0]))
@@ -392,8 +399,19 @@ def _make_handler(server: FiloHttpServer):
             if self.command == "POST":
                 try:
                     ln = int(self.headers.get("Content-Length") or 0)
-                except ValueError:
-                    ln = 0
+                    if ln < 0:
+                        raise ValueError("negative Content-Length")
+                except ValueError as e:
+                    # unparseable length desyncs the keep-alive stream:
+                    # answer 400 and drop the connection
+                    self.close_connection = True
+                    body = json.dumps(promjson.error_json(str(e))).encode()
+                    self.send_response(400)
+                    self.send_header("Content-Type", JSON_CT)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 raw = self.rfile.read(ln) if ln else b""
             code, headers, body = server.dispatcher.handle(
                 self.command, self.path, raw,

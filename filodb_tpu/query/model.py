@@ -82,18 +82,30 @@ class StepMatrix:
         return self.values.ndim == 3
 
     def compact(self) -> "StepMatrix":
-        """Drop series with no samples at all."""
+        """Drop series with no samples at all.
+
+        On device-resident values compaction is DEFERRED to
+        ``materialize()``: the boolean row mask needs host arrays, and
+        fetching here would cost one device→host round trip per query —
+        through the axon tunnel that is ~75-90ms, which single-handedly
+        capped the batched TPU query path at ~13 q/s. The flag rides along
+        so whichever boundary materializes (including the coalesced
+        batch-fetch in ``query_range_many``) applies the same mask."""
         if self.num_series == 0:
             return self
-        self.materialize()  # boolean masking needs host arrays
-        if self.is_histogram:
-            keep = ~np.all(np.isnan(self.values[:, :, -1]), axis=1)
-        else:
-            keep = ~np.all(np.isnan(self.values), axis=1)
+        if not isinstance(self.values, np.ndarray):
+            self._pending_compact = True
+            return self
+        keep = self._keep_mask()
         if keep.all():
             return self
         keys = [k for k, m in zip(self.keys, keep) if m]
         return StepMatrix(keys, self.values[keep], self.steps_ms, self.les)
+
+    def _keep_mask(self) -> np.ndarray:
+        if self.is_histogram:
+            return ~np.all(np.isnan(self.values[:, :, -1]), axis=1)
+        return ~np.all(np.isnan(self.values), axis=1)
 
     @staticmethod
     def empty(steps_ms: np.ndarray | None = None) -> "StepMatrix":
@@ -101,9 +113,17 @@ class StepMatrix:
         return StepMatrix([], np.zeros((0, len(steps))), steps)
 
     def materialize(self) -> "StepMatrix":
-        """Force device-resident values to host numpy (API boundary)."""
+        """Force device-resident values to host numpy (API boundary), then
+        apply any compaction deferred while values lived on device (row
+        drops mutate in place — callers hold references to this object)."""
         if not isinstance(self.values, np.ndarray):
             self.values = np.asarray(self.values)
+        if getattr(self, "_pending_compact", False):
+            self._pending_compact = False
+            keep = self._keep_mask()
+            if not keep.all():
+                self.keys = [k for k, m in zip(self.keys, keep) if m]
+                self.values = self.values[keep]
         return self
 
     @staticmethod
