@@ -70,20 +70,27 @@ class FileDiscovery(SeedDiscovery):
 
 @dataclass
 class DnsSrvDiscovery(SeedDiscovery):
-    """Reference ``DnsSrvClusterSeedDiscovery``: resolve SRV records.
-    (Uses best-effort socket resolution; environments without DNS SRV
-    support fall back to empty discovery.)"""
+    """Reference ``DnsSrvClusterSeedDiscovery``: resolve SRV records via the
+    built-in wire-format resolver (``utils/dns_srv.py`` — no dnspython in
+    the image). ``server``/``port`` pin a resolver (tests use a stub);
+    otherwise /etc/resolv.conf or $FILODB_DNS_SERVER decides. Resolution
+    failure logs and yields no seeds (bootstrap retries, as the reference's
+    retry loop does)."""
 
     srv_name: str = ""
+    server: str | None = None
+    port: int | None = None
 
     def discover(self):
+        from filodb_tpu.utils.dns_srv import DnsError, resolve_srv
         try:
-            import dns.resolver  # noqa: F401  (not in the base image)
-        except ImportError:
-            log.warning("dnspython unavailable; DNS SRV discovery disabled")
+            records = resolve_srv(self.srv_name, server=self.server,
+                                  port=self.port)
+        except (DnsError, OSError) as e:
+            log.warning("DNS SRV discovery for %s failed: %s",
+                        self.srv_name, e)
             return []
-        answers = dns.resolver.resolve(self.srv_name, "SRV")
-        return [(str(a.target).rstrip("."), a.port) for a in answers]
+        return [(r.target, r.port) for r in records]
 
 
 # ---------------------------------------------------------------------------

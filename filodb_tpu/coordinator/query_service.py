@@ -52,8 +52,10 @@ class QueryService:
     def query_range(self, promql: str, start_sec: int, step_sec: int,
                     end_sec: int, qcontext: QueryContext | None = None
                     ) -> QueryResult:
+        from filodb_tpu.utils.tracing import span
         params = TimeStepParams(start_sec, step_sec, end_sec)
-        plan = parse_query(promql, params, self.lookback_ms)
+        with span("parse", promql=promql):
+            plan = parse_query(promql, params, self.lookback_ms)
         return self.execute_logical(plan, qcontext)
 
     def query_range_many(self, queries, workers: int = 8) -> list:
@@ -156,8 +158,9 @@ class QueryService:
         if self.mesh_engine is not None and self._mesh_eligible() \
                 and self.mesh_engine.supports(plan):
             from filodb_tpu.query.model import QueryStats
+            from filodb_tpu.utils.tracing import span
             stats = QueryStats()
-            with query_latency.time():
+            with query_latency.time(), span("mesh-execute"):
                 data = self.mesh_engine.execute(self.memstore, self.dataset,
                                                 plan, stats)
             if data is not None:  # None = shape the kernels don't cover
@@ -169,9 +172,11 @@ class QueryService:
                 stats.wall_time_s = time.perf_counter() - t0
                 stats.result_series = data.num_series
                 return QueryResult(data, stats, qcontext.query_id)
-        exec_plan = self.planner.materialize(plan, qcontext)
+        from filodb_tpu.utils.tracing import span
+        with span("plan-materialize"):
+            exec_plan = self.planner.materialize(plan, qcontext)
         ctx = ExecContext(self.memstore, self.dataset, qcontext)
-        with query_latency.time():
+        with query_latency.time(), span("exec-dispatch"):
             result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
             if materialize:
                 # device → host once, at the boundary; query_range_many

@@ -74,11 +74,14 @@ def page_partitions(shard: TimeSeriesShard, parts: list[TimeSeriesPartition],
                     start: int, end: int,
                     cache: DemandPagedChunkCache) -> dict[int, list]:
     """Return {part_id: odp_chunks} for partitions needing older data."""
+    from filodb_tpu.utils.tracing import span, tag
     out = {}
-    for p in parts:
-        idx_start = shard.index.start_time(p.part_id)
-        if needs_paging(p, idx_start, start):
-            chunks = cache.get_or_load(shard, p, start, end)
-            if chunks:
-                out[p.part_id] = chunks
+    with span("odp-page", shard=shard.shard_num):  # ref: startODPSpan
+        for p in parts:
+            idx_start = shard.index.start_time(p.part_id)
+            if needs_paging(p, idx_start, start):
+                chunks = cache.get_or_load(shard, p, start, end)
+                if chunks:
+                    out[p.part_id] = chunks
+        tag("partitions_paged", len(out))
     return out

@@ -34,23 +34,81 @@ class _Buffers:
     n: int = 0
 
 
+class WriteBufferPool:
+    """Recycles appender sets across partitions of one schema — the analog
+    of reference ``WriteBufferPool.scala:1-92`` (pre-allocated reusable
+    appenders), sized for series churn: at 1M-series scale with turnover,
+    allocating fresh numpy buffers per created partition is measurable
+    allocator pressure.
+
+    Read-vs-reclaim safety: queries read partitions lock-free, so a buffer
+    released at eviction could still be referenced by an in-flight reader.
+    Released buffers therefore sit in a time quarantine (default 2s —
+    orders of magnitude beyond a query's buffer hold window, which ends at
+    batch-build time) before being handed out again; the same reasoning as
+    the reference's EvictionLock, by time instead of by latch
+    (``doc/memory_safety.md``)."""
+
+    def __init__(self, schema: Schema, max_chunk_size: int, cap: int = 2048,
+                 quarantine_s: float = 2.0):
+        self.schema = schema
+        self.max_chunk_size = max_chunk_size
+        self.cap = cap
+        self.quarantine_s = quarantine_s
+        self._free: list[tuple[float, _Buffers]] = []  # (released_at, buf)
+        self.obtained = 0
+        self.reused = 0
+
+    def obtain(self, factory) -> _Buffers:
+        import time
+        self.obtained += 1
+        if self._free:
+            released_at, buf = self._free[0]
+            if time.monotonic() - released_at >= self.quarantine_s:
+                self._free.pop(0)
+                self.reused += 1
+                # ALL resets happen at re-issue, after the quarantine: a
+                # released buffer stays bit-identical while an in-flight
+                # reader may still hold it
+                buf.n = 0
+                for ci, col in enumerate(self.schema.data.columns[1:]):
+                    if col.ctype == ColumnType.HISTOGRAM:
+                        buf.cols[ci] = None  # bucket schemes vary per series
+                    elif col.ctype == ColumnType.STRING:
+                        buf.cols[ci] = [None] * self.max_chunk_size
+                return buf
+        return factory()
+
+    def release(self, buf: _Buffers | None) -> None:
+        """Quarantine a buffer for later reuse. Deliberately does NOT touch
+        the buffer's contents — see obtain()."""
+        import time
+        if buf is None or len(self._free) >= self.cap \
+                or len(buf.ts) != self.max_chunk_size:
+            return
+        self._free.append((time.monotonic(), buf))
+
+
 class TimeSeriesPartition:
     """One time series: label key + chunks + active write buffer."""
 
     __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "chunks",
                  "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard",
-                 "device_pages", "_dedup_floor")
+                 "device_pages", "_dedup_floor", "buffer_pool")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: Schema,
                  max_chunk_size: int = 400, shard: int = 0,
-                 device_pages: bool = False):
+                 device_pages: bool = False,
+                 buffer_pool: "WriteBufferPool | None" = None):
         self.part_id = part_id
         self.part_key = part_key
         self.schema = schema
         self.shard = shard
         self.max_chunk_size = max_chunk_size
         self.chunks: list[Chunk] = []  # sorted by start time
-        self._buf = self._new_buffers()
+        self.buffer_pool = buffer_pool
+        self._buf = buffer_pool.obtain(self._new_buffers) if buffer_pool \
+            else self._new_buffers()
         self._chunk_seq = 0
         self._flushed_id = -1  # highest chunk id already persisted
         self.bucket_les: np.ndarray | None = None
@@ -172,10 +230,21 @@ class TimeSeriesPartition:
         self._chunk_seq = (self._chunk_seq + 1) & 0xFFF
         # swap the buffer BEFORE publishing the chunk: a concurrent reader
         # (reads chunks first, then the buffer) can momentarily miss the
-        # sealed samples but can never double-count them
+        # sealed samples but can never double-count them. The sealed buffer
+        # is NOT returned to the pool — a lock-free reader may still hold
+        # it; it is garbage-collected once unreferenced. Pool recycling
+        # happens only at partition eviction/purge (quarantined).
         self._buf = self._new_buffers()
         self.chunks.append(chunk)
         return chunk
+
+    def release_buffers(self) -> None:
+        """Return the write buffer to the pool (eviction/purge path — the
+        partition must never ingest again afterwards)."""
+        if self.buffer_pool is not None:
+            self.buffer_pool.release(self._buf)
+            self._buf = _Buffers(np.empty(0, np.int64),
+                                 [None] * len(self._buf.cols))
 
     # ---- flush -----------------------------------------------------------
 

@@ -94,6 +94,10 @@ class TimeSeriesShard:
         # on-demand paging cache (reference OnDemandPagingShard)
         from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
         self.odp_cache = DemandPagedChunkCache()
+        # write-buffer pools per schema (reference WriteBufferPool.scala):
+        # appender sets recycled across series churn, time-quarantined
+        # against in-flight lock-free readers
+        self.buffer_pools: dict[str, object] = {}
         # query-batch cache: repeated scans of unchanged data reuse the
         # decoded/padded SeriesBatch (keyed by ingest version; the analog of
         # the reference keeping chunks hot in block memory across queries)
@@ -181,7 +185,8 @@ class TimeSeriesShard:
                        for s in self.config.trace_part_key_substrings):
                     cls = TracingTimeSeriesPartition
             part = cls(pid, key, schema, self.config.max_chunk_size,
-                       self.shard_num, device_pages=self.config.device_pages)
+                       self.shard_num, device_pages=self.config.device_pages,
+                       buffer_pool=self._pool_for(schema))
         floor = self._persisted_floors.get(key)
         if floor is not None:
             part.seed_dedup_floor(floor)
@@ -199,6 +204,14 @@ class TimeSeriesShard:
         self.stats.partitions_created.inc()
         self.stats.num_partitions.set(len(self.index))
         return part
+
+    def _pool_for(self, schema):
+        from filodb_tpu.core.memstore.partition import WriteBufferPool
+        pool = self.buffer_pools.get(schema.name)
+        if pool is None:
+            pool = self.buffer_pools[schema.name] = WriteBufferPool(
+                schema, self.config.max_chunk_size)
+        return pool
 
     def _maybe_restore_evicted(self, pid: int, key: PartKey, blob: bytes,
                                part) -> None:
@@ -576,6 +589,8 @@ class TimeSeriesShard:
                     self.index.remove_part_key(pid)
                     self._by_key.pop(part.part_key, None)
                     self._host_pids.discard(pid)
+                    if hasattr(part, "release_buffers"):
+                        part.release_buffers()
                     self.partitions[pid] = None
                     if self._native_core is not None:
                         # EVERY partition has a native slot (pid alignment),
@@ -623,6 +638,8 @@ class TimeSeriesShard:
         self.evicted_keys.add(part_key_blob(key))
         self._by_key.pop(key, None)
         self._host_pids.discard(part_id)
+        if hasattr(part, "release_buffers"):
+            part.release_buffers()
         self.partitions[part_id] = None
         if self._native_core is not None:
             with self._native_core.lock:

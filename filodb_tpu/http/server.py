@@ -230,6 +230,27 @@ class HttpDispatcher:
                 label = "_metric_"
             vals = svc.memstore.label_values(svc.dataset, label)
             return self._json(200, {"status": "success", "data": vals})
+        if rest == ["debug", "trace"]:
+            # span-traced execution (reference: Kamon spans around exec,
+            # ExecPlan.scala:101 / startODPSpan — surfaced here as JSON
+            # instead of a zipkin reporter)
+            from filodb_tpu.utils.tracing import start_trace
+            if "start" in qs:
+                query, start, step, end = self.range_params(qs)
+            else:
+                query, t = self.instant_params(qs)
+                start, step, end = t, 0, t
+            with start_trace() as trace:
+                r = svc.query_range(query, start, step, end)
+            return self._json(200, {
+                "status": "success",
+                "data": {"spans": trace.as_dicts(),
+                         "result_series": r.result.num_series,
+                         "stats": {
+                             "series_scanned": r.stats.series_scanned,
+                             "samples_scanned": r.stats.samples_scanned,
+                             "wall_time_s": r.stats.wall_time_s,
+                         }}})
         return self._json(404, promjson.error_json("unknown endpoint"))
 
     def _remote_read(self, parts: list[str], body: bytes):

@@ -78,11 +78,16 @@ class ExecPlan:
         default_factory=InProcessPlanDispatcher, kw_only=True)
 
     def execute(self, ctx: ExecContext) -> QueryResult:
-        data = self.do_execute(ctx)
-        for t in self.transformers:
-            if hasattr(t, "bind"):
-                t.bind(ctx)
-            data = t.apply(data)
+        # span per exec node (reference: Kamon "execute-plan" spans,
+        # ExecPlan.scala:101); free when no trace is active on this thread
+        from filodb_tpu.utils.tracing import span
+        with span(type(self).__name__):
+            data = self.do_execute(ctx)
+            for t in self.transformers:
+                if hasattr(t, "bind"):
+                    t.bind(ctx)
+                with span(type(t).__name__):
+                    data = t.apply(data)
         self._enforce_limits(data, ctx.qcontext)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
 

@@ -1,0 +1,94 @@
+"""Span tracing for the query path.
+
+Counterpart of the reference's Kamon spans around exec-plan execution
+(``query/src/main/scala/filodb/query/exec/ExecPlan.scala:101`` "execute-
+plan" spans, ``OnDemandPagingShard.scala:48`` ``startODPSpan``): nested,
+timed spans collected per query. There is no Kamon/zipkin here; traces are
+in-process objects surfaced through the debug HTTP endpoint
+(``/promql/{ds}/api/v1/debug/trace``), the slow-query log, and tests.
+
+Zero-cost when inactive: ``span()`` checks a thread-local and no-ops unless
+a trace was explicitly started on this thread, so the hot path pays one
+attribute lookup per instrumentation point.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+_local = threading.local()
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float
+    duration_s: float = 0.0
+    depth: int = 0
+    tags: dict = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        d = {"name": self.name, "depth": self.depth,
+             "duration_ms": round(self.duration_s * 1000, 3)}
+        if self.tags:
+            d["tags"] = {k: v for k, v in self.tags.items()}
+        return d
+
+
+@dataclass
+class Trace:
+    spans: list[Span] = field(default_factory=list)
+    _depth: int = 0
+
+    def as_dicts(self) -> list[dict]:
+        return [s.as_dict() for s in self.spans]
+
+    def find(self, name: str) -> list[Span]:
+        return [s for s in self.spans if s.name == name]
+
+
+def current_trace() -> Trace | None:
+    return getattr(_local, "trace", None)
+
+
+@contextmanager
+def start_trace():
+    """Activate tracing on this thread for the duration of the block."""
+    prev = getattr(_local, "trace", None)
+    trace = Trace()
+    _local.trace = trace
+    try:
+        yield trace
+    finally:
+        _local.trace = prev
+
+
+@contextmanager
+def span(name: str, **tags):
+    """Record a nested span if a trace is active; otherwise free."""
+    trace = getattr(_local, "trace", None)
+    if trace is None:
+        yield None
+        return
+    s = Span(name, time.perf_counter(), depth=trace._depth, tags=tags)
+    trace.spans.append(s)
+    trace._depth += 1
+    try:
+        yield s
+    finally:
+        trace._depth -= 1
+        s.duration_s = time.perf_counter() - s.start_s
+
+
+def tag(key: str, value) -> None:
+    """Attach a tag to the innermost open span, if tracing."""
+    trace = getattr(_local, "trace", None)
+    if trace is None or not trace.spans:
+        return
+    for s in reversed(trace.spans):
+        if s.depth == trace._depth - 1:
+            s.tags[key] = value
+            return

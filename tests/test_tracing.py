@@ -1,0 +1,108 @@
+"""Span tracing (reference: Kamon spans around ExecPlan execution,
+``ExecPlan.scala:101``; ODP span ``OnDemandPagingShard.scala:48``)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    machine_metrics_series,
+)
+from filodb_tpu.utils.tracing import span, start_trace
+
+START = 1_600_000_000
+
+
+class TestSpans:
+    def test_nesting_and_timing(self):
+        with start_trace() as trace:
+            with span("outer", q="x"):
+                with span("inner"):
+                    pass
+            with span("sibling"):
+                pass
+        names = [(s.name, s.depth) for s in trace.spans]
+        assert names == [("outer", 0), ("inner", 1), ("sibling", 0)]
+        assert all(s.duration_s >= 0 for s in trace.spans)
+        assert trace.find("outer")[0].tags == {"q": "x"}
+
+    def test_noop_without_trace(self):
+        # no active trace: span() must not record or fail
+        with span("orphan") as s:
+            assert s is None
+
+    def test_exec_path_spans(self):
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        keys = counter_series(4, metric="m")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(keys, 200, start_ms=START * 1000), 1, 0)
+        svc = QueryService(ms, "timeseries", 1, spread=0)  # exec engine
+        with start_trace() as trace:
+            r = svc.query_range("sum(rate(m[5m]))", START + 600, 60,
+                                START + 1200)
+        assert r.result.num_series == 1
+        names = {s.name for s in trace.spans}
+        assert "parse" in names
+        assert "plan-materialize" in names
+        assert "exec-dispatch" in names
+        # exec nodes appear by class name, nested under the dispatch
+        dispatch = trace.find("exec-dispatch")[0]
+        node_spans = [s for s in trace.spans if s.depth > dispatch.depth]
+        assert node_spans, "no exec-node spans recorded"
+
+    def test_odp_span(self, tmp_path):
+        from filodb_tpu.core.store.localstore import (
+            LocalDiskColumnStore,
+            LocalDiskMetaStore,
+        )
+        cs = LocalDiskColumnStore(str(tmp_path / "d"))
+        meta = LocalDiskMetaStore(str(tmp_path / "d"))
+        ms = TimeSeriesMemStore(cs, meta)
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=50))
+        keys = machine_metrics_series(2)
+        shard = ms.get_shard("timeseries", 0)
+        for sd in gauge_stream(keys, 200, start_ms=START * 1000):
+            shard.ingest(sd)
+        shard.flush_all(ingestion_time=1)
+        for p in shard.partitions:
+            if p:
+                shard.evict_partition_chunks(p.part_id)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        with start_trace() as trace:
+            svc.query_range("count_over_time(heap_usage[30m])",
+                            START + 1900, 60, START + 1900)
+        odp = trace.find("odp-page")
+        assert odp and odp[0].tags.get("partitions_paged", 0) > 0
+
+    def test_debug_trace_endpoint(self):
+        from filodb_tpu.http.fastserver import FastHttpServer
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        keys = counter_series(3, metric="m")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(keys, 100, start_ms=START * 1000), 1, 0)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        try:
+            url = (f"http://127.0.0.1:{srv.port}/promql/timeseries/api/v1/"
+                   f"debug/trace?query=sum(rate(m[5m]))&start={START + 300}"
+                   f"&end={START + 900}&step=60")
+            with urllib.request.urlopen(url, timeout=30) as r:
+                body = json.loads(r.read())
+            data = body["data"]
+            assert data["result_series"] == 1
+            assert data["stats"]["samples_scanned"] > 0
+            names = [s["name"] for s in data["spans"]]
+            assert "parse" in names
+            assert all(np.isfinite(s["duration_ms"]) for s in data["spans"])
+        finally:
+            srv.stop()
