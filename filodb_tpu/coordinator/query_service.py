@@ -74,6 +74,7 @@ class QueryService:
         ``(promql, start_sec, step_sec, end_sec)``."""
         import numpy as np
 
+        t0 = time.perf_counter()
         plans = []
         for q in queries:
             promql, start_sec, step_sec, end_sec = q
@@ -108,23 +109,29 @@ class QueryService:
             v = r.result.values
             if not isinstance(v, np.ndarray):
                 by_shape.setdefault((v.shape, str(v.dtype)), []).append(i)
+        from filodb_tpu.query.exec.plan import ExecPlan
+        deferred = set(mesh_idx)
         for idxs in by_shape.values():
             stacked = np.asarray(jnp.stack([results[i].result.values
                                             for i in idxs]))
             for j, i in enumerate(idxs):
                 results[i].result.values = stacked[j]
-                # apply any compaction deferred while values were on device
-                results[i].result.materialize()
+                deferred.add(i)
         # limits + stats AFTER materialization, so deferred compaction has
         # dropped empty series first (enforcing on the pre-compaction count
-        # rejected queries the sequential path accepted)
-        from filodb_tpu.query.exec.plan import ExecPlan
-        for i in mesh_idx:
+        # rejected queries the sequential path accepted) — uniformly for
+        # mesh AND exec-path results whose fetch was deferred to this batch
+        wall = time.perf_counter() - t0
+        for i in sorted(deferred):
             data = results[i].result.materialize()
             qcontext = QueryContext()
             ExecPlan._enforce_limits(data, qcontext)
             results[i].stats.result_series = data.num_series
-            results[i].query_id = qcontext.query_id
+            # batched execution: the whole pass's wall time is every
+            # member's latency (they completed together)
+            results[i].stats.wall_time_s = wall
+            if not results[i].query_id:
+                results[i].query_id = qcontext.query_id
         return results
 
     def _parse_cached(self, promql: str, params: TimeStepParams):
@@ -182,6 +189,10 @@ class QueryService:
                 # device → host once, at the boundary; query_range_many
                 # defers this and batch-fetches across in-flight queries
                 result.result.materialize()
+                # device-resident results skipped in-tree enforcement
+                # (compaction was deferred); enforce on the real count now
+                from filodb_tpu.query.exec.plan import ExecPlan
+                ExecPlan._enforce_limits(result.result, qcontext)
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
         return result

@@ -43,50 +43,81 @@ class WriteBufferPool:
 
     Read-vs-reclaim safety: queries read partitions lock-free, so a buffer
     released at eviction could still be referenced by an in-flight reader.
-    Released buffers therefore sit in a time quarantine (default 2s —
-    orders of magnitude beyond a query's buffer hold window, which ends at
-    batch-build time) before being handed out again; the same reasoning as
-    the reference's EvictionLock, by time instead of by latch
-    (``doc/memory_safety.md``)."""
+    Reclamation is DETERMINISTIC (the role the reference's EvictionLock
+    latch plays, ``doc/memory_safety.md``): a released buffer is re-issued
+    only once nothing outside the pool references the buffer object or any
+    array that reuse would mutate in place. CPython refcounts are exact and
+    a numpy view pins its base array, so a reader stalled for any length of
+    time (GC pause, ODP page-in, device compile) keeps the buffer out of
+    circulation simply by still holding it — no wall-clock assumption."""
 
-    def __init__(self, schema: Schema, max_chunk_size: int, cap: int = 2048,
-                 quarantine_s: float = 2.0):
+    # how many free-list entries obtain() probes per call: a buffer pinned
+    # by a long reader must not wedge the whole pool behind it
+    _PROBE = 8
+
+    def __init__(self, schema: Schema, max_chunk_size: int, cap: int = 2048):
         self.schema = schema
         self.max_chunk_size = max_chunk_size
         self.cap = cap
-        self.quarantine_s = quarantine_s
-        self._free: list[tuple[float, _Buffers]] = []  # (released_at, buf)
+        self._free: list[_Buffers] = []
         self.obtained = 0
         self.reused = 0
+        self.blocked = 0  # probes skipped because a reader still held a ref
+
+    def _reusable(self, buf: _Buffers) -> bool:
+        """True when no reader can still observe a mutation of ``buf``.
+
+        Expected refcounts when unreferenced: the buffer object is held by
+        the free list, obtain()'s local, this parameter, and getrefcount's
+        argument (= 4); each in-place-mutated array only by its _Buffers
+        field plus getrefcount's argument (= 2, +1 for the loop variable).
+        Histogram/string columns are REPLACED (not mutated) at re-issue, so
+        stale references to those can never observe new data and are not
+        checked."""
+        import sys
+        if sys.getrefcount(buf) > 4:
+            return False
+        if sys.getrefcount(buf.ts) > 2:
+            return False
+        cols = self.schema.data.columns[1:]
+        for ci in range(len(cols)):
+            # index access, not zip: zip's yielded tuple would itself hold
+            # a reference to the array for the duration of the loop body
+            if cols[ci].ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
+                continue
+            data = buf.cols[ci]
+            if data is not None and sys.getrefcount(data) > 3:
+                return False
+        return True
 
     def obtain(self, factory) -> _Buffers:
-        import time
         self.obtained += 1
-        if self._free:
-            released_at, buf = self._free[0]
-            if time.monotonic() - released_at >= self.quarantine_s:
-                self._free.pop(0)
-                self.reused += 1
-                # ALL resets happen at re-issue, after the quarantine: a
-                # released buffer stays bit-identical while an in-flight
-                # reader may still hold it
-                buf.n = 0
-                for ci, col in enumerate(self.schema.data.columns[1:]):
-                    if col.ctype == ColumnType.HISTOGRAM:
-                        buf.cols[ci] = None  # bucket schemes vary per series
-                    elif col.ctype == ColumnType.STRING:
-                        buf.cols[ci] = [None] * self.max_chunk_size
-                return buf
+        for i in range(min(len(self._free), self._PROBE)):
+            buf = self._free[i]
+            if not self._reusable(buf):
+                self.blocked += 1
+                continue
+            self._free.pop(i)
+            self.reused += 1
+            # ALL resets happen at re-issue, once provably unreferenced: a
+            # released buffer stays bit-identical while any in-flight
+            # reader still holds it
+            buf.n = 0
+            for ci, col in enumerate(self.schema.data.columns[1:]):
+                if col.ctype == ColumnType.HISTOGRAM:
+                    buf.cols[ci] = None  # bucket schemes vary per series
+                elif col.ctype == ColumnType.STRING:
+                    buf.cols[ci] = [None] * self.max_chunk_size
+            return buf
         return factory()
 
     def release(self, buf: _Buffers | None) -> None:
-        """Quarantine a buffer for later reuse. Deliberately does NOT touch
-        the buffer's contents — see obtain()."""
-        import time
+        """Park a buffer for later reuse. Deliberately does NOT touch the
+        buffer's contents — see obtain()."""
         if buf is None or len(self._free) >= self.cap \
                 or len(buf.ts) != self.max_chunk_size:
             return
-        self._free.append((time.monotonic(), buf))
+        self._free.append(buf)
 
 
 class TimeSeriesPartition:

@@ -95,8 +95,8 @@ class TimeSeriesShard:
         from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
         self.odp_cache = DemandPagedChunkCache()
         # write-buffer pools per schema (reference WriteBufferPool.scala):
-        # appender sets recycled across series churn, time-quarantined
-        # against in-flight lock-free readers
+        # appender sets recycled across series churn, re-issued only once
+        # provably unreferenced by in-flight lock-free readers
         self.buffer_pools: dict[str, object] = {}
         # query-batch cache: repeated scans of unchanged data reuse the
         # decoded/padded SeriesBatch (keyed by ingest version; the analog of

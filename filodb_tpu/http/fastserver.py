@@ -249,22 +249,38 @@ class FastHttpServer:
                 self._close(conn)
                 return
             clen = 0
+            seen_clen = None
             ctype = ""
             keep = version.strip() == b"HTTP/1.1"
+            chunked = False
             for ln in lines[1:]:
                 lower = ln.lower()
-                if lower.startswith(b"content-length:"):
+                if lower.startswith(b"transfer-encoding:"):
+                    # chunked bodies are not framed by this parser; treating
+                    # them as body-less would desync the pipeline (the body
+                    # bytes would parse as the next request)
+                    chunked = True
+                elif lower.startswith(b"content-length:"):
                     try:
                         clen = int(ln.split(b":", 1)[1])
                     except ValueError:
                         self._close(conn)
                         return
+                    if seen_clen is not None and seen_clen != clen:
+                        # differing duplicate Content-Length is the CL.CL
+                        # smuggling vector (RFC 9112 §6.3: must reject)
+                        self._close(conn)
+                        return
+                    seen_clen = clen
                 elif lower.startswith(b"content-type:"):
                     ctype = ln.split(b":", 1)[1].strip().decode(
                         "latin-1", "replace")
                 elif lower.startswith(b"connection:"):
                     v = lower.split(b":", 1)[1].strip()
                     keep = v != b"close" if keep else v == b"keep-alive"
+            if chunked:
+                self._reject(conn, 501, "Transfer-Encoding not supported")
+                return
             if clen < 0:
                 # a negative length would rewind the request boundary into
                 # the current header block — classic smuggling vector
@@ -286,10 +302,12 @@ class FastHttpServer:
             req = self._classify_hot(conn, slot, method, path)
             if req is not None:
                 cache = self.response_cache
-                if cache is not None:
+                svc_version = service_version(req.svc) \
+                    if cache is not None else None
+                if svc_version is not None:
                     req.ckey = response_cache_key(req.svc, req.kind,
                                                   req.params)
-                    req.version = service_version(req.svc)
+                    req.version = svc_version
                     body = cache.get(req.ckey, req.version)
                     if body is not None:
                         conn.fill(slot, _response_bytes(

@@ -75,10 +75,18 @@ class ResponseCache:
             self._lru[key] = (version, body)
 
 
-def service_version(svc) -> int:
+def service_version(svc) -> int | None:
     """Cache-invalidation stamp: total ingest progress across the
-    dataset's shards (bumps on every applied write)."""
-    return sum(s.data_version for s in svc.memstore.shards_for(svc.dataset))
+    dataset's shards (bumps on every applied write).
+
+    Returns ``None`` when the service does not host every shard of the
+    dataset locally — in that case some query results come from remote
+    members whose ingest never bumps these local versions, so the stamp
+    cannot witness staleness and the response cache must be bypassed."""
+    shards = svc.memstore.shards_for(svc.dataset)
+    if len(shards) < getattr(svc, "num_shards", 1):
+        return None
+    return sum(s.data_version for s in shards)
 
 
 def response_cache_key(svc, kind: str, params: tuple) -> tuple:
@@ -187,11 +195,14 @@ class HttpDispatcher:
         cache = self.app.response_cache
         key = version = None
         if cache is not None:
-            key = response_cache_key(svc, kind, params)
             version = service_version(svc)
-            body = cache.get(key, version)
-            if body is not None:
-                return 200, {"Content-Type": JSON_CT}, body
+            if version is None:
+                cache = None  # remote shards: stamp can't witness staleness
+            else:
+                key = response_cache_key(svc, kind, params)
+                body = cache.get(key, version)
+                if body is not None:
+                    return 200, {"Content-Type": JSON_CT}, body
         r = self.app.batched(svc).query_range(*params)
         rendered = promjson.matrix_json_str(r) if kind == "range" \
             else promjson.vector_json_str(r)

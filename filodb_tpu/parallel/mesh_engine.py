@@ -259,17 +259,22 @@ class MeshQueryEngine:
         for idxs in groups.values():
             outs = self.execute_lowered_many(
                 [lows[i] for i in idxs], memstore, dataset,
-                stats_list[idxs[0]] if stats_list else None)
+                [stats_list[i] for i in idxs] if stats_list else None)
             for i, out in zip(idxs, outs):
                 results[i] = out
         return results
 
     def execute_lowered_many(self, lows: list[_Lowered], memstore,
                              dataset: str,
-                             stats: QueryStats | None = None) -> list:
+                             stats: "QueryStats | list | None" = None
+                             ) -> list:
         """Evaluate lowered plans sharing a signature (same selector/fn/agg;
         step grids may differ) in ONE mesh program. Returns one StepMatrix
-        (or None) per entry."""
+        (or None) per entry. ``stats`` is one QueryStats (single query) or a
+        list aligned with ``lows`` — every query in the group scanned the
+        whole shared batch, so each gets the full scan counts."""
+        stats_objs = stats if isinstance(stats, list) \
+            else ([stats] if stats is not None else [])
         from filodb_tpu.parallel.dist_query import (
             make_distributed_range_agg,
             make_distributed_sum_rate_ring,
@@ -298,9 +303,9 @@ class MeshQueryEngine:
                 return [StepMatrix.empty(steps_array(lo.start, lo.step,
                                                      lo.end))
                         for lo in lows]
-            if stats is not None:
-                stats.series_scanned += len(keys)
-                stats.samples_scanned += int(batch.counts.sum())
+            for st in stats_objs:
+                st.series_scanned += len(keys)
+                st.samples_scanned += int(batch.counts.sum())
         else:
             placed = None
             parts = []
@@ -335,9 +340,9 @@ class MeshQueryEngine:
                                 extra_by_obj=extra_by_obj or None)
             if batch.is_histogram:
                 return [None] * len(lows)  # hist stays on the exec path
-            if stats is not None:
-                stats.series_scanned += len(parts)
-                stats.samples_scanned += int(batch.counts.sum())
+            for st in stats_objs:
+                st.series_scanned += len(parts)
+                st.samples_scanned += int(batch.counts.sum())
             # label grouping (first-occurrence order, like
             # AggregateMapReduce). The metric label is dropped first — the
             # exec path drops it in range-function output keys before

@@ -88,7 +88,12 @@ class ExecPlan:
                     t.bind(ctx)
                 with span(type(t).__name__):
                     data = t.apply(data)
-        self._enforce_limits(data, ctx.qcontext)
+        # limits are enforced on the POST-compaction series count on every
+        # path; device-resident results defer compaction to materialize(),
+        # so their enforcement happens at the service boundary instead
+        if isinstance(data.values, np.ndarray) \
+                and not getattr(data, "_pending_compact", False):
+            self._enforce_limits(data, ctx.qcontext)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:

@@ -300,7 +300,7 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
                 out = histogram_quantile(
                     q, jnp.asarray(data.values), jnp.asarray(data.les))
                 keys = [k.drop_metric() for k in data.keys]
-                return StepMatrix(keys, out, data.steps_ms)
+                return data.derive(keys, out)
             return self._bucket_quantile(q, data)
         vals = jnp.asarray(data.values)
         if self.function in ("hour", "minute", "month", "year", "day_of_month",
@@ -310,7 +310,7 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
             params = tuple(float(a) for a in self.args)
             out = apply_instant_fn(self.function, vals, params=params)
         keys = [k.drop_metric() for k in data.keys]
-        return StepMatrix(keys, out, data.steps_ms, data.les)
+        return data.derive(keys, out, data.les)
 
     def _bucket_quantile(self, q: float, data: StepMatrix) -> StepMatrix:
         """histogram_quantile over prom-style `le`-labelled bucket series
@@ -372,7 +372,7 @@ class ScalarOperationMapper(RangeVectorTransformer):
         else:
             out = apply_binary_op(self.op, lhs, rhs, self.bool_mode)
         keys = [k.drop_metric() for k in data.keys]
-        return StepMatrix(keys, out, data.steps_ms)
+        return data.derive(keys, out)
 
 
 @dataclass
@@ -395,7 +395,7 @@ class MiscellaneousFunctionMapper(RangeVectorTransformer):
                     else:
                         lm.pop(dst, None)
                 keys.append(RangeVectorKey.of(lm))
-            return StepMatrix(keys, data.values, data.steps_ms, data.les)
+            return data.derive(keys, data.values, data.les)
         if self.function == "label_join":
             dst, sep, *srcs = self.args
             keys = []
@@ -403,7 +403,7 @@ class MiscellaneousFunctionMapper(RangeVectorTransformer):
                 lm = k.label_map
                 lm[dst] = sep.join(lm.get(s, "") for s in srcs)
                 keys.append(RangeVectorKey.of(lm))
-            return StepMatrix(keys, data.values, data.steps_ms, data.les)
+            return data.derive(keys, data.values, data.les)
         raise ValueError(f"unknown misc function {self.function}")
 
 
@@ -424,8 +424,8 @@ class SortFunctionMapper(RangeVectorTransformer):
         v = np.nan_to_num(data.values[:, -1], nan=-np.inf if not
                           self.descending else np.inf)
         order = np.argsort(-v if self.descending else v, kind="stable")
-        return StepMatrix([data.keys[i] for i in order], data.values[order],
-                          data.steps_ms, data.les)
+        return data.derive([data.keys[i] for i in order],
+                           data.values[order], data.les)
 
 
 @dataclass
@@ -459,5 +459,5 @@ class LimitFunctionMapper(RangeVectorTransformer):
     def apply(self, data: StepMatrix) -> StepMatrix:
         if data.num_series <= self.limit:
             return data
-        return StepMatrix(data.keys[: self.limit],
-                          data.values[: self.limit], data.steps_ms, data.les)
+        return data.derive(data.keys[: self.limit],
+                           data.values[: self.limit], data.les)

@@ -102,6 +102,17 @@ class StepMatrix:
         keys = [k for k, m in zip(self.keys, keep) if m]
         return StepMatrix(keys, self.values[keep], self.steps_ms, self.les)
 
+    def derive(self, keys, values, les=None) -> "StepMatrix":
+        """Copy-construct a result whose rows still correspond 1:1 to (a
+        subset/permutation of) this matrix's rows. Deferred compaction
+        carries over: the all-NaN row mask is recomputed from the NEW
+        values at materialize(), so reorder/slice/elementwise transforms
+        stay correct."""
+        out = StepMatrix(keys, values, self.steps_ms, les)
+        if getattr(self, "_pending_compact", False):
+            out._pending_compact = True
+        return out
+
     def _keep_mask(self) -> np.ndarray:
         if self.is_histogram:
             return ~np.all(np.isnan(self.values[:, :, -1]), axis=1)
@@ -143,7 +154,13 @@ class StepMatrix:
                                      axis=0)
         else:
             values = np.concatenate([p.values for p in parts], axis=0)
-        return StepMatrix(keys, values, parts[0].steps_ms, parts[0].les)
+        out = StepMatrix(keys, values, parts[0].steps_ms, parts[0].les)
+        if any(getattr(p, "_pending_compact", False) for p in parts):
+            # deferred compaction survives concatenation (row-preserving
+            # transforms use derive()) so the materialize boundary still
+            # applies the row mask
+            out._pending_compact = True
+        return out
 
 
 @dataclass

@@ -334,3 +334,103 @@ class TestFastServerPipelining:
             assert b'"2.0"' in buf  # count(http_requests_total) == 2
         finally:
             srv.stop()
+
+
+class TestClusterCacheBypass:
+    """ADVICE r3 (high): a facade that does not host every shard locally
+    cannot witness remote ingest in its data_version stamp, so the response
+    cache must be bypassed entirely (never served, never populated)."""
+
+    def test_partial_local_shards_disable_cache(self):
+        from filodb_tpu.http.server import service_version
+
+        ms = TimeSeriesMemStore()
+        # host only 1 of the dataset's shards locally → stamp must be None
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        keys = counter_series(2, metric="http_requests_total")
+        ingest_routed(ms, "timeseries",
+                      counter_stream(keys, 50, start_ms=START * 1000), 1, 0)
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        # simulate the cluster facade: the dataset spans 4 shards but only
+        # shard 0 is resident (the planner still routes locally here)
+        svc.num_shards = 4
+        assert service_version(svc) is None
+
+        srv = FiloHttpServer({"timeseries": svc}, port=0).start()
+        try:
+            q = dict(query="count(http_requests_total)", time=START + 100)
+            get(srv, "/promql/timeseries/api/v1/query", **q)
+            get(srv, "/promql/timeseries/api/v1/query", **q)
+            assert srv.response_cache.hits == 0
+            assert len(srv.response_cache._lru) == 0
+        finally:
+            srv.stop()
+
+    def test_full_local_shards_keep_cache(self):
+        from filodb_tpu.http.server import service_version
+
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        assert service_version(svc) is not None
+
+
+class TestFastServerChunkedTE:
+    def test_chunked_transfer_encoding_rejected(self):
+        """ADVICE r3 (medium): a chunked body must not be parsed as
+        pipelined requests — the server answers 501 and closes."""
+        import socket as _socket
+
+        from filodb_tpu.http.fastserver import FastHttpServer
+
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        try:
+            body = (b"5\r\nGET /\r\n0\r\n\r\n")
+            req = (b"POST /promql/timeseries/api/v1/query HTTP/1.1\r\n"
+                   b"Host: x\r\nTransfer-Encoding: chunked\r\n\r\n" + body)
+            with _socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=10) as s:
+                s.sendall(req)
+                buf = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            assert buf.startswith(b"HTTP/1.1 501")
+            # exactly one response: the chunked bytes were NOT desynced
+            # into extra pipelined requests
+            assert buf.count(b"HTTP/1.1 ") == 1
+        finally:
+            srv.stop()
+
+    def test_duplicate_conflicting_content_length_rejected(self):
+        """Differing duplicate Content-Length headers are the CL.CL request
+        smuggling vector — the connection must be dropped, not desynced."""
+        import socket as _socket
+
+        from filodb_tpu.http.fastserver import FastHttpServer
+
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100))
+        svc = QueryService(ms, "timeseries", 1, spread=0)
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        try:
+            req = (b"POST /__health HTTP/1.1\r\nHost: x\r\n"
+                   b"Content-Length: 10\r\nContent-Length: 0\r\n\r\n"
+                   b"GET / HTTP")
+            with _socket.create_connection(("127.0.0.1", srv.port),
+                                           timeout=10) as s:
+                s.sendall(req)
+                buf = b""
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            assert buf == b""  # dropped without a response, nothing desynced
+        finally:
+            srv.stop()

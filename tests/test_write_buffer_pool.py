@@ -36,8 +36,6 @@ class TestWriteBufferPool:
         shard.flush_all(ingestion_time=1)
         pools = [p for p in shard.buffer_pools.values()]
         assert pools and all(isinstance(p, WriteBufferPool) for p in pools)
-        for p in pools:
-            p.quarantine_s = 0.0  # test: skip the reader-safety delay
         evicted = sum(bool(shard.evict_partition(part.part_id))
                       for part in list(shard.partitions) if part)
         assert evicted > 0
@@ -55,8 +53,6 @@ class TestWriteBufferPool:
         for sd in gauge_stream(keys, 120, start_ms=START * 1000, seed=5):
             shard.ingest(sd)
         shard.flush_all(ingestion_time=1)
-        for p in shard.buffer_pools.values():
-            p.quarantine_s = 0.0
         for part in list(shard.partitions):
             if part:
                 shard.evict_partition(part.part_id)
@@ -77,19 +73,31 @@ class TestWriteBufferPool:
         assert r1.num_series == 3
         np.testing.assert_array_equal(np.asarray(r1.values)[:, 0], 120.0)
 
-    def test_quarantine_blocks_immediate_reuse(self):
+    def test_reader_reference_blocks_reuse(self):
+        """Deterministic reclamation: a reader holding the buffer object or
+        a VIEW of one of its arrays keeps it out of circulation; dropping
+        the reference makes it immediately reusable (no wall-clock)."""
         from filodb_tpu.core.schemas import GAUGE
         schema = GAUGE
-        pool = WriteBufferPool(schema, 50, quarantine_s=60.0)
+        pool = WriteBufferPool(schema, 50)
         from filodb_tpu.core.memstore.partition import TimeSeriesPartition
         from filodb_tpu.core.partkey import PartKey
         key = PartKey.create("gauge", {"_metric_": "m"})
         part = TimeSeriesPartition(0, key, schema, 50, buffer_pool=pool)
         buf = part._buf
         part.release_buffers()
-        # still quarantined: a new partition must get a FRESH buffer
+        # a stalled reader still holds the buffer: fresh buffer issued
         part2 = TimeSeriesPartition(1, key, schema, 50, buffer_pool=pool)
         assert part2._buf is not buf
-        pool.quarantine_s = 0.0
+        assert pool.blocked > 0
+        # holding only a VIEW of an array also pins it (view.base refcount)
+        view = buf.ts[:10]
+        del buf
         part3 = TimeSeriesPartition(2, key, schema, 50, buffer_pool=pool)
-        assert part3._buf is buf
+        assert len(part3._buf.ts) == 50 and view is not None
+        assert pool.reused == 0
+        del view
+        part4 = TimeSeriesPartition(3, key, schema, 50, buffer_pool=pool)
+        assert pool.reused == 1
+        # recycled buffer serves the new partition, zeroed fill count
+        assert part4._buf.n == 0
