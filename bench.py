@@ -109,9 +109,15 @@ QUERY = 'sum(rate(heap_usage{_ws_="demo",_ns_="App-2"}[5m]))'
 QUERY_STEP_SEC = 60
 N_QUERIES = 100
 N_WARMUP = 3
+# large-scan section: enough samples that the device lane wins end-to-end
+# even through a high-latency tunnel (scan cost ≫ sync floor)
+BIG_SERIES = 8192
+BIG_SAMPLES = 1440  # 4h @ 10s per series
+BIG_QUERY = 'sum(rate(big_counter[10m]))'
+BIG_RANGE_SEC = 3 * 3600  # ~9.3M samples scanned per query
 
 
-def build_service():
+def build_service(engine: str = "adaptive"):
     from filodb_tpu.coordinator.ingestion import ingest_routed
     from filodb_tpu.coordinator.query_service import QueryService
     from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
@@ -127,10 +133,48 @@ def build_service():
                                               groups_per_shard=8))
     n = ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
     assert n == NUM_SERIES * NUM_SAMPLES, n
-    # mesh engine first (single SPMD program per query batch; exec-tree
-    # fallback for unsupported shapes) — the TPU-native serving posture
+    # adaptive two-lane engine (parallel/adaptive.py): device mesh for
+    # batch/scan-heavy work, host lane for sync-floor-bound small queries,
+    # cost-routed — the TPU-native serving posture behind any link
     return QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
-                        engine="mesh"), keys
+                        engine=engine), keys
+
+
+def build_big_service(engine: str):
+    """Big-scan store, loaded via the bulk chunk path: per-sample Python
+    ingest of ~12M records would dominate the bench's wall clock, and this
+    section measures QUERY cost (the headline section exercises the real
+    ingest path)."""
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.memory.chunk import encode_chunk
+
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=400,
+                                              groups_per_shard=8,
+                                              native_ingest=False))
+    rng = np.random.default_rng(11)
+    ts = START_SEC * 1000 + np.arange(BIG_SAMPLES, dtype=np.int64) \
+        * INTERVAL_MS
+    chunk = 400
+    for i in range(BIG_SERIES):
+        key = PartKey.create("prom-counter", {
+            "_metric_": "big_counter", "_ws_": "demo", "_ns_": "Big",
+            "instance": f"inst-{i}"})
+        shard = ms.get_shard("timeseries", i % NUM_SHARDS)
+        part = shard.get_or_create_partition(key, int(ts[0]))
+        vals = np.cumsum(rng.integers(0, 20, BIG_SAMPLES)).astype(
+            np.float64)
+        for c0 in range(0, BIG_SAMPLES, chunk):
+            c1 = min(c0 + chunk, BIG_SAMPLES)
+            part.chunks.append(encode_chunk(
+                part.schema, ts[c0:c1], [vals[c0:c1]], len(part.chunks)))
+        shard.stats.rows_ingested.inc(BIG_SAMPLES)  # data_version stamp
+    return QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                        engine=engine)
 
 
 def run_queries(svc, n, start_sec, end_sec):
@@ -157,6 +201,76 @@ def run_queries_concurrent(svc, n, start_sec, end_sec, workers=16):
     dt = time.perf_counter() - t0
     assert all(r.result.num_series == 1 for r in rs)
     return n / dt
+
+
+def run_queries_sustained(svc, start_sec, end_sec, threads=4, batch=25,
+                          rounds=4):
+    """Sustained serving throughput: ``threads`` submitters each pipeline
+    ``rounds`` batches of ``batch`` queries (the JMH posture — multiple
+    benchmark threads with many in-flight queries per op). Completion
+    syncs of different passes overlap, so this measures steady-state
+    throughput rather than one pass's latency."""
+    import threading
+
+    done = []
+
+    def worker():
+        c = 0
+        for _ in range(rounds):
+            qs = [(QUERY, start_sec, QUERY_STEP_SEC, end_sec)] * batch
+            rs = svc.query_range_many(qs)
+            assert all(r.result.num_series == 1 for r in rs)
+            c += batch
+        done.append(c)
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return sum(done) / (time.perf_counter() - t0)
+
+
+def measure_big_scan():
+    """End-to-end lane comparison at scan-heavy scale (~9M samples per
+    query): here device compute dominates the sync floor, so the device
+    lane must win END-TO-END, tunnel included — the complement of the
+    small-scan workload where the floor dominates and the host lane wins."""
+    from filodb_tpu.promql.parser import TimeStepParams
+
+    svc = build_big_service("adaptive")
+    start_sec = START_SEC + 3600
+    end_sec = start_sec + BIG_RANGE_SEC
+    eng = svc.mesh_engine
+    out = {"series": BIG_SERIES,
+           "samples_per_query_approx":
+               BIG_SERIES * (BIG_RANGE_SEC + 600) // 10}
+    plan = svc._parse_cached(BIG_QUERY, TimeStepParams(
+        start_sec, QUERY_STEP_SEC, end_sec))
+    host = eng._host()
+    lanes = {"device": eng.device_engine}
+    if host is not None:
+        lanes["host"] = host
+    for lane_name, engine in lanes.items():
+        lows = [engine._lower(plan)]
+        if lows[0] is None:
+            continue
+        for _ in range(2):  # warm: compile + batch build + upload
+            engine.execute_lowered_many(lows, svc.memstore,
+                                        "timeseries")[0].materialize()
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            engine.execute_lowered_many(lows, svc.memstore,
+                                        "timeseries")[0].materialize()
+        out[f"{lane_name}_lane_ms_per_query"] = round(
+            (time.perf_counter() - t0) / iters * 1e3, 1)
+    d = out.get("device_lane_ms_per_query")
+    h = out.get("host_lane_ms_per_query")
+    if d and h:
+        out["device_speedup_end_to_end"] = round(h / d, 2)
+    return out
 
 
 def naive_baseline_qps(svc, start_sec, end_sec, n_iters=5):
@@ -289,7 +403,7 @@ def main():
     micro = kernel_microbench(platform)
     sys.stderr.write(f"kernel microbench: {json.dumps(micro)}\n")
 
-    svc, _ = build_service()
+    svc, _ = build_service("adaptive")
     start_sec = START_SEC + 1800
     end_sec = START_SEC + 1800 + 30 * 60  # 30-min range, 31 steps
 
@@ -297,8 +411,28 @@ def main():
     run_queries_concurrent(svc, N_QUERIES, start_sec, end_sec)  # batch compile
     seq_qps, p50_ms, p99_ms = run_queries(svc, N_QUERIES, start_sec, end_sec)
     conc_qps = run_queries_concurrent(svc, N_QUERIES, start_sec, end_sec)
-    qps = max(seq_qps, conc_qps)
+    sustained_qps = run_queries_sustained(svc, start_sec, end_sec)
+    qps = max(seq_qps, conc_qps, sustained_qps)
     baseline = naive_baseline_qps(svc, start_sec, end_sec)
+
+    # device-timed breakdown (VERDICT r3 #1): where a single query's
+    # latency goes, so the sync-floor-bound sequential number is
+    # attributable — floor (one blocking host↔device round trip), pure
+    # device kernel time (microbench), and the device lane's end-to-end
+    # per-query cost as routed by the adaptive engine
+    eng = svc.mesh_engine
+    breakdown = {}
+    if getattr(eng, "sync_floor_s", None) is not None:
+        breakdown["sync_floor_ms"] = round(eng.sync_floor_s * 1e3, 2)
+    breakdown["device_kernel_ms"] = micro.get("fused_decode_rate_sum_ms")
+    if hasattr(eng, "_cost"):
+        breakdown["lane_costs_ms_per_query"] = {
+            f"{lane}_bs{b}": round(c.est * 1e3, 2)
+            for (lane, b), c in eng._cost.items() if c.est is not None}
+        breakdown["routed"] = dict(eng.routed)
+
+    big = measure_big_scan()
+    sys.stderr.write(f"big scan: {json.dumps(big)}\n")
 
     # Honest reference comparison: the JVM reference cannot run in this
     # image (no JVM/sbt, zero egress), so alongside the measured
@@ -311,19 +445,26 @@ def main():
         "metric": "promql_sum_rate_range_query_throughput",
         "value": round(qps, 2),
         "unit": "queries/sec",
-        "vs_baseline": round(qps / baseline, 2),
-        "baseline_note": ("vs_baseline = measured ratio against naive "
-                          "per-sample numpy/python iteration; see "
-                          "reference_jvm_estimated_qps for the JVM-engine "
-                          "estimate (BENCH_LOCAL.md)"),
-        "reference_jvm_estimated_qps": [ref_lo, ref_hi],
+        # headline comparison first: measured qps against the reasoned
+        # JVM-engine estimate band for this exact workload
         "vs_reference_estimate": [round(qps / ref_hi, 2),
                                   round(qps / ref_lo, 2)],
+        "reference_jvm_estimated_qps": [ref_lo, ref_hi],
         "sequential_qps": round(seq_qps, 2),
+        "latency_p50_ms": round(p50_ms, 2),
+        "latency_p99_ms": round(p99_ms, 2),
         "concurrent_qps": round(conc_qps, 2),
-        "latency_p50_ms": round(p50_ms, 1),
-        "latency_p99_ms": round(p99_ms, 1),
+        "sustained_qps": round(sustained_qps, 2),
+        "latency_breakdown": breakdown,
+        "big_scan": big,
         "platform": platform,
+        # secondary: ratio against naive per-sample numpy/python iteration
+        # of the same queries (NOT the JVM engine)
+        "vs_baseline": round(qps / baseline, 2),
+        "baseline_note": ("vs_baseline = measured ratio against naive "
+                          "per-sample numpy/python iteration; the "
+                          "reference comparison is vs_reference_estimate "
+                          "(BENCH_LOCAL.md)"),
         "probe": probe_log,
         "kernel_microbench": micro,
     }))

@@ -32,7 +32,9 @@ class QueryService:
     lookback_ms: int = 300_000
     # "exec" = scatter-gather exec-plan tree (the reference's distribution);
     # "mesh" = lower supported agg(range_fn(sel[w])) by (...) plans onto the
-    # (shard × time) device mesh, falling back to exec for everything else
+    # (shard × time) device mesh, falling back to exec for everything else;
+    # "adaptive" = mesh plus a host lane, cost-routed per batch size
+    # (parallel/adaptive.py) — the default serving posture
     engine: str = "exec"
     mesh: object = None  # jax Mesh override for engine="mesh"
     planner: SingleClusterPlanner = field(init=False)
@@ -46,6 +48,9 @@ class QueryService:
         if self.engine == "mesh":
             from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
             self.mesh_engine = MeshQueryEngine(mesh=self.mesh)
+        elif self.engine == "adaptive":
+            from filodb_tpu.parallel.adaptive import AdaptiveQueryEngine
+            self.mesh_engine = AdaptiveQueryEngine(mesh=self.mesh)
 
     # ---- promql entry points --------------------------------------------
 

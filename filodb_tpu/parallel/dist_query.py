@@ -41,12 +41,19 @@ def _window_bounds(ts, steps, window):
 
 
 def _local_rate_partials(ts, vals, counts_mask, steps, window,
-                         counter: bool = True):
+                         counter: bool = True, raw=None):
     """Per-device window partials for the local (P_l, S_l) time block.
 
-    Returns [P_l, K, 6]: n, t_first, v_first_raw, t_last, v_last_raw,
-    internal (counter-corrected when ``counter``) increase. Missing => n=0
-    and sentinels.
+    Returns [P_l, K, 7]: n, t_first, v_first, t_last, v_last, internal
+    (counter-corrected when ``counter``) increase, v_first_raw. Missing
+    => n=0 and sentinels.
+
+    ``raw`` [P_l, S_l] is the uncorrected value tensor when ``vals`` ride
+    the pre-corrected/rebased f32-precision lane (``SeriesBatch
+    .delta_host``); it feeds ONLY the ``v_first_raw`` field, whose sole
+    consumer is Prometheus' extrapolate-to-zero heuristic. The boundary
+    combine keeps using the rebased first/last (a large base would not
+    cancel exactly in f32 there).
     """
     dt = fdtype()
     valid = counts_mask
@@ -76,13 +83,18 @@ def _local_rate_partials(ts, vals, counts_mask, steps, window,
     v_first = jnp.where(has, g(v, i_first), 0.0)
     v_last = jnp.where(has, g(v, i_last), 0.0)
     inc = jnp.where(has, g(cv, i_last) - g(cv, i_first), 0.0)
-    return jnp.stack([n.astype(dt), t_first, v_first, t_last, v_last, inc],
-                     axis=-1)
+    if raw is None:
+        v_first_raw = v_first
+    else:
+        rawm = jnp.where(valid, raw, 0.0).astype(dt)
+        v_first_raw = jnp.where(has, g(rawm, i_first), 0.0)
+    return jnp.stack([n.astype(dt), t_first, v_first, t_last, v_last, inc,
+                      v_first_raw], axis=-1)
 
 
 def _combine_time_partials(parts, steps, window, mode: str = "rate",
                            counter: bool = True):
-    """Combine all-gathered time-block partials [dt, P, K, 6] → [P, K].
+    """Combine all-gathered time-block partials [dt, P, K, 7] → [P, K].
 
     Sequential associative combine over the (static, small) time axis,
     handling counter resets across block boundaries, then Prometheus
@@ -110,7 +122,8 @@ def _combine_time_partials(parts, steps, window, mode: str = "rate",
         else:
             boundary = jnp.where(nd & has_prev, vf - v_prev, 0.0)
         total_inc = total_inc + inc + boundary
-        v_first_g = jnp.where(nd & ~has_prev, vf, v_first_g)
+        # the global first's RAW value (field 6), for extrapolate-to-zero
+        v_first_g = jnp.where(nd & ~has_prev, parts[d, ..., 6], v_first_g)
         v_prev = jnp.where(nd, vl, v_prev)
         has_prev = has_prev | nd
 
@@ -250,6 +263,19 @@ def _group_reduce(res, gid_l, num_groups, agg):
 COUNTER_FNS = {"rate": ("rate", True), "increase": ("increase", True),
                "delta": ("delta", False)}
 
+
+def _mesh_call(ts, vals, valid, group_ids, steps, window, raw=None):
+    """(in_specs, args) for the distributed step functions' shard_map —
+    appending the optional raw-value operand when the pre-corrected lane
+    supplies it."""
+    in_specs = (P("shard", "time"), P("shard", "time"),
+                P("shard", "time"), P("shard"), P(None), P())
+    args = (ts, vals, valid, group_ids, steps, window)
+    if raw is not None:
+        in_specs += (P("shard", "time"),)
+        args += (raw,)
+    return in_specs, args
+
 # aggs with associative mesh reductions
 MESH_AGG_OPS = ("sum", "avg", "count", "min", "max", "stddev", "stdvar",
                 "group")
@@ -263,12 +289,13 @@ def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
     per-series [P, K] matrix (raw selectors / un-aggregated range functions),
     sharded over the shard axis."""
 
-    def per_series(ts_l, vals_l, valid_l, steps_r, window_r):
+    def per_series(ts_l, vals_l, valid_l, steps_r, window_r, raw_l=None):
         if fn in COUNTER_FNS:
             mode, counter = COUNTER_FNS[fn]
             parts = _local_rate_partials(ts_l, vals_l, valid_l, steps_r,
-                                         window_r, counter=counter)
-            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 6]
+                                         window_r, counter=counter,
+                                         raw=raw_l)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
             return _combine_time_partials(gathered, steps_r, window_r,
                                           mode=mode, counter=counter)
         combine = _SIMPLE_COMBINE[fn]
@@ -277,20 +304,24 @@ def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
         gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
         return combine(gathered)
 
-    def step(ts, vals, valid, group_ids, steps, window):
-        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
-            res = per_series(ts_l, vals_l, valid_l, steps_r, window_r)
+    def step(ts, vals, valid, group_ids, steps, window, raw=None):
+        # ``raw`` [P, S]: uncorrected values, present when ``vals`` ride
+        # the pre-corrected/rebased f32-precision lane
+        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r,
+                   *rest):
+            res = per_series(ts_l, vals_l, valid_l, steps_r, window_r,
+                             rest[0] if rest else None)
             if agg is None:
                 return res
             return _group_reduce(res, gid_l, num_groups, agg)
 
+        in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
+                                    window, raw)
         return jax.shard_map(
-            kernel, mesh=mesh,
-            in_specs=(P("shard", "time"), P("shard", "time"),
-                      P("shard", "time"), P("shard"), P(None), P()),
+            kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P("shard", None) if agg is None else P(None, None),
             check_vma=False,
-        )(ts, vals, valid, group_ids, steps, window)
+        )(*args)
 
     return jax.jit(step)
 
@@ -305,11 +336,12 @@ def make_distributed_sum_rate(mesh: Mesh, num_groups: int):
     Output: [G, K] group sums, fully replicated.
     """
 
-    def step(ts, vals, valid, group_ids, steps, window):
-        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
+    def step(ts, vals, valid, group_ids, steps, window, raw=None):
+        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r, *rest):
             parts = _local_rate_partials(ts_l, vals_l, valid_l, steps_r,
-                                         window_r)
-            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 6]
+                                         window_r,
+                                         raw=rest[0] if rest else None)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
             rate = _combine_time_partials(gathered, steps_r, window_r)
             present = ~jnp.isnan(rate)
             contrib = jnp.where(present, rate, 0.0)
@@ -320,23 +352,28 @@ def make_distributed_sum_rate(mesh: Mesh, num_groups: int):
             gcnt = lax.psum(gcnt, "shard")
             return jnp.where(gcnt > 0, gsum, jnp.nan)
 
+        in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
+                                    window, raw)
         return jax.shard_map(
-            kernel, mesh=mesh,
-            in_specs=(P("shard", "time"), P("shard", "time"),
-                      P("shard", "time"), P("shard"), P(None), P()),
+            kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, None),
             check_vma=False,
-        )(ts, vals, valid, group_ids, steps, window)
+        )(*args)
 
     return jax.jit(step)
 
 
-def shard_batch_arrays(mesh: Mesh, ts, vals, valid, group_ids):
-    """Place host arrays with (shard, time) shardings."""
+def shard_batch_arrays(mesh: Mesh, ts, vals, valid, group_ids, raw=None):
+    """Place host arrays with (shard, time) shardings. ``raw`` [P, S]
+    (optional — the uncorrected values accompanying the rebased lane)
+    shards like ``vals``."""
     s2 = NamedSharding(mesh, P("shard", "time"))
     s1 = NamedSharding(mesh, P("shard"))
-    return (jax.device_put(ts, s2), jax.device_put(vals, s2),
-            jax.device_put(valid, s2), jax.device_put(group_ids, s1))
+    placed = (jax.device_put(ts, s2), jax.device_put(vals, s2),
+              jax.device_put(valid, s2), jax.device_put(group_ids, s1))
+    if raw is not None:
+        placed += (jax.device_put(raw, s2),)
+    return placed
 
 
 def pad_for_mesh(ts, vals, counts, group_ids, mesh: Mesh):
@@ -367,29 +404,33 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
     all-gathering every time-block's partials, carry state around the time
     axis with ``lax.ppermute`` (the literal ring-attention communication
     shape). Each of the dt-1 hops passes the running combine state
-    [P_l, K, 7] to the next time block:
+    [P_l, K, 8] to the next time block:
 
-        (n_so_far, t_first, v_first_raw, inc_so_far, has_prev, v_prev, t_last)
+        (n_so_far, t_first, v_first, inc_so_far, has_prev, v_prev, t_last,
+         v_first_raw)
 
     Memory per device stays O(P_l·K) regardless of dt (the all_gather
-    version holds [dt, P_l, K, 6]); latency is dt-1 ICI hops.
+    version holds [dt, P_l, K, 7]); latency is dt-1 ICI hops.
     """
     dt_size = mesh.shape["time"]
 
-    def step(ts, vals, valid, group_ids, steps, window):
-        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r):
+    def step(ts, vals, valid, group_ids, steps, window, raw=None):
+        def kernel(ts_l, vals_l, valid_l, gid_l, steps_r, window_r,
+                   *rest):
             dtt = fdtype()
             parts = _local_rate_partials(ts_l, vals_l, valid_l, steps_r,
-                                         window_r)
-            n_l, tf_l, vf_l, tl_l, vl_l, inc_l = [parts[..., i]
-                                                  for i in range(6)]
+                                         window_r,
+                                         raw=rest[0] if rest else None)
+            n_l, tf_l, vf_l, tl_l, vl_l, inc_l, vfr_l = [
+                parts[..., i] for i in range(7)]
             has_l = n_l > 0
             t_idx = lax.axis_index("time")
 
             # state flowing forward around the ring
             state = jnp.stack([
                 n_l, tf_l, jnp.where(has_l, vf_l, 0.0), inc_l,
-                has_l.astype(dtt), jnp.where(has_l, vl_l, 0.0), tl_l],
+                has_l.astype(dtt), jnp.where(has_l, vl_l, 0.0), tl_l,
+                jnp.where(has_l, vfr_l, 0.0)],
                 axis=-1)
 
             perm = [(i, i + 1) for i in range(dt_size - 1)]
@@ -399,8 +440,8 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
                 # devices with t_idx == 0 receive zeros (no source): mask the
                 # counts/flags AND re-sentinel the min/max-combined fields so
                 # zeros can't pollute t_first (min) / t_last (max)
-                p_n, p_tf, p_vf, p_inc, p_has, p_vl, p_tl = [
-                    prev[..., i] for i in range(7)]
+                p_n, p_tf, p_vf, p_inc, p_has, p_vl, p_tl, p_vfr = [
+                    prev[..., i] for i in range(8)]
                 first_block = (t_idx == 0)
                 p_n = jnp.where(first_block, 0.0, p_n)
                 p_has = jnp.where(first_block, 0.0, p_has)
@@ -417,11 +458,14 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
                 tf_c = jnp.minimum(p_tf, tf_l)
                 vf_c = jnp.where(p_has > 0, p_vf,
                                  jnp.where(has_l, vf_l, 0.0))
+                p_vfr = jnp.where(first_block, 0.0, p_vfr)
+                vfr_c = jnp.where(p_has > 0, p_vfr,
+                                  jnp.where(has_l, vfr_l, 0.0))
                 has_c = jnp.maximum(p_has, has_l.astype(dtt))
                 vl_c = jnp.where(has_l, vl_l, p_vl)
                 tl_c = jnp.maximum(p_tl, tl_l)
-                out = jnp.stack([n_c, tf_c, vf_c, inc_c, has_c, vl_c, tl_c],
-                                axis=-1)
+                out = jnp.stack([n_c, tf_c, vf_c, inc_c, has_c, vl_c, tl_c,
+                                 vfr_c], axis=-1)
                 return out, None
 
             state, _ = lax.scan(hop, state, None, length=max(dt_size - 1, 1)
@@ -434,8 +478,8 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
                     jnp.where(t_idx == dt_size - 1, state, 0.0), "time")
             else:
                 full = state
-            n_tot, t_first_g, v_first_g, total_inc, _, _, t_last_g = [
-                full[..., i] for i in range(7)]
+            (n_tot, t_first_g, _, total_inc, _, _, t_last_g,
+             v_first_raw_g) = [full[..., i] for i in range(8)]
 
             # Prometheus extrapolation (same as the gather variant)
             t_first_s = t_first_g / 1000.0
@@ -448,7 +492,8 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
             dur_end = range_end - t_last_s
             dur_zero = jnp.where(
                 total_inc > 0,
-                sampled * v_first_g / jnp.maximum(total_inc, 1e-30), jnp.inf)
+                sampled * v_first_raw_g / jnp.maximum(total_inc, 1e-30),
+                jnp.inf)
             dur_start = jnp.minimum(dur_start, dur_zero)
             threshold = avg_dur * 1.1
             extend = sampled
@@ -468,12 +513,12 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
                 present.astype(contrib.dtype), gid_l, num_groups), "shard")
             return jnp.where(gcnt > 0, gsum, jnp.nan)
 
+        in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
+                                    window, raw)
         return jax.shard_map(
-            kernel, mesh=mesh,
-            in_specs=(P("shard", "time"), P("shard", "time"),
-                      P("shard", "time"), P("shard"), P(None), P()),
+            kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, None),
             check_vma=False,
-        )(ts, vals, valid, group_ids, steps, window)
+        )(*args)
 
     return jax.jit(step)

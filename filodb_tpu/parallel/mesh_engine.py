@@ -294,8 +294,14 @@ class MeshQueryEngine:
 
         shards = memstore.shards_for(dataset)
         version = sum(s.data_version for s in shards)
+        # delta-family fns place the pre-corrected/rebased f64→f32 value
+        # lane (SeriesBatch.delta_host) instead of raw values, so the lane
+        # kind is part of the cache key ("corrected" also implies counter
+        # reset correction; "rebased" is shift-only, for delta on gauges)
+        lane = ("corrected" if fn in ("rate", "increase")
+                else "rebased" if fn == "delta" else "raw")
         ckey = (dataset, str(low0.filters), chunk_start, chunk_end,
-                low0.by, low0.without, low0.agg is None)
+                low0.by, low0.without, low0.agg is None, lane)
         cached = self._batch_cache.get(ckey)
         if cached is not None and cached[0] == version:
             _, batch, keys, gids, out_keys, placed = cached
@@ -379,9 +385,24 @@ class MeshQueryEngine:
         if placed is None:
             gids_full = np.zeros(batch.ts.shape[0], np.int32)
             gids_full[: len(gids)] = gids
+            raw_vals = None
+            if lane == "raw":
+                mesh_vals = batch.vals
+            else:
+                mesh_vals = batch.delta_host(counter=(lane == "corrected"))
+                if lane == "corrected":
+                    # rate/increase also need the raw values for the
+                    # extrapolate-to-zero clamp (heuristic-only reference)
+                    raw_vals = batch.vals
             ts_p, vals_p, valid, gid_p = pad_for_mesh(
-                batch.ts, batch.vals, batch.counts, gids_full, mesh)
-            placed = shard_batch_arrays(mesh, ts_p, vals_p, valid, gid_p)
+                batch.ts, mesh_vals, batch.counts, gids_full, mesh)
+            raw_p = None
+            if raw_vals is not None:
+                raw_p = np.zeros(vals_p.shape, vals_p.dtype)
+                raw_p[: raw_vals.shape[0], : raw_vals.shape[1]] = \
+                    np.nan_to_num(raw_vals, nan=0.0)
+            placed = shard_batch_arrays(mesh, ts_p, vals_p, valid, gid_p,
+                                        raw_p)
             self._cache_put(ckey, (version, batch, keys, gids, out_keys,
                                    placed))
 
@@ -395,9 +416,18 @@ class MeshQueryEngine:
                 step_fn = make_distributed_range_agg(mesh, fn, Gp, agg)
             self._fns[key] = step_fn
 
+        import jax
         import jax.numpy as jnp
-        win_d = jnp.asarray(np.int32(low0.window))
-        ts_d, vals_d, valid_d, gid_d = placed
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # replicated small operands are PINNED to the mesh's devices: the
+        # default backend may be a different platform (e.g. a host-lane CPU
+        # mesh inside a TPU process), and a default-placed operand would
+        # drag cross-backend transfers into every call
+        repl = NamedSharding(mesh, PartitionSpec())
+        win_d = jax.device_put(np.int32(low0.window), repl)
+        ts_d, vals_d, valid_d, gid_d = placed[:4]
+        raw_d = placed[4] if len(placed) > 4 else None
 
         # Fixed call shapes: compile storms would otherwise follow the batch
         # size (every distinct ΣKp is a fresh program). Queries grouped by
@@ -431,8 +461,14 @@ class MeshQueryEngine:
                 if grid_d is None:
                     if len(self._grid_cache) >= self._grid_cache_cap:
                         self._grid_cache.pop(next(iter(self._grid_cache)))
-                    grid_d = self._grid_cache[gkey] = jnp.asarray(blob)
-                out = step_fn(ts_d, vals_d, valid_d, gid_d, grid_d, win_d)
+                    grid_d = self._grid_cache[gkey] = jax.device_put(
+                        blob, repl)
+                if raw_d is not None:
+                    out = step_fn(ts_d, vals_d, valid_d, gid_d, grid_d,
+                                  win_d, raw_d)
+                else:
+                    out = step_fn(ts_d, vals_d, valid_d, gid_d, grid_d,
+                                  win_d)
                 calls.append((out, chunk, Kp))
         # phase 2: coalesced device→host fetch — one transfer per distinct
         # output shape (per-query slicing on device would cost a dispatch +

@@ -66,6 +66,59 @@ class SeriesBatch:
             self._device = dev
         return dev
 
+    def delta_host(self, counter: bool):
+        """Rebased values [P,S] f64 for the delta-family range functions
+        (rate/increase/delta/irate/idelta/deriv).
+
+        Values are counter-reset-corrected (when ``counter``) and then
+        rebased by each series' first in-range value — all HOST-side in
+        float64 — so the later float32 device cast only ever sees
+        window-scale magnitudes. Without this, a long-lived counter
+        ≥2^24 (~16.7M) loses per-window delta precision entirely on the
+        f32 device path (reference RateFunctions.scala:1-303 runs in
+        double throughout). Prometheus' extrapolate-to-zero clamp needs
+        each window's RAW first sample, so kernels additionally take the
+        raw value tensor (``device_arrays()[1]``) as a heuristic-only
+        reference — f32 rounding there is irrelevant."""
+        cache = getattr(self, "_delta_host", None)
+        if cache is None:
+            cache = self._delta_host = {}
+        hit = cache.get(counter)
+        if hit is not None:
+            return hit
+        vals = self.vals
+        valid = ~np.isnan(vals)
+        v = np.where(valid, vals, 0.0)
+        if counter:
+            prev = np.concatenate([v[:, :1], v[:, :-1]], axis=1)
+            pvalid = np.concatenate(
+                [np.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+            dropped = (v < prev) & valid & pvalid
+            v = v + np.cumsum(np.where(dropped, prev, 0.0), axis=1)
+        # samples are packed contiguously from 0, so the first in-range
+        # value is column 0 (corrected first == raw first: no prior reset)
+        base = np.where(self.counts > 0, v[:, 0], 0.0)
+        rebased = np.where(valid, v - base[:, None], np.nan)
+        cache[counter] = rebased
+        return rebased
+
+    def delta_arrays(self, counter: bool):
+        """(ts, rebased_vals, counts, raw_vals) device arrays (cached) —
+        the device twin of :meth:`delta_host` for the exec kernel path.
+        ``raw_vals`` is the shared upload from :meth:`device_arrays`."""
+        cache = getattr(self, "_delta_device", None)
+        if cache is None:
+            cache = self._delta_device = {}
+        hit = cache.get(counter)
+        if hit is None:
+            import jax.numpy as jnp
+
+            rebased = self.delta_host(counter)
+            ts_d, raw_d, counts_d = self.device_arrays()
+            hit = cache[counter] = (ts_d, jnp.asarray(rebased), counts_d,
+                                    raw_d)
+        return hit
+
 
 def build_batch(partitions: list[TimeSeriesPartition], start: int, end: int,
                 value_col: int | None = None, pad_series: bool = True,

@@ -134,29 +134,42 @@ SIMPLE_FNS = (
 )
 
 
-@partial(jax.jit, static_argnames=("fn", "counter"))
+@partial(jax.jit, static_argnames=("fn", "counter", "pre_corrected"))
 def range_eval(fn: str, ts, vals, counts, steps, window, extra=0.0,
-               counter: bool = False):
+               counter: bool = False, pre_corrected: bool = False,
+               raw=None):
     """Evaluate one range function at each step for each series.
 
     ts: int32 [P,S] relative ms; vals: float [P,S]; counts: int32 [P];
     steps: int32 [K]; window: int32 scalar ms; extra: scalar parameter
     (predict_linear horizon etc.). Returns float [P,K].
+
+    ``pre_corrected``: values were counter-reset-corrected AND per-series
+    rebased host-side in f64 (``SeriesBatch.delta_host``) — the in-kernel
+    correction is skipped. ``raw`` [P,S] is the UNcorrected value tensor,
+    consulted only where Prometheus' extrapolate-to-zero heuristic needs
+    each window's raw first sample (precision there is moot, so the f32
+    copy suffices). This is what keeps f32 device math exact at real
+    counter magnitudes (a counter ≥2^24 otherwise loses every per-window
+    delta to the f32 cast).
     """
     return _range_impl(fn, ts, vals, _valid_mask(ts, counts), steps, window,
-                       extra, counter)
+                       extra, counter, pre_corrected, raw)
 
 
-@partial(jax.jit, static_argnames=("fn", "counter"))
+@partial(jax.jit, static_argnames=("fn", "counter", "pre_corrected"))
 def range_eval_masked(fn: str, ts, vals, valid, steps, window, extra=0.0,
-                      counter: bool = False):
+                      counter: bool = False, pre_corrected: bool = False,
+                      raw=None):
     """Mask-aware variant: ``valid`` [P,S] may have interior gaps (device-
     decoded block-aligned pages). Gap positions must carry a timestamp ≤ the
     next valid sample's (monotone non-decreasing ts overall)."""
-    return _range_impl(fn, ts, vals, valid, steps, window, extra, counter)
+    return _range_impl(fn, ts, vals, valid, steps, window, extra, counter,
+                       pre_corrected, raw)
 
 
-def _range_impl(fn: str, ts, vals, valid, steps, window, extra, counter):
+def _range_impl(fn: str, ts, vals, valid, steps, window, extra, counter,
+                pre_corrected: bool = False, raw=None):
     dt = fdtype()
     vals = vals.astype(dt)
     v = jnp.where(valid, vals, 0.0)
@@ -264,14 +277,21 @@ def _range_impl(fn: str, ts, vals, valid, steps, window, extra, counter):
                        horizon_s=extra)
 
     if fn in ("rate", "increase", "delta"):
-        if counter or fn in ("rate", "increase"):
+        if pre_corrected or not (counter or fn in ("rate", "increase")):
+            cv = v  # host pre-corrected values are already monotone
+        else:
             cv = _counter_corrected(jnp.where(valid, vals, 0.0), valid, pv)
             cv = jnp.where(valid, cv, 0.0)
-        else:
-            cv = v
         v_first = _gather(cv, first_idx)
         v_last = _gather(cv, last_idx)
-        raw_first = _gather(v, first_idx)
+        if pre_corrected and raw is not None:
+            # the extrapolate-to-zero heuristic needs each window's RAW
+            # first sample — the rebased lane lost that magnitude, so
+            # gather it from the raw reference tensor instead
+            raw_first = _gather(
+                jnp.where(valid, raw.astype(dt), 0.0), first_idx)
+        else:
+            raw_first = _gather(v, first_idx)
         t_first = _gather(ts, first_idx).astype(dt) / 1000.0
         t_last = _gather(ts, last_idx).astype(dt) / 1000.0
         result = v_last - v_first

@@ -226,7 +226,11 @@ class SelectRawPartitionsExec(ExecPlan):
 
     def execute(self, ctx: ExecContext) -> QueryResult:
         data = self.do_execute(ctx)
-        self._enforce_limits(data, ctx.qcontext)
+        # same post-compaction rule as ExecPlan.execute: device-resident
+        # results with deferred compaction enforce at the service boundary
+        if isinstance(data.values, np.ndarray) \
+                and not getattr(data, "_pending_compact", False):
+            self._enforce_limits(data, ctx.qcontext)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
 
     def _use_device_path(self, shard, schema, col) -> bool:

@@ -64,10 +64,12 @@ def gauge_stream(keys: list[PartKey], n_samples: int, start_ms: int = 0,
 
 def counter_stream(keys: list[PartKey], n_samples: int, start_ms: int = 0,
                    interval_ms: int = 10_000, batch: int = 100, seed: int = 0,
-                   reset_every: int = 0):
-    """Counter samples with optional resets to exercise rate correction."""
+                   reset_every: int = 0, start_value: float = 0.0):
+    """Counter samples with optional resets to exercise rate correction.
+    ``start_value`` sets the initial counter magnitude (a long-lived busy
+    counter sits well beyond 2^24 — the f32-precision regime)."""
     rng = np.random.default_rng(seed)
-    values = dict.fromkeys(keys, 0.0)
+    values = dict.fromkeys(keys, start_value)
     container = RecordContainer()
     offset = 0
     for s in range(n_samples):

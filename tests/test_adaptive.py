@@ -1,0 +1,143 @@
+"""AdaptiveQueryEngine: two-lane cost routing (parallel/adaptive.py).
+
+On the CPU-only test backend the host lane is declined (the default
+backend IS the cpu), so routing is exercised with injected lanes.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.parallel.adaptive import (
+    AdaptiveQueryEngine,
+    _bucket,
+    _LaneCost,
+)
+from filodb_tpu.testing.data import counter_series, counter_stream
+
+START = 1_600_000_000
+
+
+def _service(engine="adaptive"):
+    ms = TimeSeriesMemStore()
+    for s in range(2):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100))
+    ingest_routed(ms, "timeseries",
+                  counter_stream(counter_series(4), 300,
+                                 start_ms=START * 1000), 2, 1)
+    return QueryService(ms, "timeseries", 2, spread=1, engine=engine)
+
+
+class TestAdaptiveOnCpu:
+    def test_degenerates_to_device_lane_on_cpu(self):
+        """With a cpu default backend there is no separate host lane: the
+        adaptive engine must route everything to the device engine and
+        produce results identical to engine="mesh"."""
+        svc = _service("adaptive")
+        ref = _service("mesh")
+        q = ("sum(rate(http_requests_total[5m]))", START + 900, 60,
+             START + 1800)
+        a = svc.query_range(*q).result.materialize()
+        b = ref.query_range(*q).result.materialize()
+        np.testing.assert_allclose(np.asarray(a.values),
+                                   np.asarray(b.values), rtol=1e-12)
+        eng = svc.mesh_engine
+        assert isinstance(eng, AdaptiveQueryEngine)
+        assert eng._host() is None
+        assert eng.routed["device"] >= 1 and eng.routed["host"] == 0
+
+    def test_execute_many_parity(self):
+        svc = _service("adaptive")
+        ref = _service("mesh")
+        qs = [("sum(rate(http_requests_total[5m]))", START + 900, 60,
+               START + 1800)] * 5
+        ra = svc.query_range_many(qs)
+        rb = ref.query_range_many(qs)
+        for x, y in zip(ra, rb):
+            np.testing.assert_allclose(np.asarray(x.result.values),
+                                       np.asarray(y.result.values),
+                                       rtol=1e-12)
+
+
+class _FakeLane:
+    """Counts calls; pretends each call takes ``cost`` seconds/query."""
+
+    def __init__(self, cost):
+        self.cost = cost
+        self.calls = 0
+
+    def execute(self, memstore, dataset, plan, stats=None):
+        self.calls += 1
+        from filodb_tpu.query.model import StepMatrix
+        return StepMatrix.empty(np.array([0], np.int64))
+
+    def execute_many(self, plans, memstore, dataset, stats_list=None):
+        self.calls += 1
+        from filodb_tpu.query.model import StepMatrix
+        return [StepMatrix.empty(np.array([0], np.int64)) for _ in plans]
+
+    def execute_lowered_many(self, lows, memstore, dataset, stats=None):
+        from filodb_tpu.query.model import StepMatrix
+        return [StepMatrix.empty(np.array([0], np.int64)) for _ in lows]
+
+    def _lower(self, plan):
+        return object()
+
+
+class TestRouting:
+    def _engine_with_lanes(self):
+        eng = AdaptiveQueryEngine()
+        eng.device_engine = _FakeLane(0.070)
+        eng._host_engine = _FakeLane(0.001)
+        eng._host_checked = True
+        eng.sync_floor_s = 0.070
+        return eng
+
+    def test_cold_start_routes_host_and_costs_learned(self):
+        eng = self._engine_with_lanes()
+        # seed costs as a serving loop would
+        eng._record("host", 1, 0.001)
+        eng._record("device", 1, 0.070)
+        assert eng._route(1) == "host"
+        # large batches amortize the device sync: device wins there
+        eng._record("host", 256, 0.256)     # 1ms/query
+        eng._record("device", 256, 0.020)   # 0.08ms/query
+        assert eng._route(256) == "device"
+
+    def test_cold_start_prefers_host(self):
+        eng = self._engine_with_lanes()
+        assert eng._route(1) == "host"
+
+    def test_warmup_sample_replaced_not_blended(self):
+        c = _LaneCost()
+        c.record(5.0)     # compile-skewed first sample
+        c.record(0.001)   # first real sample replaces outright
+        assert c.est == pytest.approx(0.001)
+        c.record(0.002)   # later samples blend
+        assert 0.001 < c.est < 0.002
+
+    def test_shadow_probe_prices_other_lane(self):
+        eng = self._engine_with_lanes()
+        eng._record("host", 1, 0.001)
+        svc = _service("mesh")  # donor memstore + a lowerable plan
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+        plan = parse_query("sum(rate(http_requests_total[5m]))",
+                           TimeStepParams(START + 900, 60, START + 1800))
+        # device estimate missing -> shadow probe is due
+        eng._maybe_shadow("host", [plan], svc.memstore, "timeseries")
+        deadline = time.time() + 5
+        while eng.shadowed["device"] == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.shadowed["device"] == 1
+        assert eng._cost[("device", 1)].est is not None
+
+    def test_buckets(self):
+        assert _bucket(1) == 1
+        assert _bucket(3) == 4
+        assert _bucket(100) == 256
+        assert _bucket(5000) == 1024

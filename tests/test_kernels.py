@@ -334,3 +334,60 @@ class TestHistogramQuantile:
         les = jnp.asarray([1.0, np.inf])
         h = jnp.asarray([[0.0, 0.0]])
         assert np.isnan(np.asarray(histogram_quantile(0.5, h, les))[0])
+
+
+class TestPreCorrectedLaneParity:
+    """The pre-corrected/rebased f32-precision lane must be numerically
+    identical (in f64 test mode) to the legacy in-kernel correction path —
+    including the extrapolate-to-zero clamp, which needs each window's RAW
+    first sample (a reset right before the window start must still bind
+    the clamp)."""
+
+    def _args(self):
+        # one series with a counter reset at t=70s; window at 105s has its
+        # first sample AFTER the reset (raw first = 2, corrected = 1102)
+        ts = np.array([[0, 10, 20, 30, 40, 70, 80, 90]], np.int32) * 1000
+        vals = np.array([[0, 400, 800, 1000, 1100, 2, 52, 102]], np.float64)
+        counts = np.array([8], np.int32)
+        steps = np.array([105_000], np.int32)
+        window = np.int32(60_000)
+        return ts, vals, counts, steps, window
+
+    def test_rate_clamp_survives_rebasing(self):
+        from filodb_tpu.query.engine import kernels
+        from filodb_tpu.query.engine.batch import SeriesBatch
+
+        ts, vals, counts, steps, window = self._args()
+        legacy = np.asarray(kernels.range_eval(
+            "rate", ts, vals, counts, steps, window, counter=True))
+        batch = SeriesBatch(0, ts, vals, counts, [0])
+        ts_d, reb, cnt_d, raw_d = batch.delta_arrays(counter=True)
+        lane = np.asarray(kernels.range_eval(
+            "rate", ts_d, reb, cnt_d, steps, window, counter=True,
+            pre_corrected=True, raw=raw_d))
+        np.testing.assert_allclose(lane, legacy, rtol=1e-12)
+        # the clamp actually binds here (guards against the heuristic
+        # silently degrading to no-clamp)
+        unclamped = np.asarray(kernels.range_eval(
+            "rate", ts_d, reb, cnt_d, steps, window, counter=True,
+            pre_corrected=True))
+        assert not np.allclose(unclamped, legacy, rtol=1e-6)
+
+    def test_idelta_keeps_raw_negative_delta_across_reset(self):
+        """idelta is defined on raw samples: the step straddling a counter
+        reset reports the negative raw diff (Prometheus semantics) — the
+        rebase-only lane must preserve that."""
+        from filodb_tpu.query.engine import kernels
+        from filodb_tpu.query.engine.batch import SeriesBatch
+
+        ts, vals, counts, steps, window = self._args()
+        legacy = np.asarray(kernels.range_eval(
+            "idelta", ts, vals, counts,
+            np.array([75_000], np.int32), window))
+        batch = SeriesBatch(0, ts, vals, counts, [0])
+        ts_d, reb, cnt_d, _ = batch.delta_arrays(counter=False)
+        lane = np.asarray(kernels.range_eval(
+            "idelta", ts_d, reb, cnt_d, np.array([75_000], np.int32),
+            window, pre_corrected=True))
+        np.testing.assert_allclose(lane, legacy, rtol=1e-12)
+        assert legacy[0, 0] == -1098.0  # 2 - 1100: raw negative delta
