@@ -197,12 +197,19 @@ def cmd_decode_chunk(args):
                       f"[{c.start_time}..{c.end_time}] bytes={c.nbytes}")
                 if args.verbose:
                     ts = c.decode_column(0)
-                    vals = c.decode_column(len(c.vectors) - 1)
-                    if isinstance(vals, HistogramColumn):
-                        print(f"    les={vals.les}")
-                    else:
-                        print(f"    ts[:5]={ts[:5]} vals[:5]="
-                              f"{np.asarray(vals)[:5]}")
+                    print(f"    ts[:5]={ts[:5]}")
+                    for ci in range(1, len(c.vectors)):
+                        vals = c.decode_column(ci)
+                        codec_id = c.vectors[ci][0]
+                        if isinstance(vals, HistogramColumn):
+                            print(f"    col{ci} codec={codec_id} hist "
+                                  f"les={vals.les} rows[:2]={vals.rows[:2]}")
+                        elif isinstance(vals, list):  # strings or maps
+                            print(f"    col{ci} codec={codec_id} "
+                                  f"vals[:5]={vals[:5]}")
+                        else:
+                            print(f"    col{ci} codec={codec_id} "
+                                  f"vals[:5]={np.asarray(vals)[:5]}")
 
 
 def main(argv=None):

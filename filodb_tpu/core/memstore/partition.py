@@ -83,7 +83,8 @@ class WriteBufferPool:
         for ci in range(len(cols)):
             # index access, not zip: zip's yielded tuple would itself hold
             # a reference to the array for the duration of the loop body
-            if cols[ci].ctype in (ColumnType.HISTOGRAM, ColumnType.STRING):
+            if cols[ci].ctype in (ColumnType.HISTOGRAM, ColumnType.STRING,
+                                  ColumnType.MAP):
                 continue
             data = buf.cols[ci]
             if data is not None and sys.getrefcount(data) > 3:
@@ -106,7 +107,7 @@ class WriteBufferPool:
             for ci, col in enumerate(self.schema.data.columns[1:]):
                 if col.ctype == ColumnType.HISTOGRAM:
                     buf.cols[ci] = None  # bucket schemes vary per series
-                elif col.ctype == ColumnType.STRING:
+                elif col.ctype in (ColumnType.STRING, ColumnType.MAP):
                     buf.cols[ci] = [None] * self.max_chunk_size
             return buf
         return factory()
@@ -160,7 +161,7 @@ class TimeSeriesPartition:
                 cols.append(np.empty(self.max_chunk_size, np.int64))
             elif c.ctype == ColumnType.HISTOGRAM:
                 cols.append(None)  # allocated on first sample (bucket count)
-            elif c.ctype == ColumnType.STRING:
+            elif c.ctype in (ColumnType.STRING, ColumnType.MAP):
                 cols.append([None] * self.max_chunk_size)
             else:
                 raise ValueError(f"unsupported {c.ctype}")
@@ -217,7 +218,7 @@ class TimeSeriesPartition:
                         (self.max_chunk_size, len(buckets)), np.int64)
                 self.bucket_les = np.asarray(les, np.float64)
                 b.cols[ci][i] = buckets
-            elif col.ctype == ColumnType.STRING:
+            elif col.ctype in (ColumnType.STRING, ColumnType.MAP):
                 b.cols[ci][i] = v
             else:
                 b.cols[ci][i] = v
@@ -239,7 +240,7 @@ class TimeSeriesPartition:
                 cols.append(HistogramColumn(
                     self.bucket_les if self.bucket_les is not None
                     else np.zeros(rows.shape[1]), rows))
-            elif col.ctype == ColumnType.STRING:
+            elif col.ctype in (ColumnType.STRING, ColumnType.MAP):
                 cols.append(data[: b.n])
             else:
                 cols.append(data[: b.n])

@@ -112,16 +112,18 @@ def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0) ->
     vectors: list[bytes] = [codecs.encode_delta_delta(ts)]
     for col, data in zip(schema.data.columns[1:], columns):
         if col.ctype == ColumnType.DOUBLE:
-            vectors.append(codecs.encode_xor_double(np.asarray(data, np.float64)))
+            vectors.append(codecs.encode_double(np.asarray(data, np.float64)))
         elif col.ctype in (ColumnType.LONG, ColumnType.INT, ColumnType.TIMESTAMP):
-            vectors.append(codecs.encode_delta_delta(np.asarray(data, np.int64)))
+            vectors.append(codecs.encode_int(np.asarray(data, np.int64)))
         elif col.ctype == ColumnType.HISTOGRAM:
             if isinstance(data, codecs.HistogramColumn):
                 vectors.append(codecs.encode_hist_2d_delta(data.rows, data.les))
             else:
                 vectors.append(codecs.encode_hist_2d_delta(np.asarray(data, np.int64)))
         elif col.ctype == ColumnType.STRING:
-            vectors.append(codecs.encode_dict_string(list(data)))
+            vectors.append(codecs.encode_string(list(data)))
+        elif col.ctype == ColumnType.MAP:
+            vectors.append(codecs.encode_map(list(data)))
         else:
             raise ValueError(f"unsupported column type {col.ctype}")
     return Chunk(chunk_id(int(ts[0]), seq), len(ts), int(ts[0]), int(ts[-1]),

@@ -41,6 +41,10 @@ CODEC_HIST_2D_DELTA = 4
 CODEC_DICT_STRING = 5          # legacy: NUL-separated dictionary (decode only)
 CODEC_RAW_DOUBLE = 6
 CODEC_DICT_STRING_LP = 7       # u32-length-prefixed dictionary entries
+CODEC_CONST_DOUBLE = 8         # ConstVector analog for doubles
+CODEC_PACKED_INT = 9           # frame-of-reference bit-packed ints/longs
+CODEC_UTF8 = 10                # raw UTF8 vector: i32 offsets + blob
+CODEC_MAP = 11                 # map<string,string> column (dict over blobs)
 
 
 def encode_delta_delta(values: np.ndarray) -> bytes:
@@ -177,6 +181,189 @@ def decode_dict_string(data: bytes) -> list[str]:
     return [table[int(c)] for c in codes]
 
 
+def encode_const_double(value: float, n: int) -> bytes:
+    """All-rows-equal double vector (reference ``ConstVector.scala``: repeats
+    one stored value ``numRows`` times)."""
+    return struct.pack("<BId", CODEC_CONST_DOUBLE, n, value)
+
+
+def decode_const_double(data: bytes) -> np.ndarray:
+    codec, n, value = struct.unpack_from("<BId", data, 0)
+    assert codec == CODEC_CONST_DOUBLE, f"bad codec {codec}"
+    return np.full(n, value, dtype=np.float64)
+
+
+def encode_double(values: np.ndarray) -> bytes:
+    """Encode a double column with automatic codec selection: const when all
+    rows carry one value (bitwise, so NaN==NaN), XOR+NibblePack otherwise
+    (reference ``DoubleVector.optimize`` → ConstVector / DeltaDeltaDouble)."""
+    v = np.ascontiguousarray(values, dtype=np.float64)
+    if len(v) and (v.view(np.uint64) == v.view(np.uint64)[0]).all():
+        return encode_const_double(float(v[0]), len(v))
+    return encode_xor_double(v)
+
+
+# frame-of-reference bit widths tried in order (reference IntBinaryVector
+# supports nbits 2/4/8/16/32; we add 1 and 64 at the extremes)
+_PACK_WIDTHS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def encode_packed_int(values: np.ndarray) -> bytes:
+    """Frame-of-reference bit-packed integer vector.
+
+    Values are rebased against their minimum, then packed at the smallest
+    bit width in {1,2,4,8,16,32,64} that holds ``max - min``; an all-equal
+    vector collapses to width 0 (ConstVector analog). Counterpart of the
+    reference's minimal-nbits int vectors (``IntBinaryVector.scala:56-120``,
+    ``IntBinaryVector.optimize``) and ``LongBinaryVector``/``ConstVector``.
+    """
+    v = np.ascontiguousarray(values, dtype=np.int64)
+    n = len(v)
+    if n == 0:
+        return struct.pack("<BIqB", CODEC_PACKED_INT, 0, 0, 0)
+    base = int(v.min())
+    spread = int(v.max()) - base  # fits u64: int64 range spread
+    if spread == 0:
+        return struct.pack("<BIqB", CODEC_PACKED_INT, n, base, 0)
+    rebased = (v - base).astype(np.uint64)
+    nbits = next(w for w in _PACK_WIDTHS if spread < (1 << w) or w == 64)
+    head = struct.pack("<BIqB", CODEC_PACKED_INT, n, base, nbits)
+    if nbits >= 8:
+        return head + rebased.astype(f"<u{nbits // 8}").tobytes()
+    # sub-byte widths: pack per-value bits little-endian within each byte
+    per_byte = 8 // nbits
+    pad = (-n) % per_byte
+    r = np.concatenate([rebased, np.zeros(pad, np.uint64)]) \
+        .reshape(-1, per_byte).astype(np.uint8)
+    shifts = (np.arange(per_byte, dtype=np.uint8) * nbits).astype(np.uint8)
+    packed = (r << shifts).astype(np.uint8)
+    return head + np.bitwise_or.reduce(packed, axis=1).tobytes()
+
+
+def decode_packed_int(data: bytes) -> np.ndarray:
+    codec, n, base, nbits = struct.unpack_from("<BIqB", data, 0)
+    assert codec == CODEC_PACKED_INT, f"bad codec {codec}"
+    off = struct.calcsize("<BIqB")
+    if n == 0:
+        return np.array([], np.int64)
+    if nbits == 0:
+        return np.full(n, base, dtype=np.int64)
+    if nbits >= 8:
+        raw = np.frombuffer(data, dtype=f"<u{nbits // 8}", count=n, offset=off)
+        return base + raw.astype(np.int64)
+    per_byte = 8 // nbits
+    nbytes = (n + per_byte - 1) // per_byte
+    b = np.frombuffer(data, dtype=np.uint8, count=nbytes, offset=off)
+    shifts = (np.arange(per_byte, dtype=np.uint8) * nbits).astype(np.uint8)
+    mask = np.uint8((1 << nbits) - 1)
+    vals = ((b[:, None] >> shifts) & mask).reshape(-1)[:n]
+    return base + vals.astype(np.int64)
+
+
+def encode_int(values: np.ndarray) -> bytes:
+    """Encode an int/long column picking the smaller of frame-of-reference
+    bit packing and delta-delta+NibblePack (the reference's ``optimize`` step
+    likewise picks the best encoding per chunk)."""
+    packed = encode_packed_int(values)
+    dd = encode_delta_delta(values)
+    return packed if len(packed) <= len(dd) else dd
+
+
+def _encode_blob_vector(codec_id: int, blobs: list[bytes]) -> bytes:
+    """Shared layout for UTF8/MAP vectors: i32 end-offsets + concatenated
+    blob (reference ``UTF8Vector.scala`` fixed-offset layout)."""
+    offsets = np.zeros(len(blobs), dtype=np.uint32)
+    total = 0
+    for i, b in enumerate(blobs):
+        total += len(b)
+        offsets[i] = total
+    return (struct.pack("<BII", codec_id, len(blobs), total)
+            + offsets.tobytes() + b"".join(blobs))
+
+
+def _decode_blob_vector(data: bytes, expect_codec: int) -> list[bytes]:
+    codec, n, total = struct.unpack_from("<BII", data, 0)
+    assert codec == expect_codec, f"bad codec {codec}"
+    off = struct.calcsize("<BII")
+    ends = np.frombuffer(data, dtype=np.uint32, count=n, offset=off)
+    blob_off = off + 4 * n
+    blob = data[blob_off : blob_off + total]
+    out, start = [], 0
+    for e in ends:
+        out.append(blob[start : int(e)])
+        start = int(e)
+    return out
+
+
+def encode_utf8(values: list[str]) -> bytes:
+    """Raw (non-dict) UTF8 string vector — offsets + blob, values may contain
+    any bytes including NULs (reference ``UTF8Vector.scala``)."""
+    return _encode_blob_vector(CODEC_UTF8, [s.encode("utf-8") for s in values])
+
+
+def decode_utf8(data: bytes) -> list[str]:
+    return [b.decode("utf-8") for b in _decode_blob_vector(data, CODEC_UTF8)]
+
+
+def encode_string(values: list[str]) -> bytes:
+    """Encode a string column with dict-vs-raw auto-selection: dictionary
+    when cardinality is low enough to pay off (reference
+    ``DictUTF8Vector.shouldMakeDict`` samples for uniqueness the same way)."""
+    uniq = len(set(values))
+    if len(values) and uniq <= max(1, len(values) // 2):
+        return encode_dict_string(values)
+    return encode_utf8(values)
+
+
+def _ser_map(m: dict) -> bytes:
+    """Canonical binary form of one map row: sorted u16-length-prefixed
+    key/value UTF8 pairs."""
+    parts = [struct.pack("<H", len(m))]
+    for k in sorted(m):
+        kb, vb = k.encode("utf-8"), str(m[k]).encode("utf-8")
+        parts.append(struct.pack("<HH", len(kb), len(vb)))
+        parts.append(kb)
+        parts.append(vb)
+    return b"".join(parts)
+
+
+def _deser_map(b: bytes) -> dict:
+    (npairs,) = struct.unpack_from("<H", b, 0)
+    off, out = 2, {}
+    for _ in range(npairs):
+        kl, vl = struct.unpack_from("<HH", b, off)
+        off += 4
+        k = b[off : off + kl].decode("utf-8")
+        off += kl
+        out[k] = b[off : off + vl].decode("utf-8")
+        off += vl
+    return out
+
+
+def encode_map(values: list[dict]) -> bytes:
+    """Map<string,string> column: rows serialized canonically, then
+    dictionary-encoded over whole-row blobs (map rows repeat heavily —
+    reference ``Column.MapColumn`` stores per-row label maps)."""
+    blobs = [_ser_map(m or {}) for m in values]
+    uniq: dict[bytes, int] = {}
+    codes = np.empty(len(blobs), dtype=np.uint64)
+    for i, b in enumerate(blobs):
+        codes[i] = uniq.setdefault(b, len(uniq))
+    table = list(uniq)
+    head = _encode_blob_vector(CODEC_MAP, table)
+    return head + struct.pack("<I", len(values)) + bytes(nibble_pack(codes))
+
+
+def decode_map(data: bytes) -> list[dict]:
+    codec, nuniq, total = struct.unpack_from("<BII", data, 0)
+    assert codec == CODEC_MAP, f"bad codec {codec}"
+    table_end = struct.calcsize("<BII") + 4 * nuniq + total
+    table = [_deser_map(b) for b in _decode_blob_vector(data, CODEC_MAP)]
+    (n,) = struct.unpack_from("<I", data, table_end)
+    codes = nibble_unpack(data[table_end + 4 :], n)
+    return [dict(table[int(c)]) for c in codes]
+
+
 def encode_raw_double(values: np.ndarray) -> bytes:
     v = np.ascontiguousarray(values, dtype=np.float64)
     return struct.pack("<BI", CODEC_RAW_DOUBLE, len(v)) + v.tobytes()
@@ -213,4 +400,12 @@ def decode_any(data: bytes) -> np.ndarray | list[str]:
         return decode_dict_string(data)
     if codec == CODEC_RAW_DOUBLE:
         return decode_raw_double(data)
+    if codec == CODEC_CONST_DOUBLE:
+        return decode_const_double(data)
+    if codec == CODEC_PACKED_INT:
+        return decode_packed_int(data)
+    if codec == CODEC_UTF8:
+        return decode_utf8(data)
+    if codec == CODEC_MAP:
+        return decode_map(data)
     raise ValueError(f"unknown codec id {codec}")

@@ -1,0 +1,219 @@
+"""Round-trip + selection tests for the full column vector codec family.
+
+Coverage model: the reference's vector specs
+(``memory/src/test/scala/filodb.memory/format/vectors/IntBinaryVectorTest.scala``,
+``LongVectorTest.scala``, ``UTF8VectorTest.scala``, ``DoubleVectorTest.scala``,
+ConstVector cases in ``NativeVectorTest.scala``) — minimal-nbits int packing,
+const collapse, raw-vs-dict UTF8, and multi-column schema chunks.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.core.schemas import Column, ColumnType, DataSchema, Schema
+from filodb_tpu.memory import codecs
+from filodb_tpu.memory.chunk import encode_chunk
+
+
+class TestPackedInt:
+    def test_round_trip_widths(self):
+        rng = np.random.default_rng(7)
+        for hi in (1, 2, 8, 200, 60_000, 2**31, 2**40):
+            v = rng.integers(0, hi, size=137, dtype=np.int64)
+            out = codecs.decode_packed_int(codecs.encode_packed_int(v))
+            np.testing.assert_array_equal(out, v)
+
+    def test_const_collapses_to_width0(self):
+        v = np.full(1000, 123456789, dtype=np.int64)
+        enc = codecs.encode_packed_int(v)
+        # header only: ConstVector analog
+        assert len(enc) == 14
+        np.testing.assert_array_equal(codecs.decode_packed_int(enc), v)
+
+    def test_minimal_nbits_selection(self):
+        # values 0/1 -> 1 bit per value
+        v = (np.arange(800) % 2).astype(np.int64)
+        enc = codecs.encode_packed_int(v)
+        assert len(enc) <= 14 + 100  # 800 bits = 100 bytes payload
+        np.testing.assert_array_equal(codecs.decode_packed_int(enc), v)
+        # values 0..15 -> 4 bits
+        v4 = (np.arange(800) % 16).astype(np.int64)
+        enc4 = codecs.encode_packed_int(v4)
+        assert len(enc4) <= 14 + 400
+        np.testing.assert_array_equal(codecs.decode_packed_int(enc4), v4)
+
+    def test_frame_of_reference_large_base(self):
+        # large base, tiny spread: should pack at sub-byte width
+        v = 10**17 + (np.arange(100) % 4).astype(np.int64)
+        enc = codecs.encode_packed_int(v)
+        assert len(enc) <= 14 + 25
+        np.testing.assert_array_equal(codecs.decode_packed_int(enc), v)
+
+    def test_negative_values(self):
+        v = np.array([-5, -1, 0, 3, -5, 2], dtype=np.int64)
+        np.testing.assert_array_equal(
+            codecs.decode_packed_int(codecs.encode_packed_int(v)), v)
+
+    def test_int64_extremes(self):
+        v = np.array([np.iinfo(np.int64).min, 0, np.iinfo(np.int64).max - 1],
+                     dtype=np.int64)
+        np.testing.assert_array_equal(
+            codecs.decode_packed_int(codecs.encode_packed_int(v)), v)
+
+    def test_empty(self):
+        out = codecs.decode_packed_int(
+            codecs.encode_packed_int(np.array([], np.int64)))
+        assert len(out) == 0
+
+    def test_odd_lengths_subbyte(self):
+        for n in (1, 3, 7, 9, 15):
+            v = (np.arange(n) % 2).astype(np.int64)
+            np.testing.assert_array_equal(
+                codecs.decode_packed_int(codecs.encode_packed_int(v)), v)
+
+    def test_encode_int_picks_best(self):
+        # monotone ramp: delta-delta collapses to const-slope (header only);
+        # random small ints: frame-of-reference wins
+        ramp = np.arange(0, 10_000, 10, dtype=np.int64)
+        enc = codecs.encode_int(ramp)
+        assert enc[0] == codecs.CODEC_DELTA_DELTA_CONST
+        rng = np.random.default_rng(3)
+        rnd = rng.integers(0, 16, size=1000, dtype=np.int64)
+        enc2 = codecs.encode_int(rnd)
+        np.testing.assert_array_equal(codecs.decode_any(enc2), rnd)
+        assert len(enc2) < 1000  # must beat raw int64 by 8x+
+
+
+class TestConstDouble:
+    def test_round_trip(self):
+        enc = codecs.encode_const_double(2.75, 42)
+        out = codecs.decode_const_double(enc)
+        assert out.shape == (42,)
+        assert (out == 2.75).all()
+
+    def test_encode_double_selects_const(self):
+        v = np.full(500, -1.5)
+        enc = codecs.encode_double(v)
+        assert enc[0] == codecs.CODEC_CONST_DOUBLE
+        assert len(enc) == 13
+        np.testing.assert_array_equal(codecs.decode_any(enc), v)
+
+    def test_encode_double_nan_const(self):
+        v = np.full(10, np.nan)
+        enc = codecs.encode_double(v)
+        assert enc[0] == codecs.CODEC_CONST_DOUBLE
+        assert np.isnan(codecs.decode_any(enc)).all()
+
+    def test_encode_double_varying_uses_xor(self):
+        v = np.array([1.0, 2.0, 3.0])
+        enc = codecs.encode_double(v)
+        assert enc[0] == codecs.CODEC_XOR_DOUBLE
+        np.testing.assert_array_equal(codecs.decode_any(enc), v)
+
+
+class TestUTF8Vector:
+    def test_round_trip(self):
+        vals = ["alpha", "beta", "", "汉字", "x" * 300]
+        assert codecs.decode_utf8(codecs.encode_utf8(vals)) == vals
+
+    def test_empty_vector(self):
+        assert codecs.decode_utf8(codecs.encode_utf8([])) == []
+
+    def test_high_cardinality_selects_raw(self):
+        vals = [f"series-{i}" for i in range(100)]
+        enc = codecs.encode_string(vals)
+        assert enc[0] == codecs.CODEC_UTF8
+        assert codecs.decode_any(enc) == vals
+
+    def test_low_cardinality_selects_dict(self):
+        vals = ["up", "down"] * 50
+        enc = codecs.encode_string(vals)
+        assert enc[0] == codecs.CODEC_DICT_STRING_LP
+        assert codecs.decode_any(enc) == vals
+
+
+class TestMapVector:
+    def test_round_trip(self):
+        vals = [{"app": "api", "dc": "east"},
+                {"app": "api", "dc": "west"},
+                {},
+                {"app": "api", "dc": "east"}]
+        out = codecs.decode_map(codecs.encode_map(vals))
+        assert out == vals
+
+    def test_none_rows_become_empty(self):
+        out = codecs.decode_map(codecs.encode_map([None, {"a": "1"}]))
+        assert out == [{}, {"a": "1"}]
+
+    def test_repeating_maps_dict_compress(self):
+        row = {"kubernetes_namespace": "prod", "app": "gateway", "zone": "b"}
+        vals = [dict(row) for _ in range(1000)]
+        enc = codecs.encode_map(vals)
+        # dictionary: ~one blob + packed codes, far below per-row encoding
+        assert len(enc) < 800
+        assert codecs.decode_any(enc) == vals
+
+    def test_unicode_keys_values(self):
+        vals = [{"ключ": "значение", "k": "汉"}]
+        assert codecs.decode_map(codecs.encode_map(vals)) == vals
+
+
+MULTI = Schema(DataSchema(
+    "multi",
+    (Column("timestamp", ColumnType.TIMESTAMP),
+     Column("count", ColumnType.LONG),
+     Column("flag", ColumnType.INT),
+     Column("value", ColumnType.DOUBLE),
+     Column("msg", ColumnType.STRING),
+     Column("tags", ColumnType.MAP)),
+    value_column=3,
+))
+
+
+class TestMultiColumnChunk:
+    def test_full_schema_round_trip(self):
+        n = 50
+        ts = np.arange(n, dtype=np.int64) * 1000
+        counts = np.arange(n, dtype=np.int64) * 3
+        flags = (np.arange(n) % 2).astype(np.int64)
+        vals = np.sin(np.arange(n) / 5.0)
+        msgs = [f"event {i % 5}" for i in range(n)]
+        tags = [{"host": f"h{i % 3}"} for i in range(n)]
+        chunk = encode_chunk(MULTI, ts, [counts, flags, vals, msgs, tags])
+        np.testing.assert_array_equal(chunk.decode_column(0), ts)
+        np.testing.assert_array_equal(chunk.decode_column(1), counts)
+        np.testing.assert_array_equal(chunk.decode_column(2), flags)
+        np.testing.assert_allclose(chunk.decode_column(3), vals)
+        assert chunk.decode_column(4) == msgs
+        assert chunk.decode_column(5) == tags
+
+    def test_serialized_chunk_survives_wire(self):
+        from filodb_tpu.memory.chunk import Chunk
+        n = 10
+        ts = np.arange(n, dtype=np.int64)
+        chunk = encode_chunk(MULTI, ts, [
+            np.zeros(n, np.int64), np.ones(n, np.int64),
+            np.full(n, 7.0), ["a"] * n, [{"k": "v"}] * n])
+        back = Chunk.deserialize(chunk.serialize())
+        assert back.decode_column(4) == ["a"] * n
+        assert back.decode_column(5) == [{"k": "v"}] * n
+        np.testing.assert_array_equal(back.decode_column(3), np.full(n, 7.0))
+
+
+class TestPartitionIngestMultiColumn:
+    def test_ingest_and_read_string_map_columns(self):
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        from filodb_tpu.core.partkey import PartKey
+        part = TimeSeriesPartition(
+            0, PartKey.create("multi", {"_metric_": "events"}), MULTI,
+            max_chunk_size=8)
+        for i in range(20):  # crosses chunk boundaries
+            part.ingest(i * 1000, (i, i % 2, float(i), f"m{i % 3}",
+                                   {"n": str(i % 2)}))
+        assert part.num_samples == 20
+        ts, vals = part.read_samples(0, 10**9, col=3)
+        np.testing.assert_array_equal(vals, np.arange(20, dtype=float))
+        ts, msgs = part.read_samples(0, 10**9, col=4)
+        assert list(msgs) == [f"m{i % 3}" for i in range(20)]
+        ts, tags = part.read_samples(0, 10**9, col=5)
+        assert list(tags) == [{"n": str(i % 2)} for i in range(20)]
