@@ -161,6 +161,25 @@ class PartKeyIndex:
     def __len__(self) -> int:
         return self._count
 
+    @property
+    def ram_bytes(self) -> int:
+        """Approximate resident bytes of the index tiers (reference
+        ``indexRamBytes`` gauge): time arrays + tail postings + native
+        postings store."""
+        n = self._start.nbytes + self._end.nbytes
+        for vals in self._tail.values():
+            for pids in vals.values():
+                n += 64 + 8 * len(pids)
+        for fl in self._frozen.values():
+            n += len(fl.vblob) + fl.voff.nbytes + fl.poff.nbytes \
+                + fl.pids.nbytes
+        if self._nt is not None:
+            try:
+                n += int(self._nt.ram_bytes())
+            except Exception:
+                pass
+        return n
+
     def _ensure(self, part_id: int) -> None:
         while part_id >= len(self._start):
             self._start = np.concatenate([self._start,

@@ -51,6 +51,7 @@ class DemandPagedChunkCache:
             if cached is None:
                 self._lru[key] = ch
                 odp_chunks_paged.inc()
+                shard.stats.chunks_paged_in.inc()
                 cached = ch
             else:
                 self._lru.move_to_end(key)
@@ -83,5 +84,6 @@ def page_partitions(shard: TimeSeriesShard, parts: list[TimeSeriesPartition],
                 chunks = cache.get_or_load(shard, p, start, end)
                 if chunks:
                     out[p.part_id] = chunks
+                    shard.stats.partitions_paged_in.inc()
         tag("partitions_paged", len(out))
     return out

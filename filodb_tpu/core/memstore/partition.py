@@ -25,6 +25,11 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.schemas import ColumnType, Schema
 from filodb_tpu.memory.chunk import Chunk, encode_chunk
 from filodb_tpu.memory.codecs import HistogramColumn
+from filodb_tpu.utils.metrics import Counter
+
+# process-wide (reference keeps an untagged chunks-queried counter beside the
+# per-shard one, ``TimeSeriesShard.scala:48``)
+chunks_queried = Counter("memstore_chunks_queried")
 
 
 @dataclass
@@ -358,6 +363,7 @@ class TimeSeriesPartition:
             chunks.sort(key=lambda c: c.id)
         ts_parts, val_parts = [], []
         les = None
+        chunks_queried.inc(len(chunks))
         for c in chunks:
             ts = c.decode_column(0)
             vals = c.decode_column(col)

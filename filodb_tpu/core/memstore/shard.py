@@ -33,31 +33,109 @@ from filodb_tpu.core.record import SomeData
 from filodb_tpu.core.schemas import Schemas
 from filodb_tpu.core.store.api import ColumnStore, MetaStore, PartKeyRecord
 from filodb_tpu.core.store.config import StoreConfig
-from filodb_tpu.utils.metrics import Counter, Gauge
+from filodb_tpu.utils.metrics import Counter, Gauge, GaugeFn, Histogram
 
 log = logging.getLogger(__name__)
 
 
-@dataclass
 class ShardStats:
-    """Reference ``TimeSeriesShardStats`` (``TimeSeriesShard.scala:41-133``)."""
+    """The reference's full named shard metric set, tagged {dataset, shard}
+    (``TimeSeriesShardStats``, ``TimeSeriesShard.scala:41-133``). Metric
+    names keep the reference's Kamon names, Prometheus-sanitized. Gauges
+    over live shard state (index sizes, pool sizes, chunk bytes) register
+    as scrape-time callbacks via ``register_state_gauges``."""
 
-    rows_ingested: Counter = field(default_factory=lambda: Counter("rows_ingested"))
-    rows_skipped: Counter = field(default_factory=lambda: Counter("rows_skipped"))
-    quota_dropped: Counter = field(default_factory=lambda: Counter("quota_dropped"))
-    out_of_order_dropped: Counter = field(
-        default_factory=lambda: Counter("out_of_order_dropped"))
-    partitions_created: Counter = field(
-        default_factory=lambda: Counter("partitions_created"))
-    partitions_purged: Counter = field(
-        default_factory=lambda: Counter("partitions_purged"))
-    partitions_evicted: Counter = field(
-        default_factory=lambda: Counter("partitions_evicted"))
-    partitions_restored: Counter = field(
-        default_factory=lambda: Counter("partitions_restored"))
-    chunks_flushed: Counter = field(default_factory=lambda: Counter("chunks_flushed"))
-    flushes_done: Counter = field(default_factory=lambda: Counter("flushes_done"))
-    num_partitions: Gauge = field(default_factory=lambda: Gauge("num_partitions"))
+    def __init__(self, dataset: str = "", shard: int = 0):
+        tags = {"dataset": dataset, "shard": str(shard)}
+        self.tags = tags
+
+        def C(name):
+            return Counter(name, tags)
+
+        def G(name):
+            return Gauge(name, tags)
+
+        def H(name):
+            return Histogram(name, tags)
+
+        # ingest
+        self.rows_ingested = C("memstore_rows_ingested")
+        self.rows_skipped = C("recovery_row_skipped")
+        self.quota_dropped = C("memstore_data_dropped")
+        self.unknown_schema_dropped = C("memstore_unknown_schema_dropped")
+        self.incompatible_containers = C("memstore_incompatible_containers")
+        self.offsets_not_recovered = C("memstore_offsets_not_recovered")
+        self.out_of_order_dropped = C("memstore_out_of_order_samples")
+        self.ingestion_clock_delay = G("ingestion_clock_delay_ms")
+        self.ingestion_pipeline_latency = H("ingestion_pipeline_latency_seconds")
+        # partition lifecycle
+        self.partitions_created = C("memstore_partitions_created")
+        self.partitions_purged = C("memstore_partitions_purged")
+        self.partitions_purged_index = C("memstore_partitions_purged_index")
+        self.purge_time_ms = C("memstore_partitions_purge_time_ms")
+        self.partitions_evicted = C("memstore_partitions_evicted")
+        self.chunkids_evicted = C("memstore_chunkids_evicted")
+        self.partitions_restored = C("memstore_partitions_paged_restored")
+        self.eviction_stall_ns = C("memstore_eviction_stall_ns")
+        self.num_partitions = G("num_partitions")
+        self.timeseries_count = G("memstore_timeseries_count")
+        # encode / flush
+        self.samples_encoded = C("memstore_samples_encoded")
+        self.encoded_bytes = C("memstore_encoded_bytes_allocated")
+        self.encoded_hist_bytes = C("memstore_hist_encoded_bytes")
+        self.chunks_flushed = C("memstore_flushes_chunks_written")
+        self.flushes_done = C("memstore_flushes_success")
+        self.flushes_failed = C("memstore_flushes_failed")
+        self.dirty_keys_flushed = C("memstore_index_num_dirty_keys_flushed")
+        self.flush_latency = H("chunk_flush_task_latency_seconds")
+        self.downsample_records_created = C("memstore_downsample_records_created")
+        # offsets (lag construction: kafka_latest - latest_inmemory, etc.)
+        self.offset_latest_in_mem = G("shard_offset_latest_inmemory")
+        self.offset_flushed_latest = G("shard_offset_flushed_latest")
+        self.offset_flushed_earliest = G("shard_offset_flushed_earliest")
+        # recovery
+        self.recovery_time_ms = G("memstore_total_shard_recovery_time_ms")
+        self.index_recovery_partkeys = C(
+            "memstore_index_recovery_partkeys_processed")
+        # query
+        self.partitions_queried = C("memstore_partitions_queried")
+        self.query_time_range_minutes = H("query_time_range_minutes")
+        # on-demand paging
+        self.chunks_paged_in = C("chunks_paged_in")
+        self.partitions_paged_in = C("memstore_partitions_paged_in")
+        # evicted-part-key bloom
+        self.bloom_queries = C("evicted_pk_bloom_filter_queries")
+        self.bloom_fp = C("evicted_pk_bloom_filter_fp")
+
+    def register_state_gauges(self, shard: "TimeSeriesShard") -> None:
+        """Scrape-time gauges over live shard state (reference gauges that
+        Kamon samples: index entries/bytes, buffer pool size, bloom size,
+        chunk memory)."""
+        import weakref
+        ref = weakref.ref(shard)  # don't let the registry pin a dead shard
+
+        def fn(get):
+            def call():
+                s = ref()
+                return get(s) if s is not None else float("nan")
+            return call
+
+        GaugeFn("memstore_index_entries", fn(lambda s: len(s.index)),
+                self.tags)
+        GaugeFn("memstore_index_ram_bytes",
+                fn(lambda s: s.index.ram_bytes), self.tags)
+        GaugeFn("memstore_writebuffer_pool_size",
+                fn(lambda s: sum(len(p._free)
+                                 for p in s.buffer_pools.values())),
+                self.tags)
+        GaugeFn("evicted_pk_bloom_filter_approx_size",
+                fn(lambda s: s.evicted_keys.count), self.tags)
+        GaugeFn("memstore_chunk_ram_bytes", fn(lambda s: s.chunk_bytes()),
+                self.tags)
+        GaugeFn("num_ingesting_partitions",
+                fn(lambda s: sum(1 for p in s.partitions
+                                 if p is not None and p.unflushed_count)),
+                self.tags)
 
 
 class TimeSeriesShard:
@@ -70,7 +148,7 @@ class TimeSeriesShard:
         self.config = store_config
         self.column_store = column_store
         self.meta_store = meta_store
-        self.stats = ShardStats()
+        self.stats = ShardStats(dataset, shard_num)
 
         self.partitions: list[TimeSeriesPartition | None] = []
         self._by_key: dict[PartKey, int] = {}
@@ -133,6 +211,7 @@ class TimeSeriesShard:
                 self._native_core = NativeShardCore(
                     store_config.max_chunk_size,
                     store_config.groups_per_shard)
+        self.stats.register_state_gauges(self)
 
     @property
     def data_version(self) -> int:
@@ -221,10 +300,12 @@ class TimeSeriesShard:
         the dedup floor from the old endTime so replayed history can't
         double-ingest (reference TimeSeriesShard.scala:457 bloom +
         partkey restore)."""
+        self.stats.bloom_queries.inc()
         if blob not in self.evicted_keys:
             return
         old = self.index.pid_for_exact_key(key, blob, exclude=pid)
         if old is None:
+            self.stats.bloom_fp.inc()
             return  # bloom false positive
         old_start = self.index.start_time(old)
         old_end = self.index.end_time(old)
@@ -361,6 +442,7 @@ class TimeSeriesShard:
                 if n >= 0:
                     return n
         n = 0
+        last_ts = -1
         for rec in data.container:
             group = self.group_of(rec.part_key)
             if offset <= self.group_watermarks[group]:
@@ -374,10 +456,15 @@ class TimeSeriesShard:
                 continue
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
+                last_ts = rec.timestamp
             else:
                 self.stats.out_of_order_dropped.inc()
         self._ingested_offset = max(self._ingested_offset, offset)
         self.stats.rows_ingested.inc(n)
+        if last_ts > 0:
+            import time as _time
+            self.stats.ingestion_clock_delay.set(
+                int(_time.time() * 1000) - last_ts)
         return n
 
     @property
@@ -393,6 +480,7 @@ class TimeSeriesShard:
         if ingestion_time is None:
             ingestion_time = int(_time.time() * 1000)
         written = 0
+        t_flush0 = _time.perf_counter()
         dirty_pks: list[PartKeyRecord] = []
         # Capture the checkpoint offset BEFORE snapshotting any buffers:
         # rows at or below this offset are guaranteed to be in the buffers
@@ -409,11 +497,22 @@ class TimeSeriesShard:
             with self.write_lock:
                 chunks = part.make_flush_chunks()
             if chunks:
-                self.column_store.write_chunks(
-                    self.dataset, self.shard_num, part.part_key, chunks,
-                    ingestion_time)
+                try:
+                    self.column_store.write_chunks(
+                        self.dataset, self.shard_num, part.part_key, chunks,
+                        ingestion_time)
+                except Exception:
+                    self.stats.flushes_failed.inc()
+                    raise
                 part.mark_flushed(max(c.id for c in chunks))
                 written += len(chunks)
+                st = self.stats
+                st.samples_encoded.inc(sum(c.num_rows for c in chunks))
+                st.encoded_bytes.inc(sum(c.nbytes for c in chunks))
+                from filodb_tpu.memory.codecs import CODEC_HIST_2D_DELTA
+                st.encoded_hist_bytes.inc(sum(
+                    len(v) for c in chunks for v in c.vectors
+                    if v and v[0] == CODEC_HIST_2D_DELTA))
                 if self.downsampler is not None:
                     self.downsampler.on_flush(part, chunks)
             if part.part_id in self._dirty_part_keys:
@@ -424,6 +523,7 @@ class TimeSeriesShard:
         if dirty_pks:
             self.column_store.write_part_keys(self.dataset, self.shard_num,
                                               dirty_pks)
+            self.stats.dirty_keys_flushed.inc(len(dirty_pks))
         # checkpoint: everything at or below this offset for this group is safe
         self.meta_store.write_checkpoint(self.dataset, self.shard_num, group,
                                          checkpoint_offset)
@@ -434,6 +534,10 @@ class TimeSeriesShard:
                                             self.group_watermarks[group])
         self.stats.chunks_flushed.inc(written)
         self.stats.flushes_done.inc()
+        self.stats.flush_latency.observe(_time.perf_counter() - t_flush0)
+        self.stats.offset_latest_in_mem.set(self._ingested_offset)
+        self.stats.offset_flushed_latest.set(max(self.group_watermarks))
+        self.stats.offset_flushed_earliest.set(min(self.group_watermarks))
         return written
 
     def flush_all(self, ingestion_time: int | None = None) -> int:
@@ -483,6 +587,15 @@ class TimeSeriesShard:
         persisted chunk timestamp so WAL replay of rows that were flushed
         just before the crash (ingested mid-flush, above the checkpoint) is
         deduplicated instead of double-written."""
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return self._recover_index_inner()
+        finally:
+            self.stats.recovery_time_ms.set(
+                (_time.perf_counter() - t0) * 1000.0)
+
+    def _recover_index_inner(self) -> int:
         if not self.partitions:
             snap = self.column_store.read_index_snapshot(self.dataset,
                                                          self.shard_num)
@@ -505,6 +618,7 @@ class TimeSeriesShard:
             self.index.update_end_time(part.part_id, rec.end_time)
             self._dirty_part_keys.discard(part.part_id)
             n += 1
+        self.stats.index_recovery_partkeys.inc(n)
         return n
 
     def _reset_registry(self) -> None:
@@ -578,8 +692,10 @@ class TimeSeriesShard:
     def purge_expired(self, now_ms: int) -> int:
         """Drop partitions whose data is entirely past retention
         (reference TTL purge ``TimeSeriesShard.scala:838``)."""
+        import time as _time
         cutoff = now_ms - self.config.retention_ms
         purged = 0
+        t0 = _time.perf_counter()
         with self.write_lock:
             for pid, part in enumerate(self.partitions):
                 if part is None:
@@ -604,7 +720,11 @@ class TimeSeriesShard:
                     purged += 1
         if purged:
             self.stats.partitions_purged.inc(purged)
+            self.stats.partitions_purged_index.inc(purged)
+            self.stats.purge_time_ms.inc(
+                int((_time.perf_counter() - t0) * 1000))
             self.stats.num_partitions.set(len(self.index))
+            self.stats.timeseries_count.set(len(self.index))
         return purged
 
     def evict_partition_chunks(self, part_id: int) -> int:
@@ -612,7 +732,9 @@ class TimeSeriesShard:
         partition + index entry; reads fall back to ODP (reference
         ``TimeSeriesShard`` eviction ``:1611``)."""
         part = self.partitions[part_id]
-        return part.evict_flushed_chunks() if part else 0
+        n = part.evict_flushed_chunks() if part else 0
+        self.stats.chunkids_evicted.inc(n)
+        return n
 
     def evict_partition(self, part_id: int) -> bool:
         """Fully evict one partition under memory pressure (reference
@@ -627,7 +749,7 @@ class TimeSeriesShard:
         part = self.partitions[part_id]
         if part is None:
             return False
-        part.evict_flushed_chunks()
+        self.stats.chunkids_evicted.inc(part.evict_flushed_chunks())
         if part.has_unpersisted_data():
             return False  # unpersisted data remains; not evictable
         key = part.part_key
@@ -703,11 +825,13 @@ class TimeSeriesShard:
         memory fits the shard budget (reference eviction under memory
         pressure with time-ordered reclaim, ``BlockManager`` "time-ordered"
         lists). Returns chunks evicted."""
+        import time as _time
         budget = budget_bytes if budget_bytes is not None \
             else self.config.shard_mem_mb * 1024 * 1024
         used = self.chunk_bytes()
         if used <= budget:
             return 0
+        t0 = _time.perf_counter()
         evicted = 0
         parts = sorted((p for p in self.partitions if p is not None),
                        key=lambda p: p.latest_ts)
@@ -726,6 +850,8 @@ class TimeSeriesShard:
             # paged shells + ODP)
             headroom = max(len(self.index) // 20, 64)
             self.evict_cold_partitions(headroom)
+        self.stats.eviction_stall_ns.inc(
+            int((_time.perf_counter() - t0) * 1e9))
         return evicted
 
     def mark_part_ended(self, part_id: int, end_time: int) -> None:
@@ -735,7 +861,12 @@ class TimeSeriesShard:
     # ---- query support ---------------------------------------------------
 
     def lookup_partitions(self, filters, start: int, end: int) -> list[int]:
-        return self.index.part_ids_from_filters(filters, start, end)
+        ids = self.index.part_ids_from_filters(filters, start, end)
+        self.stats.partitions_queried.inc(len(ids))
+        if end > start and end < INGESTING:
+            self.stats.query_time_range_minutes.observe(
+                (end - start) / 60_000.0)
+        return ids
 
     def label_values(self, label: str, filters=None,
                      start: int = 0, end: int = INGESTING) -> list[str]:

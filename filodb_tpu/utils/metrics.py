@@ -47,6 +47,23 @@ class Gauge(Metric):
         self.value = v
 
 
+class GaugeFn(Metric):
+    """Gauge whose value is computed at scrape time from a callback —
+    used for state that lives elsewhere (index sizes, pool sizes, arena
+    stats) so scrapes never go stale and no update path is needed."""
+
+    def __init__(self, name: str, fn, tags: dict[str, str] | None = None):
+        super().__init__(name, tags)
+        self.fn = fn
+
+    @property
+    def value(self) -> float:
+        try:
+            return float(self.fn())
+        except Exception:
+            return float("nan")
+
+
 class Histogram(Metric):
     """Fixed-boundary latency histogram (seconds)."""
 
@@ -92,12 +109,14 @@ def render_prometheus() -> str:
         tagstr = f"{{{tagstr}}}" if tagstr else ""
         if isinstance(m, Counter):
             lines.append(f"{m.name}_total{tagstr} {m.value}")
-        elif isinstance(m, Gauge):
+        elif isinstance(m, (Gauge, GaugeFn)):
             lines.append(f"{m.name}{tagstr} {m.value}")
         elif isinstance(m, Histogram):
             for b in Histogram.BOUNDS:
                 t = tagstr[:-1] + f',le="{b}"}}' if tagstr else f'{{le="{b}"}}'
                 lines.append(f"{m.name}_bucket{t} {m.buckets.get(b, 0)}")
+            t = tagstr[:-1] + ',le="+Inf"}' if tagstr else '{le="+Inf"}'
+            lines.append(f"{m.name}_bucket{t} {m.count}")
             lines.append(f"{m.name}_count{tagstr} {m.count}")
             lines.append(f"{m.name}_sum{tagstr} {m.sum}")
     return "\n".join(lines) + "\n"
