@@ -97,6 +97,8 @@ class ShardDownsampler:
                 c = RecordContainer()
                 for r in recs:
                     c.add(r)
+                self.records_created = getattr(
+                    self, "records_created", 0) + len(recs)
                 self.publish(res, c)
 
 

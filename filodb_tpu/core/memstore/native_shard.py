@@ -173,7 +173,7 @@ class NativeBackedPartition:
     access. All mutation goes through the core.
     """
 
-    __slots__ = ("part_id", "max_chunk_size", "shard", "bucket_les",
+    __slots__ = ("part_id", "max_chunk_size", "shard",
                  "device_pages", "_core", "_lib", "_chunks_cache",
                  "_chunks_ver", "_part_key", "_schema", "_key_blob",
                  "_schemas")
@@ -195,10 +195,23 @@ class NativeBackedPartition:
         self._schemas = schemas
         self.max_chunk_size = max_chunk_size
         self.shard = shard
-        self.bucket_les = None
         self.device_pages = False
         self._chunks_cache: list[Chunk] = []
         self._chunks_ver = -1
+
+    @property
+    def bucket_les(self) -> np.ndarray | None:
+        """Current bucket bounds for the native hist column (None for
+        all-scalar partitions) — the host partition's ``bucket_les``."""
+        with self._core.lock:
+            nb = int(self._lib.part_hist_nb(self._core._core, self.part_id))
+            if nb <= 0:
+                return None
+            out = np.empty(nb, np.float64)
+            self._lib.part_hist_les(
+                self._core._core, self.part_id,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+            return out
 
     @property
     def part_key(self) -> PartKey:
@@ -218,6 +231,23 @@ class NativeBackedPartition:
     # -- ingest (rare path: replay of object containers, tests) --
 
     def ingest(self, ts: int, values: tuple) -> bool:
+        hist_at = next((i for i, v in enumerate(values)
+                        if isinstance(v, tuple)
+                        or (isinstance(v, np.ndarray) and v.ndim)), -1)
+        if hist_at >= 0:
+            les, counts = values[hist_at]
+            les = np.ascontiguousarray(les, np.float64)
+            counts = np.ascontiguousarray(counts, np.int64)
+            dvals = np.array([float(v) if i != hist_at else np.nan
+                              for i, v in enumerate(values)], np.float64)
+            with self._core.lock:
+                return bool(self._lib.part_append_hist(
+                    self._core._core, self.part_id, ts,
+                    dvals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    len(dvals),
+                    les.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(les), hist_at))
         vals = np.asarray(values, np.float64)
         with self._core.lock:
             return bool(self._lib.part_append(
@@ -291,7 +321,18 @@ class NativeBackedPartition:
                     core, pid, n,
                     ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                     cols.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-        return _Buffers(ts, [cols[i] for i in range(ncols)], n)
+            out_cols = [cols[i] for i in range(ncols)]
+            hist_col = int(self._lib.part_hist_col(core, pid))
+            if hist_col >= 0 and n:
+                nb = int(self._lib.part_hist_nb(core, pid))
+                rows = np.zeros((n, max(nb, 1)), np.int64)
+                got = self._lib.part_buf_hist_copy(
+                    core, pid, n,
+                    rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+                out_cols[hist_col] = rows[:got] if got == n else \
+                    np.vstack([rows[:got],
+                               np.zeros((n - got, max(nb, 1)), np.int64)])
+        return _Buffers(ts, out_cols, n)
 
     def switch_buffers(self) -> None:
         with self._core.lock:

@@ -316,11 +316,12 @@ class TimeSeriesPartition:
     def _buffer_chunk(self) -> Chunk:
         b = self._buf
         cols = []
+        bles = self.bucket_les
         for col, data in zip(self.schema.data.columns[1:], b.cols):
             if col.ctype == ColumnType.HISTOGRAM:
                 rows = data[: b.n] if data is not None else np.zeros((b.n, 0), np.int64)
                 cols.append(HistogramColumn(
-                    self.bucket_les if self.bucket_les is not None
+                    bles if bles is not None
                     else np.zeros(rows.shape[1]), rows))
             else:
                 cols.append(data[: b.n])
@@ -387,8 +388,8 @@ class TimeSeriesPartition:
                 data = b.cols[col - 1]
                 colspec = self.schema.data.columns[col]
                 if colspec.ctype == ColumnType.HISTOGRAM:
-                    les = (self.bucket_les if self.bucket_les is not None
-                           else les)
+                    bles = self.bucket_les
+                    les = bles if bles is not None else les
                     rows = (data[:n] if data is not None
                             else np.zeros((n, 0), np.int64))
                     val_parts.append(rows[mask].copy())

@@ -78,7 +78,6 @@ class ShardStats:
         self.partitions_restored = C("memstore_partitions_paged_restored")
         self.eviction_stall_ns = C("memstore_eviction_stall_ns")
         self.num_partitions = G("num_partitions")
-        self.timeseries_count = G("memstore_timeseries_count")
         # encode / flush
         self.samples_encoded = C("memstore_samples_encoded")
         self.encoded_bytes = C("memstore_encoded_bytes_allocated")
@@ -99,7 +98,10 @@ class ShardStats:
             "memstore_index_recovery_partkeys_processed")
         # query
         self.partitions_queried = C("memstore_partitions_queried")
-        self.query_time_range_minutes = H("query_time_range_minutes")
+        self.query_time_range_minutes = Histogram(
+            "query_time_range_minutes", tags,
+            bounds=(5.0, 15.0, 60.0, 180.0, 360.0, 720.0, 1440.0,
+                    4320.0, 10080.0, 43200.0, 129600.0, 525600.0))
         # on-demand paging
         self.chunks_paged_in = C("chunks_paged_in")
         self.partitions_paged_in = C("memstore_partitions_paged_in")
@@ -121,6 +123,8 @@ class ShardStats:
             return call
 
         GaugeFn("memstore_index_entries", fn(lambda s: len(s.index)),
+                self.tags)
+        GaugeFn("memstore_timeseries_count", fn(lambda s: len(s.index)),
                 self.tags)
         GaugeFn("memstore_index_ram_bytes",
                 fn(lambda s: s.index.ram_bytes), self.tags)
@@ -191,6 +195,7 @@ class TimeSeriesShard:
         self._native_core = None
         self._nat_skipped_seen = 0
         self._nat_ooo_seen = 0
+        self._nat_incompat_seen = 0
         # pids of host-backed (non-native) partitions, e.g. histograms —
         # lets shard-wide accounting avoid walking every lazy partition
         self._host_pids: set[int] = set()
@@ -353,6 +358,10 @@ class TimeSeriesShard:
     # ---- ingest ----------------------------------------------------------
 
     def ingest(self, data: SomeData) -> int:
+        with self.stats.ingestion_pipeline_latency.time():
+            return self._ingest_timed(data)
+
+    def _ingest_timed(self, data: SomeData) -> int:
         """Ingest one container at an offset. Returns rows ingested."""
         if self.config.assert_single_writer:
             # single-writer-per-shard discipline tripwire (reference
@@ -371,8 +380,13 @@ class TimeSeriesShard:
 
     def _native_eligible(self, schema) -> bool:
         from filodb_tpu.core.schemas import ColumnType
-        return all(c.ctype == ColumnType.DOUBLE
-                   for c in schema.data.columns[1:])
+        n_hist = 0
+        for c in schema.data.columns[1:]:
+            if c.ctype == ColumnType.HISTOGRAM:
+                n_hist += 1
+            elif c.ctype != ColumnType.DOUBLE:
+                return False
+        return n_hist <= 1  # native lane covers doubles + one hist column
 
     def _drain_native_parts(self) -> None:
         """Register partitions the C++ core created during ingest: index,
@@ -428,6 +442,11 @@ class TimeSeriesShard:
         if ooo != self._nat_ooo_seen:
             self.stats.out_of_order_dropped.inc(ooo - self._nat_ooo_seen)
             self._nat_ooo_seen = ooo
+        incompat = core.stat(5)
+        if incompat != self._nat_incompat_seen:
+            self.stats.incompatible_containers.inc(
+                incompat - self._nat_incompat_seen)
+            self._nat_incompat_seen = incompat
         self._ingested_offset = max(self._ingested_offset, offset)
         self.stats.rows_ingested.inc(n)
         return n
@@ -453,6 +472,9 @@ class TimeSeriesShard:
                                                     rec.timestamp)
             except QuotaExceededError:
                 self.stats.quota_dropped.inc()
+                continue
+            except KeyError:
+                self.stats.unknown_schema_dropped.inc()
                 continue
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
@@ -514,7 +536,10 @@ class TimeSeriesShard:
                     len(v) for c in chunks for v in c.vectors
                     if v and v[0] == CODEC_HIST_2D_DELTA))
                 if self.downsampler is not None:
+                    before = getattr(self.downsampler, "records_created", 0)
                     self.downsampler.on_flush(part, chunks)
+                    after = getattr(self.downsampler, "records_created", 0)
+                    st.downsample_records_created.inc(after - before)
             if part.part_id in self._dirty_part_keys:
                 dirty_pks.append(PartKeyRecord(
                     part.part_key, self.index.start_time(part.part_id),
@@ -572,6 +597,10 @@ class TimeSeriesShard:
                 self.group_watermarks[g] = off
                 if self._native_core is not None:
                     self._native_core.set_watermark(g, off)
+        missing = self.config.groups_per_shard - len(
+            [g for g in cps if g < len(self.group_watermarks)])
+        if cps and missing > 0:
+            self.stats.offsets_not_recovered.inc(missing)
         return min(cps.values()) if cps else -1
 
     def recover_index(self) -> int:
@@ -724,7 +753,6 @@ class TimeSeriesShard:
             self.stats.purge_time_ms.inc(
                 int((_time.perf_counter() - t0) * 1000))
             self.stats.num_partitions.set(len(self.index))
-            self.stats.timeseries_count.set(len(self.index))
         return purged
 
     def evict_partition_chunks(self, part_id: int) -> int:

@@ -124,6 +124,16 @@ def _load():
         lib.shard_core_part_hash.restype = ctypes.c_uint32
         lib.part_append.argtypes = [vp, i32, i64, f64p, i32]
         lib.part_append.restype = i64
+        lib.part_append_hist.argtypes = [vp, i32, i64, f64p, i32, f64p,
+                                         i64p, i32, i32]
+        lib.part_append_hist.restype = i64
+        lib.part_hist_col.argtypes = [vp, i32]
+        lib.part_hist_col.restype = i32
+        lib.part_hist_nb.argtypes = [vp, i32]
+        lib.part_hist_nb.restype = i32
+        lib.part_hist_les.argtypes = [vp, i32, f64p]
+        lib.part_buf_hist_copy.argtypes = [vp, i32, i32, i64p]
+        lib.part_buf_hist_copy.restype = i32
         for fn in ("part_latest_ts", "part_first_ts", "part_earliest_ts",
                    "part_num_samples", "part_version", "part_flushed_id",
                    "part_chunk_bytes"):

@@ -175,6 +175,23 @@ class MeshQueryEngine:
                 return None
             return _replace_post(inner, ("instant", plan.function,
                                          tuple(plan.args)))
+        if isinstance(plan, lp.ApplyInstantFunction) \
+                and plan.function == "histogram_quantile":
+            # histogram_quantile(φ, sum(rate(hist[5m])) by (...)) runs
+            # fully on the mesh: bucket-rate partials are associative, so
+            # buckets flatten into the series axis (see
+            # execute_lowered_many) and the quantile is a tiny [G, K, B]
+            # post-transform (reference first-class-histogram query path,
+            # ``HistogramQuantileMapper`` + README.md:437 claim)
+            args = [a.value if isinstance(a, lp.ScalarFixedDoublePlan)
+                    else a for a in plan.args]
+            if len(args) != 1 or not isinstance(args[0], (int, float)):
+                return None
+            inner = self._lower(plan.vector)
+            if inner is None:
+                return None
+            return _replace_post(inner, ("instant", "histogram_quantile",
+                                         (float(args[0]),)))
         if isinstance(plan, lp.ScalarVectorBinaryOperation):
             sc = plan.scalar
             if isinstance(sc, lp.ScalarFixedDoublePlan):
@@ -300,8 +317,12 @@ class MeshQueryEngine:
         # reset correction; "rebased" is shift-only, for delta on gauges)
         lane = ("corrected" if fn in ("rate", "increase")
                 else "rebased" if fn == "delta" else "raw")
+        # the agg NAME is part of the key (not just agg-vs-none): a
+        # histogram batch cached under sum must not satisfy a later
+        # min/max/avg over the same selector — those fall back to the
+        # exec path, and the cache-hit branch must re-make that decision
         ckey = (dataset, str(low0.filters), chunk_start, chunk_end,
-                low0.by, low0.without, low0.agg is None, lane)
+                low0.by, low0.without, low0.agg, lane)
         cached = self._batch_cache.get(ckey)
         if cached is not None and cached[0] == version:
             _, batch, keys, gids, out_keys, placed = cached
@@ -309,6 +330,8 @@ class MeshQueryEngine:
                 return [StepMatrix.empty(steps_array(lo.start, lo.step,
                                                      lo.end))
                         for lo in lows]
+            if batch.is_histogram and low0.agg not in (None, "sum"):
+                return [None] * len(lows)
             for st in stats_objs:
                 st.series_scanned += len(keys)
                 st.samples_scanned += int(batch.counts.sum())
@@ -344,8 +367,9 @@ class MeshQueryEngine:
                         for lo in lows]
             batch = build_batch(parts, chunk_start, chunk_end,
                                 extra_by_obj=extra_by_obj or None)
-            if batch.is_histogram:
-                return [None] * len(lows)  # hist stays on the exec path
+            if batch.is_histogram and low0.agg not in (None, "sum"):
+                # bucket-wise semantics only defined for sum (and raw)
+                return [None] * len(lows)
             for st in stats_objs:
                 st.series_scanned += len(parts)
                 st.samples_scanned += int(batch.counts.sum())
@@ -364,8 +388,14 @@ class MeshQueryEngine:
                 for i, gk in enumerate(gkeys):
                     gids[i] = uniq.setdefault(gk, len(uniq))
                 out_keys = list(uniq.keys())
+        # histogram batches flatten buckets into the series axis: every
+        # (series, bucket) pair becomes one scalar row, group ids become
+        # g*B + b, and the same associative kernels/combines apply. The
+        # output un-flattens to [rows, K, B].
+        B = batch.vals.shape[2] \
+            if (batch is not None and batch.is_histogram) else 1
         G = len(out_keys)
-        Gp = _pow2(max(G, 1))
+        Gp = _pow2(max(G * B, 1))
 
         # per-plan step grids, each padded to a power of two for compile
         # reuse (window evaluations are independent per step — batching
@@ -394,8 +424,20 @@ class MeshQueryEngine:
                     # rate/increase also need the raw values for the
                     # extrapolate-to-zero clamp (heuristic-only reference)
                     raw_vals = batch.vals
+            bt_ts, bt_counts = batch.ts, batch.counts
+            if B > 1:
+                Pp_, S_ = bt_ts.shape
+                mesh_vals = np.ascontiguousarray(
+                    mesh_vals.transpose(0, 2, 1)).reshape(Pp_ * B, S_)
+                if raw_vals is not None:
+                    raw_vals = np.ascontiguousarray(
+                        raw_vals.transpose(0, 2, 1)).reshape(Pp_ * B, S_)
+                bt_ts = np.repeat(bt_ts, B, axis=0)
+                bt_counts = np.repeat(bt_counts, B)
+                gids_full = (gids_full[:, None] * B + np.arange(
+                    B, dtype=np.int32)[None, :]).reshape(-1)
             ts_p, vals_p, valid, gid_p = pad_for_mesh(
-                batch.ts, mesh_vals, batch.counts, gids_full, mesh)
+                bt_ts, mesh_vals, bt_counts, gids_full, mesh)
             raw_p = None
             if raw_vals is not None:
                 raw_p = np.zeros(vals_p.shape, vals_p.dtype)
@@ -443,7 +485,7 @@ class MeshQueryEngine:
         for i, (Kp, _, _) in enumerate(spans):
             by_kp.setdefault(Kp, []).append(i)
         results: list = [None] * len(lows)
-        nrows = G if agg else len(keys)
+        nrows = (G if agg else len(keys)) * B
         # phase 1: dispatch every chunk's device program (async — results
         # stay lazy on device so compute overlaps across chunks)
         calls: list[tuple] = []
@@ -492,12 +534,16 @@ class MeshQueryEngine:
                 lo = lows[i]
                 _, K, steps_ms = spans[i]
                 vals = out_np[:nrows, j * Kp : j * Kp + K]
+                if B > 1:  # un-flatten buckets: [n*B, K] -> [n, K, B]
+                    vals = np.ascontiguousarray(
+                        vals.reshape(-1, B, K).transpose(0, 2, 1))
                 if agg is None:
                     rkeys = keys if lo.keep_metric \
                         else [k.drop_metric() for k in keys]
                 else:
                     rkeys = out_keys
-                m = StepMatrix(list(rkeys), vals, steps_ms)
+                m = StepMatrix(list(rkeys), vals, steps_ms,
+                               batch.les if B > 1 else None)
                 results[i] = self._apply_post(m, lo)
         return results
 

@@ -97,8 +97,12 @@ class SeriesBatch:
             v = v + np.cumsum(np.where(dropped, prev, 0.0), axis=1)
         # samples are packed contiguously from 0, so the first in-range
         # value is column 0 (corrected first == raw first: no prior reset)
-        base = np.where(self.counts > 0, v[:, 0], 0.0)
-        rebased = np.where(valid, v - base[:, None], np.nan)
+        if v.ndim == 3:  # histogram: per-(series, bucket) rebase
+            base = np.where(self.counts[:, None] > 0, v[:, 0], 0.0)
+            rebased = np.where(valid, v - base[:, None, :], np.nan)
+        else:
+            base = np.where(self.counts > 0, v[:, 0], 0.0)
+            rebased = np.where(valid, v - base[:, None], np.nan)
         cache[counter] = rebased
         return rebased
 

@@ -350,20 +350,27 @@ class InstantVectorFunctionMapper(RangeVectorTransformer):
         if not groups:
             return StepMatrix([], np.zeros((0, data.num_steps)),
                               data.steps_ms)
-        out_keys = []
-        outs = []
+        # groups sharing one bucket scheme evaluate as ONE batched
+        # [G, K, B] quantile call (per-group device calls previously cost
+        # ~90% of flat-histogram query time at fleet scale)
+        by_les: dict[tuple, list] = {}
         for gk, buckets in groups.items():
             buckets.sort()
-            les = np.array([b[0] for b in buckets])
-            idx = [b[1] for b in buckets]
-            h = data.values[idx]  # [B, K]
+            by_les.setdefault(tuple(b[0] for b in buckets),
+                              []).append((gk, [b[1] for b in buckets]))
+        out_keys = []
+        outs = []
+        for les_t, members in by_les.items():
+            les = np.array(les_t)
+            h = data.values[np.array([idx for _, idx in members])]  # [G,B,K]
             # make cumulative counts monotonic across buckets (prom tolerates
             # slight non-monotonicity from scrapes)
-            h = np.maximum.accumulate(np.nan_to_num(h, nan=0.0), axis=0)
+            h = np.maximum.accumulate(np.nan_to_num(h, nan=0.0), axis=1)
             res = np.asarray(histogram_quantile(
-                q, jnp.asarray(h.T[None]), jnp.asarray(les)))[0]  # [K]
-            out_keys.append(gk)
-            outs.append(res)
+                q, jnp.asarray(h.transpose(0, 2, 1)),
+                jnp.asarray(les)))  # [G, K]
+            out_keys.extend(gk for gk, _ in members)
+            outs.extend(res)
         return StepMatrix(out_keys, np.stack(outs), data.steps_ms)
 
 

@@ -65,13 +65,16 @@ class GaugeFn(Metric):
 
 
 class Histogram(Metric):
-    """Fixed-boundary latency histogram (seconds)."""
+    """Fixed-boundary histogram; default bounds suit latency seconds,
+    pass ``bounds`` for other units (e.g. query ranges in minutes)."""
 
     BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
               1.0, 2.5, 5.0, 10.0)
 
-    def __init__(self, name: str, tags: dict[str, str] | None = None):
+    def __init__(self, name: str, tags: dict[str, str] | None = None,
+                 bounds: tuple | None = None):
         super().__init__(name, tags)
+        self.bounds = tuple(bounds) if bounds is not None else self.BOUNDS
         self.buckets = defaultdict(int)
         self.count = 0
         self.sum = 0.0
@@ -79,7 +82,7 @@ class Histogram(Metric):
     def observe(self, v: float) -> None:
         self.count += 1
         self.sum += v
-        for b in self.BOUNDS:
+        for b in self.bounds:
             if v <= b:
                 self.buckets[b] += 1
 
@@ -112,7 +115,7 @@ def render_prometheus() -> str:
         elif isinstance(m, (Gauge, GaugeFn)):
             lines.append(f"{m.name}{tagstr} {m.value}")
         elif isinstance(m, Histogram):
-            for b in Histogram.BOUNDS:
+            for b in m.bounds:
                 t = tagstr[:-1] + f',le="{b}"}}' if tagstr else f'{{le="{b}"}}'
                 lines.append(f"{m.name}_bucket{t} {m.buckets.get(b, 0)}")
             t = tagstr[:-1] + ',le="+Inf"}' if tagstr else '{le="+Inf"}'

@@ -377,6 +377,14 @@ struct NPart {
     std::vector<int64_t> ts;
     std::vector<std::vector<double>> cols;
     std::vector<NSealed> sealed;
+    // first-class histogram column (at most one per schema, like the
+    // reference's prom-histogram): bucket-count rows kept row-major; the
+    // matching cols[] slot carries NaN placeholders so every shape
+    // invariant (lockstep growth, buf copy) holds unchanged
+    int32_t hist_col = -1;
+    int32_t hist_nb = 0;
+    std::vector<double> hist_les;
+    std::vector<int64_t> hist_rows;  // ts.size() x hist_nb, row-major
 
     int64_t latest() const {
         int64_t t = floor_ts;
@@ -397,6 +405,7 @@ struct ShardCore {
     std::deque<NPart> parts;  // stable references; index == pid
     std::vector<int32_t> new_parts;
     int64_t rows_skipped = 0, rows_ooo = 0, rows_ingested = 0;
+    int64_t rows_incompat = 0;  // value shape mismatched the partition
     // encode scratch (single-writer per shard)
     std::vector<int64_t> resid;
     std::vector<uint64_t> words;
@@ -457,6 +466,38 @@ void encode_xor(ShardCore* c, const double* v, int64_t n, std::string& out) {
     out.append((char*)c->packed.data(), m);
 }
 
+// Hist-2D-delta codec, byte-identical to codecs.encode_hist_2d_delta:
+// u8 codec=4 | u32 n | u32 nb | f64*nb les | nibble_pack(zigzag(
+//   delta-across-time(delta-across-buckets(rows))))
+void encode_hist2d(ShardCore* c, const NPart& p, int64_t n,
+                   std::string& out) {
+    uint32_t nb = (uint32_t)p.hist_nb;
+    uint8_t head[9];
+    head[0] = 4;
+    uint32_t n32 = (uint32_t)n;
+    std::memcpy(head + 1, &n32, 4);
+    std::memcpy(head + 5, &nb, 4);
+    out.assign((char*)head, 9);
+    out.append((const char*)p.hist_les.data(), (size_t)nb * 8);
+    int64_t total = n * (int64_t)nb;
+    if (!total) return;
+    c->resid.resize(total);
+    const int64_t* r = p.hist_rows.data();
+    for (int64_t i = 0; i < n; i++) {
+        for (int64_t j = 0; j < (int64_t)nb; j++) {
+            int64_t bd = r[i * nb + j] - (j ? r[i * nb + j - 1] : 0);
+            int64_t pbd = i ? (r[(i - 1) * nb + j]
+                               - (j ? r[(i - 1) * nb + j - 1] : 0)) : 0;
+            c->resid[i * nb + j] = bd - pbd;
+        }
+    }
+    c->words.resize(total);
+    zigzag_encode_i64(c->resid.data(), c->words.data(), total);
+    c->packed.resize(16 + total * 9 + 64);
+    int64_t m = nibble_pack(c->words.data(), total, c->packed.data());
+    out.append((char*)c->packed.data(), m);
+}
+
 void seal_part(ShardCore* c, NPart& p) {
     int64_t n = (int64_t)p.ts.size();
     if (!n) return;
@@ -468,12 +509,17 @@ void seal_part(ShardCore* c, NPart& p) {
     p.seq = (p.seq + 1) & 0xFFF;
     encode_dd(c, p.ts.data(), n, s.ts_bytes);
     s.col_bytes.resize(p.cols.size());
-    for (size_t i = 0; i < p.cols.size(); i++)
-        encode_xor(c, p.cols[i].data(), n, s.col_bytes[i]);
+    for (size_t i = 0; i < p.cols.size(); i++) {
+        if ((int32_t)i == p.hist_col)
+            encode_hist2d(c, p, n, s.col_bytes[i]);
+        else
+            encode_xor(c, p.cols[i].data(), n, s.col_bytes[i]);
+    }
     p.samples_sealed += n;
     p.sealed.push_back(std::move(s));
     p.ts.clear();
     for (auto& col : p.cols) col.clear();
+    p.hist_rows.clear();
     p.version++;
 }
 
@@ -495,8 +541,10 @@ void shard_core_set_watermark(void* cp, int32_t group, int64_t off) {
 }
 
 // Parse + ingest one binary RecordContainer (format: core/record.py v2).
-// Returns rows ingested, or -1 if any record has a non-scalar value shape
-// (histograms/strings): the container is then NOT ingested at all and the
+// Value shapes covered: scalar f64 (tag 0) and first-class histogram
+// les+counts (tag 1, at most one per record — reference multi-schema
+// ingest, TimeSeriesShard.scala:570). Returns rows ingested, or -1 on a
+// malformed/uncovered container: it is then NOT ingested at all and the
 // caller takes the host fallback path. All-or-nothing via a validate pass.
 int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
                           int64_t offset) {
@@ -524,9 +572,23 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
         uint8_t nv = d[o];
         o += 1;
         if (nv == 0) return -1;
+        int hists = 0;
         for (uint8_t j = 0; j < nv; j++) {
-            if (o + 9 > end || d[o] != 0) return -1;  // scalar f64 only
-            o += 9;
+            if (o + 1 > end) return -1;
+            uint8_t tag = d[o];
+            if (tag == 0) {
+                if (o + 9 > end) return -1;
+                o += 9;
+            } else if (tag == 1) {
+                if (o + 3 > end) return -1;
+                uint16_t nb = rd_u16(d + o + 1);
+                if (nb == 0 || nb > 4096) return -1;
+                if (o + 3 + (int64_t)nb * 16 > end) return -1;
+                o += 3 + (int64_t)nb * 16;
+                if (++hists > 1) return -1;  // one hist column per record
+            } else {
+                return -1;  // strings/other shapes take the host path
+            }
         }
         if (o != end) return -1;
         off = end;
@@ -551,6 +613,26 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
         int64_t key_len = o - key_off;
         uint8_t nv = d[o];
         o += 1;
+        // per-value layout walk (validated in pass 1)
+        int64_t voff[256];
+        uint8_t vtag[256];
+        uint16_t vnb[256];
+        int32_t rec_hist = -1;
+        {
+            int64_t vo = o;
+            for (uint16_t j = 0; j < nv; j++) {
+                vtag[j] = d[vo];
+                voff[j] = vo;
+                if (d[vo] == 0) {
+                    vnb[j] = 0;
+                    vo += 9;
+                } else {
+                    vnb[j] = rd_u16(d + vo + 1);
+                    rec_hist = (int32_t)j;
+                    vo += 3 + (int64_t)vnb[j] * 16;
+                }
+            }
+        }
         int32_t group = (int32_t)(hash % (uint32_t)c->groups);
         if (offset <= c->watermarks[group]) {
             c->rows_skipped++;
@@ -569,15 +651,53 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
             p->cols.resize(nv);
             p->ts.reserve(8);
             for (auto& col : p->cols) col.reserve(8);
+            if (rec_hist >= 0) {
+                p->hist_col = rec_hist;
+                p->hist_nb = vnb[rec_hist];
+                p->hist_les.resize(p->hist_nb);
+                std::memcpy(p->hist_les.data(), d + voff[rec_hist] + 3,
+                            (size_t)p->hist_nb * 8);
+            }
             c->by_key.emplace(p->key, pid);
             c->new_parts.push_back(pid);
         } else {
             p = &c->parts[it->second];
         }
+        // a record whose hist position disagrees with the partition's
+        // shape cannot append without desyncing columns — drop it. An
+        // EMPTY partition (pre-created via shard_core_create_part or a
+        // snapshot bootstrap, which don't know value shapes) adopts the
+        // first record's shape instead.
+        if (rec_hist != p->hist_col) {
+            if (rec_hist >= 0 && p->hist_col < 0 && p->ts.empty()
+                    && p->sealed.empty()) {
+                p->hist_col = rec_hist;
+                p->hist_nb = vnb[rec_hist];
+                p->hist_les.resize(p->hist_nb);
+                std::memcpy(p->hist_les.data(), d + voff[rec_hist] + 3,
+                            (size_t)p->hist_nb * 8);
+            } else {
+                c->rows_incompat++;
+                off = end;
+                continue;
+            }
+        }
         if (ts <= p->latest()) {
             c->rows_ooo++;
             off = end;
             continue;
+        }
+        if (p->hist_col >= 0) {
+            uint16_t nb = vnb[p->hist_col];
+            if ((int32_t)nb != p->hist_nb) {
+                // bucket-scheme change forces a chunk switch (mirrors
+                // TimeSeriesPartition.ingest host semantics)
+                if (!p->ts.empty()) seal_part(c, *p);
+                p->hist_nb = nb;
+                p->hist_les.resize(nb);
+            }
+            std::memcpy(p->hist_les.data(), d + voff[p->hist_col] + 3,
+                        (size_t)nb * 8);
         }
         if (p->first_ts < 0) p->first_ts = ts;
         p->ts.push_back(ts);
@@ -588,8 +708,17 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
         // with NaN; extra values are dropped.
         for (size_t j = 0; j < p->cols.size(); j++) {
             double x = std::numeric_limits<double>::quiet_NaN();
-            if (j < (size_t)nv) std::memcpy(&x, d + o + 1 + j * 9, 8);
+            if (j < (size_t)nv && vtag[j] == 0)
+                std::memcpy(&x, d + voff[j] + 1, 8);
             p->cols[j].push_back(x);
+        }
+        if (p->hist_col >= 0) {
+            const uint8_t* counts = d + voff[p->hist_col] + 3
+                + (int64_t)p->hist_nb * 8;
+            size_t base = p->hist_rows.size();
+            p->hist_rows.resize(base + p->hist_nb);
+            std::memcpy(p->hist_rows.data() + base, counts,
+                        (size_t)p->hist_nb * 8);
         }
         if ((int32_t)p->ts.size() >= c->max_chunk) seal_part(c, *p);
         ingested++;
@@ -607,6 +736,7 @@ int64_t shard_core_stat(void* cp, int32_t which) {
         case 2: return c->rows_ooo;
         case 3: return (int64_t)c->parts.size();
         case 4: return (int64_t)c->new_parts.size();
+        case 5: return c->rows_incompat;
         default: return -1;
     }
 }
@@ -750,6 +880,10 @@ int64_t part_append(void* cp, int32_t pid, int64_t ts, const double* vals,
     ShardCore* c = static_cast<ShardCore*>(cp);
     NPart& p = c->parts[pid];
     if (ts <= p.latest()) return 0;
+    // a histogram partition must take part_append_hist: fabricating an
+    // all-zero cumulative bucket row here would read as a counter reset
+    // and corrupt every later rate()/increase() window
+    if (p.hist_col >= 0) return 0;
     if (p.first_ts < 0) p.first_ts = ts;
     p.ts.push_back(ts);
     for (int32_t j = 0; j < nvals && j < (int32_t)p.cols.size(); j++)
@@ -757,6 +891,64 @@ int64_t part_append(void* cp, int32_t pid, int64_t ts, const double* vals,
     if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, p);
     c->rows_ingested++;
     return 1;
+}
+
+// Host-fallback single append for histogram partitions: ``dvals`` carries
+// every value column in schema order (the entry at the hist column is
+// ignored); les+counts carry the bucket scheme and cumulative counts.
+int64_t part_append_hist(void* cp, int32_t pid, int64_t ts,
+                         const double* dvals, int32_t ndv,
+                         const double* les, const int64_t* counts,
+                         int32_t nb, int32_t hist_col) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    NPart& p = c->parts[pid];
+    if (nb <= 0 || nb > 4096 || hist_col < 0) return 0;
+    if (p.hist_col < 0 && p.ts.empty() && p.sealed.empty()
+            && p.hist_rows.empty()) {
+        p.hist_col = hist_col;  // first sample fixes the hist column
+        p.hist_nb = nb;
+        p.hist_les.assign(les, les + nb);
+    }
+    if (hist_col != p.hist_col) return 0;
+    if (ts <= p.latest()) return 0;
+    if (nb != p.hist_nb) {
+        if (!p.ts.empty()) seal_part(c, p);
+        p.hist_nb = nb;
+        p.hist_les.resize(nb);
+    }
+    p.hist_les.assign(les, les + nb);
+    if (p.first_ts < 0) p.first_ts = ts;
+    p.ts.push_back(ts);
+    for (int32_t j = 0; j < (int32_t)p.cols.size(); j++)
+        p.cols[j].push_back(
+            j < ndv && j != hist_col
+                ? dvals[j] : std::numeric_limits<double>::quiet_NaN());
+    size_t base = p.hist_rows.size();
+    p.hist_rows.resize(base + nb);
+    std::memcpy(p.hist_rows.data() + base, counts, (size_t)nb * 8);
+    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, p);
+    c->rows_ingested++;
+    return 1;
+}
+
+int32_t part_hist_col(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].hist_col;
+}
+int32_t part_hist_nb(void* cp, int32_t pid) {
+    return static_cast<ShardCore*>(cp)->parts[pid].hist_nb;
+}
+void part_hist_les(void* cp, int32_t pid, double* out) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    std::memcpy(out, p.hist_les.data(), p.hist_les.size() * 8);
+}
+// copies up to n buffer rows of bucket counts, row-major [n][nb]
+int32_t part_buf_hist_copy(void* cp, int32_t pid, int32_t n, int64_t* out) {
+    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
+    if (p.hist_nb <= 0) return 0;
+    int32_t have = (int32_t)(p.hist_rows.size() / p.hist_nb);
+    if (n > have) n = have;
+    std::memcpy(out, p.hist_rows.data(), (size_t)n * p.hist_nb * 8);
+    return n;
 }
 
 int64_t part_latest_ts(void* cp, int32_t pid) {

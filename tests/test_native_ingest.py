@@ -96,15 +96,24 @@ class TestNativeParity:
             assert len(all_ts) == len(set(all_ts))
             assert len(set(all_ts)) == 120
 
-    def test_histogram_containers_fall_back(self):
+    def test_histogram_containers_ingest_natively(self):
         hkeys = histogram_series(2)
         stream = list(to_bytes_stream(histogram_stream(hkeys, 30, batch=1)))
         _, shard = build(True, stream)
-        # native lane rejected the containers; host path ingested them
+        # hist containers take the native lane (VERDICT r3 #3a): partitions
+        # are native-backed and read back full histogram columns
         assert shard.stats.rows_ingested.value == 60
-        assert type(shard.partitions[0]).__name__ == "TimeSeriesPartition"
+        assert type(shard.partitions[0]).__name__ == "NativeBackedPartition"
         t, v = shard.partitions[0].read_samples(0, 10**15)
         assert len(t) == 30
+        from filodb_tpu.memory.codecs import HistogramColumn
+        assert isinstance(v, HistogramColumn)
+        assert v.rows.shape[0] == 30 and v.rows.shape[1] == len(v.les)
+        # cumulative bucket counts are monotone non-decreasing per row
+        assert (np.diff(v.rows, axis=1) >= 0).all()
+        # sum/count scalar columns ride the same native records
+        t1, sums = shard.partitions[0].read_samples(0, 10**15, col=1)
+        assert len(t1) == 30 and np.isfinite(sums).all()
 
     def test_mixed_scalar_and_hist_pid_alignment(self):
         gkeys = machine_metrics_series(2)
