@@ -21,6 +21,8 @@ DEFAULTS = {
     "wal_fsync": False,           # fsync every WAL append (power-failure safe)
     "wal_server_port": 0,         # serve this node's WAL over TCP (broker)
     "wal_remote": None,           # "host:port" — use a remote log server
+    "store_server_port": 0,       # serve this node's column store over TCP
+    "store_remote": None,         # "host:port" — use a remote chunk store
     "http_port": 8080,
     "gateway_port": 0,            # 0 = disabled
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
@@ -57,6 +59,8 @@ class ServerConfig:
     wal_fsync: bool = False     # fsync every WAL append (power-failure safe)
     wal_server_port: int = 0    # serve this node's WAL over TCP (broker)
     wal_remote: str | None = None  # "host:port" — use a remote log server
+    store_server_port: int = 0    # serve the column store over TCP
+    store_remote: str | None = None  # "host:port" — remote chunk store
     http_port: int = 8080
     http_reuse_port: bool = False  # SO_REUSEPORT multi-process serving
     http_impl: str = "fast"  # "fast" event loop | "threaded" stdlib server
@@ -98,6 +102,8 @@ class ServerConfig:
             wal_fsync=cfg.get("wal_fsync", False),
             wal_server_port=cfg.get("wal_server_port", 0),
             wal_remote=cfg.get("wal_remote"),
+            store_server_port=cfg.get("store_server_port", 0),
+            store_remote=cfg.get("store_remote"),
             http_port=cfg["http_port"],
             http_reuse_port=cfg.get("http_reuse_port", False),
             http_impl=cfg.get("http_impl", "fast"),

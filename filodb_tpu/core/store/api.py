@@ -47,6 +47,19 @@ class ColumnStore:
     def scan_part_keys(self, dataset: str, shard: int) -> list[PartKeyRecord]:
         raise NotImplementedError
 
+    def scan_part_keys_split(self, dataset: str, shard: int, split: int,
+                             n_splits: int) -> list[PartKeyRecord]:
+        """One token-range split of the part-key scan, for parallel readers
+        (downsampler/repair jobs) — the reference's ``getScanSplits``
+        (``CassandraColumnStore.scala:52``). Default: hash-filter over the
+        full scan; remote impls filter server-side."""
+        from filodb_tpu.core.store.remotestore import split_of
+        from filodb_tpu.core.store.localstore import _pk_blob
+        if n_splits <= 1:
+            return self.scan_part_keys(dataset, shard)
+        return [r for r in self.scan_part_keys(dataset, shard)
+                if split_of(_pk_blob(r.part_key), n_splits) == split]
+
     def scan_chunks_by_ingestion_time(self, dataset: str, shard: int,
                                       start: int, end: int):
         """Yield (part_key, chunks) whose ingestion time falls in [start, end)

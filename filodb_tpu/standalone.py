@@ -34,10 +34,28 @@ class FiloServer:
     def __init__(self, config: ServerConfig):
         self.config = config
         os.makedirs(config.data_dir, exist_ok=True)
-        self.column_store = LocalDiskColumnStore(
-            os.path.join(config.data_dir, "columnstore"))
-        self.meta_store = LocalDiskMetaStore(
-            os.path.join(config.data_dir, "columnstore"))
+        self.store_server = None
+        if config.store_remote:
+            # remote durability tier (reference: CassandraColumnStore role)
+            from filodb_tpu.core.store.remotestore import (
+                RemoteColumnStore,
+                RemoteMetaStore,
+            )
+            host, port = config.store_remote.rsplit(":", 1)
+            self.column_store = RemoteColumnStore(host, int(port))
+            self.meta_store = RemoteMetaStore(host, int(port))
+        else:
+            self.column_store = LocalDiskColumnStore(
+                os.path.join(config.data_dir, "columnstore"))
+            self.meta_store = LocalDiskMetaStore(
+                os.path.join(config.data_dir, "columnstore"))
+            if config.store_server_port:
+                from filodb_tpu.core.store.remotestore import (
+                    ChunkStoreServer,
+                )
+                self.store_server = ChunkStoreServer(
+                    host="0.0.0.0", port=config.store_server_port,
+                    backing=self.column_store, meta=self.meta_store).start()
         self.memstore = TimeSeriesMemStore(self.column_store, self.meta_store)
         self.node = Node(config.node_name, self.memstore)
         self.cluster = FilodbCluster()
@@ -429,6 +447,8 @@ class FiloServer:
             l.close()
         if getattr(self, "log_server", None) is not None:
             self.log_server.stop()  # broker role: port, thread, open logs
+        if self.store_server is not None:
+            self.store_server.shutdown()
         self.column_store.close()
         self.meta_store.close()
 
