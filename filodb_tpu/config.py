@@ -21,6 +21,7 @@ DEFAULTS = {
     "wal_fsync": False,           # fsync every WAL append (power-failure safe)
     "wal_server_port": 0,         # serve this node's WAL over TCP (broker)
     "wal_remote": None,           # "host:port" — use a remote log server
+    "wal_kafka": None,            # "host:port" — external Kafka broker WAL
     "store_server_port": 0,       # serve this node's column store over TCP
     "store_remote": None,         # "host:port" — use a remote chunk store
     "http_port": 8080,
@@ -59,6 +60,7 @@ class ServerConfig:
     wal_fsync: bool = False     # fsync every WAL append (power-failure safe)
     wal_server_port: int = 0    # serve this node's WAL over TCP (broker)
     wal_remote: str | None = None  # "host:port" — use a remote log server
+    wal_kafka: str | None = None  # "host:port" — external Kafka broker
     store_server_port: int = 0    # serve the column store over TCP
     store_remote: str | None = None  # "host:port" — remote chunk store
     http_port: int = 8080
@@ -102,6 +104,7 @@ class ServerConfig:
             wal_fsync=cfg.get("wal_fsync", False),
             wal_server_port=cfg.get("wal_server_port", 0),
             wal_remote=cfg.get("wal_remote"),
+            wal_kafka=cfg.get("wal_kafka"),
             store_server_port=cfg.get("store_server_port", 0),
             store_remote=cfg.get("store_remote"),
             http_port=cfg["http_port"],

@@ -72,7 +72,14 @@ class FiloServer:
     def _shard_log(self, dataset: str, shard: int):
         key = (dataset, shard)
         if key not in self.logs:
-            if self.config.wal_remote:
+            if self.config.wal_kafka:
+                # external Kafka broker: topic per dataset, partition ==
+                # shard (reference KafkaIngestionStream contract)
+                from filodb_tpu.kafka.kafka_protocol import KafkaReplayLog
+                host, port = self.config.wal_kafka.rsplit(":", 1)
+                self.logs[key] = KafkaReplayLog(host, int(port), dataset,
+                                                shard)
+            elif self.config.wal_remote:
                 # networked log (the Kafka contract): no shared FS needed
                 from filodb_tpu.kafka.log_server import RemoteLog
                 host, port = self.config.wal_remote.rsplit(":", 1)
