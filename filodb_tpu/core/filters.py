@@ -44,6 +44,78 @@ def _compile_anchored(pattern: str) -> re.Pattern:
     return re.compile(f"^(?:{pattern})$")
 
 
+_RE_META = set(".^$*+?{}[]|()\\")
+
+
+def _split_top_level_alts(pattern: str) -> list[str]:
+    """Split on top-level ``|`` (escapes consumed, group nesting tracked).
+    An escaped sequence stays in its part verbatim, so parts containing
+    ``\\`` still read as non-literal downstream."""
+    parts, cur, depth = [], [], 0
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\":
+            cur.append(ch)
+            i += 1
+            if i < len(pattern):
+                cur.append(pattern[i])
+                i += 1
+            continue
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        if ch == "|" and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    parts.append("".join(cur))
+    return parts
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=1024)
+def regex_plan(pattern: str) -> tuple[str, object]:
+    """Pre-analyze an anchored regex the way Prometheus'
+    FastRegexMatcher / Lucene's automata rewriting do
+    (reference ``PartKeyLuceneIndex.scala:455`` leans on Lucene's
+    ``RegexpQuery`` automaton; this is the index-side equivalent):
+
+    - ``("literal", s)``  — no metacharacters: an Equals lookup
+    - ``("alts", [s..])`` — top-level alternation of literals: an In lookup
+    - ``("prefix", p)``   — literal prefix: narrow the value scan to the
+      sorted value table's prefix range before running the regex
+    - ``("scan", None)``  — fall back to the full value-table scan
+    """
+    if not any(ch in _RE_META for ch in pattern):
+        return ("literal", pattern)
+    parts = _split_top_level_alts(pattern)
+    if len(parts) > 1:
+        if all(p and not any(ch in _RE_META for ch in p) for p in parts):
+            return ("alts", parts)
+        # top-level alternation with non-literal branches: the pattern
+        # head is NOT a mandatory prefix of every match
+        return ("scan", None)
+    prefix = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch in _RE_META:
+            break
+        if i + 1 < len(pattern) and pattern[i + 1] in "*+?{":
+            break  # quantifier makes this char optional/repeated
+        prefix.append(ch)
+        i += 1
+    if prefix:
+        return ("prefix", "".join(prefix))
+    return ("scan", None)
+
+
 class _CompiledRegexMixin:
     """Per-instance compiled-pattern memo: ``matches`` runs once per value
     in index value-table scans — recompiling (even via the re module's

@@ -74,6 +74,28 @@ class FrozenLabel:
     def pid_slice(self, vi: int) -> np.ndarray:
         return self.pids[self.poff[vi] : self.poff[vi + 1]]
 
+    def prefix_range(self, prefix: bytes) -> tuple[int, int]:
+        """[lo, hi) of sorted value-table indexes starting with ``prefix``
+        — binary search against the prefix and its byte-successor."""
+        def bisect(target: bytes) -> int:
+            lo, hi = 0, self.nv
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self.value(mid) < target:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            return lo
+
+        start = bisect(prefix)
+        succ = bytearray(prefix)
+        while succ and succ[-1] == 0xFF:
+            succ.pop()
+        if not succ:
+            return start, self.nv
+        succ[-1] += 1
+        return start, bisect(bytes(succ))
+
     def values(self):
         for vi in range(self.nv):
             yield self.value(vi), vi
@@ -110,6 +132,19 @@ def _filter_cache_key(flt):
     if isinstance(flt, NotEquals):
         return ("ne", flt.value)
     return None
+
+
+def _intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Intersection of two SORTED-unique id arrays via binary search —
+    ``np.intersect1d`` re-sorts its inputs every call, which dominated
+    regex-filter queries (all postings here are already sorted)."""
+    if not len(a) or not len(b):
+        return a[:0]
+    if len(a) > len(b):
+        a, b = b, a
+    pos = np.searchsorted(b, a)
+    pos[pos == len(b)] = len(b) - 1
+    return a[b[pos] == a]
 
 
 def _from_set(s: set[int]) -> np.ndarray:
@@ -331,10 +366,14 @@ class PartKeyIndex:
         return np.unique(np.concatenate(parts))
 
     def _value_scan_ids(self, col: str, match,
-                        cache_key=None) -> np.ndarray:
+                        cache_key=None, prefix: str | None = None
+                        ) -> np.ndarray:
         """Union postings of every value matching the predicate. Native
         path memoizes per (label, predicate) keyed on the postings
-        generation — dashboards repeat the same regex scans."""
+        generation — dashboards repeat the same regex scans. ``prefix``
+        (a literal regex prefix, see ``filters.regex_plan``) narrows the
+        candidate set before the regex runs: binary-searched range on the
+        sorted frozen table, cheap ``startswith`` pre-filter elsewhere."""
         if self._nt is not None:
             gen = self._nt.generation
             ck = (col, cache_key) if cache_key is not None else None
@@ -343,8 +382,12 @@ class PartKeyIndex:
                 if hit is not None and hit[0] == gen:
                     return hit[1]
             values = self._nt.values(col)
-            vids = np.fromiter(
-                (i for i, v in enumerate(values) if match(v)), np.int32)
+            if prefix:
+                cand = ((i, v) for i, v in enumerate(values)
+                        if v.startswith(prefix))
+            else:
+                cand = enumerate(values)
+            vids = np.fromiter((i for i, v in cand if match(v)), np.int32)
             ids = self._nt.union_values(col, vids).astype(np.int64) \
                 if len(vids) else _EMPTY
             if ck is not None:
@@ -355,12 +398,19 @@ class PartKeyIndex:
         parts = []
         fr = self._frozen.get(col)
         if fr is not None:
-            for vb, vi in fr.values():
+            if prefix:
+                lo, hi = fr.prefix_range(prefix.encode())
+                vrange = ((fr.value(vi), vi) for vi in range(lo, hi))
+            else:
+                vrange = fr.values()
+            for vb, vi in vrange:
                 if match(vb.decode()):
                     parts.append(fr.pid_slice(vi).astype(np.int64))
         tail = self._tail.get(col)
         if tail is not None:
             for value, s in tail.items():
+                if prefix and not value.startswith(prefix):
+                    continue
                 if s and match(value):
                     parts.append(_from_set(s))
         if not parts:
@@ -377,8 +427,27 @@ class PartKeyIndex:
             if not parts:
                 return _EMPTY
             return np.unique(np.concatenate(parts))
-        # EqualsRegex that can't match an absent label ("" doesn't match):
-        # the per-label value scan is a sound positive filter
+        if isinstance(flt, EqualsRegex):
+            # FastRegexMatcher-style rewriting: literals and literal
+            # alternations become postings lookups; a literal prefix
+            # narrows the value scan (reference leans on Lucene regex
+            # automata, PartKeyLuceneIndex.scala:455)
+            from filodb_tpu.core.filters import regex_plan
+            kind, arg = regex_plan(flt.pattern)
+            if kind == "literal":
+                return self._equals_ids(f.column, arg)
+            if kind == "alts":
+                parts = [self._equals_ids(f.column, v) for v in arg]
+                parts = [p for p in parts if len(p)]
+                if not parts:
+                    return _EMPTY
+                return np.unique(np.concatenate(parts))
+            return self._value_scan_ids(f.column, flt.matches,
+                                        cache_key=_filter_cache_key(flt),
+                                        prefix=arg if kind == "prefix"
+                                        else None)
+        # NotEqualsRegex/NotEquals that can't match an absent label ("":
+        # doesn't match): the per-label value scan is a sound positive filter
         return self._value_scan_ids(f.column, flt.matches,
                                     cache_key=_filter_cache_key(flt))
 
@@ -453,6 +522,38 @@ class PartKeyIndex:
                     self._end.ctypes.data, len(self._start))
             return self._nt.query_equals(ent[1], ent[2], ba[2], ba[3],
                                          ba[4], start_time, end_time)
+        if self._nt is not None and not self._deleted and filters:
+            # equals + positive-regex fast path: cached regex postings ride
+            # into the native call as a sorted allow-list; intersection AND
+            # the time predicate run in one C++ pass
+            eqs = [f for f in filters if type(f.filter) is Equals]
+            regs = [f for f in filters if isinstance(f.filter, EqualsRegex)
+                    and not f.filter.matches("")]
+            if regs and len(eqs) + len(regs) == len(filters):
+                allow = None
+                for f in regs:
+                    ids = self._ids_for_filter(f)
+                    allow = ids if allow is None \
+                        else _intersect_sorted(allow, ids)
+                    if not len(allow):
+                        return []
+                key = tuple((f.column, f.filter.value) for f in eqs)
+                ent = self._pairs_cache.get(key)
+                if ent is None:
+                    from filodb_tpu.memory.native import TagIndexNative
+                    blob = TagIndexNative.encode_pairs(list(key))
+                    ent = (blob, TagIndexNative.addr_of(blob), len(key))
+                    if len(self._pairs_cache) >= 256:
+                        self._pairs_cache.pop(next(iter(self._pairs_cache)))
+                    self._pairs_cache[key] = ent
+                ba = self._bounds_addr
+                if ba is None or ba[0] is not self._start:
+                    ba = self._bounds_addr = (
+                        self._start, self._end, self._start.ctypes.data,
+                        self._end.ctypes.data, len(self._start))
+                return self._nt.query_equals_allow(
+                    ent[1], ent[2], allow, ba[2], ba[3], ba[4],
+                    start_time, end_time)
         if self._nt is None and not self._frozen:
             return self._part_ids_set_path(filters, start_time, end_time)
         result: np.ndarray | None = None
@@ -475,7 +576,7 @@ class PartKeyIndex:
         for f in others:
             ids = self._ids_for_filter(f)
             result = ids if result is None \
-                else np.intersect1d(result, ids, assume_unique=True)
+                else _intersect_sorted(result, ids)
             if not len(result):
                 return []
         if result is None:

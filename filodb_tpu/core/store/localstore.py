@@ -60,6 +60,11 @@ class _Db:
                 os.makedirs(d, exist_ok=True)
                 c = sqlite3.connect(os.path.join(d, f"shard-{shard}.db"),
                                     check_same_thread=False)
+                # the meta store and the column store hold SEPARATE
+                # connections to one shard file; concurrent group flushes
+                # interleave chunk and checkpoint writes, so lock waits
+                # must block-and-retry instead of raising immediately
+                c.execute("PRAGMA busy_timeout=10000")
                 c.execute("PRAGMA journal_mode=WAL")
                 c.execute("PRAGMA synchronous=NORMAL")
                 c.execute("""CREATE TABLE IF NOT EXISTS chunks (

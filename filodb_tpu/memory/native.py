@@ -177,6 +177,11 @@ def _load():
                                               i64, i64, i64,
                                               ctypes.c_void_p, i64]
         lib.tagindex_query_equals.restype = i64
+        lib.tagindex_query_equals_allow.argtypes = [
+            vp, ctypes.c_void_p, i32, ctypes.c_void_p, i64,
+            ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
+            ctypes.c_void_p, i64]
+        lib.tagindex_query_equals_allow.restype = i64
         lib.tagindex_intersect_equals.argtypes = [vp, u8p, i32, i32p, i64]
         lib.tagindex_intersect_equals.restype = i64
         lib.tagindex_label_all.argtypes = [vp, cp, i64, i32p, i64]
@@ -451,6 +456,30 @@ class TagIndexNative:
                     self._h, pairs_addr, npairs, starts_addr, ends_addr,
                     bounds_len, start_t, end_t, self._buf_addr,
                     len(self._buf))
+            return self._buf[: int(n)].tolist()
+
+    def query_equals_allow(self, pairs_addr: int, npairs: int,
+                           allow: np.ndarray, starts_addr: int,
+                           ends_addr: int, bounds_len: int,
+                           start_t: int, end_t: int) -> list[int]:
+        """Equals postings ∩ sorted allow-list (cached regex postings) ∩
+        time predicate, one native call — the regex-filter fast path."""
+        allow = np.ascontiguousarray(allow, np.int32)
+        aptr = allow.ctypes.data
+        with self._lock:
+            if self._pend:
+                self._flush()
+            n = self._lib.tagindex_query_equals_allow(
+                self._h, pairs_addr, npairs, aptr, len(allow), starts_addr,
+                ends_addr, bounds_len, start_t, end_t, self._buf_addr,
+                len(self._buf))
+            if n < 0:
+                self._buf = np.empty(int(-n) + 64, np.int32)
+                self._buf_addr = self._buf.ctypes.data
+                n = self._lib.tagindex_query_equals_allow(
+                    self._h, pairs_addr, npairs, aptr, len(allow),
+                    starts_addr, ends_addr, bounds_len, start_t, end_t,
+                    self._buf_addr, len(self._buf))
             return self._buf[: int(n)].tolist()
 
     def label_all(self, label: str) -> np.ndarray:

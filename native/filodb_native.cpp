@@ -1398,6 +1398,40 @@ int64_t tagindex_query_equals(void* h, const uint8_t* pairs, int32_t npairs,
     return w;
 }
 
+// query_equals + an extra sorted allow-list intersected in the same pass —
+// the regex fast path: equals postings ∩ cached regex postings ∩ time
+// predicate, all in one call (no per-query numpy round trips host-side)
+int64_t tagindex_query_equals_allow(void* h, const uint8_t* pairs,
+                                    int32_t npairs, const int32_t* allow,
+                                    int64_t allow_len, const int64_t* starts,
+                                    const int64_t* ends, int64_t bounds_len,
+                                    int64_t start_t, int64_t end_t,
+                                    int32_t* out, int64_t cap) {
+    int64_t n;
+    if (npairs > 0) {
+        n = tagindex_intersect_equals(h, pairs, npairs, out, cap);
+        if (n < 0) return n;
+    } else {
+        // no equals filters: the allow list IS the candidate set
+        n = allow_len < cap ? allow_len : cap;
+        if (allow_len > cap) return -allow_len;
+        std::memcpy(out, allow, n * 4);
+    }
+    int64_t w = 0, a = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int32_t pid = out[i];
+        if (npairs > 0) {  // gallop the sorted allow list alongside
+            while (a < allow_len && allow[a] < pid) a++;
+            if (a >= allow_len) break;
+            if (allow[a] != pid) continue;
+        }
+        if (pid < bounds_len && starts[pid] <= end_t
+            && ends[pid] >= start_t)
+            out[w++] = pid;
+    }
+    return w;
+}
+
 // union of every posting of a label ("has this label at all")
 int64_t tagindex_label_all(void* h, const char* labn, int64_t ll,
                            int32_t* out, int64_t cap) {

@@ -132,7 +132,7 @@ class TestSnapshotRoundTrip:
         # tombstone pids stay dead; pid numbering is preserved
         assert s2.partitions[0] is None and s2.partitions[4] is not None
 
-    def test_hist_partition_restored_as_host_backed(self, tmp_path):
+    def test_hist_partition_restored_and_odp_readable(self, tmp_path):
         cs = LocalDiskColumnStore(str(tmp_path))
         meta = LocalDiskMetaStore(str(tmp_path))
         ms = TimeSeriesMemStore(cs, meta)
@@ -145,7 +145,9 @@ class TestSnapshotRoundTrip:
         ms2 = TimeSeriesMemStore(cs, meta)
         s2 = ms2.setup("ds", 0, small_cfg())
         assert s2.recover_index() == 1
-        assert type(s2.partitions[0]).__name__ == "TimeSeriesPartition"
+        # hist schemas ride the native ingest lane (round 5), so the
+        # restored partition is native-backed
+        assert type(s2.partitions[0]).__name__ == "NativeBackedPartition"
         # ODP still serves the flushed hist chunks through this partition
         from filodb_tpu.core.memstore.odp import page_partitions
         extra = page_partitions(s2, [s2.partitions[0]], 0, 10**15,

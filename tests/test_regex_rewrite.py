@@ -1,0 +1,179 @@
+"""Regex filter rewriting (FastRegexMatcher analog) + index parity.
+
+Reference leans on Lucene's regex automata (``PartKeyLuceneIndex.scala:455``);
+here literal/alternation/prefix analysis rewrites regex filters into
+postings lookups and narrowed scans. Every rewrite must return EXACTLY the
+ids of the naive full value scan, on both the native and pure-Python index
+tiers.
+"""
+
+import os
+
+import pytest
+
+from filodb_tpu.core.filters import (
+    ColumnFilter,
+    Equals,
+    EqualsRegex,
+    NotEqualsRegex,
+    regex_plan,
+)
+from filodb_tpu.core.memstore.index import FrozenLabel, PartKeyIndex
+from filodb_tpu.core.partkey import PartKey
+
+
+class TestRegexPlan:
+    def test_literal(self):
+        assert regex_plan("api") == ("literal", "api")
+        assert regex_plan("api-server_1") == ("literal", "api-server_1")
+
+    def test_alternation_of_literals(self):
+        assert regex_plan("a|b|c") == ("alts", ["a", "b", "c"])
+        assert regex_plan("up|down") == ("alts", ["up", "down"])
+
+    def test_nested_alternation_not_alts(self):
+        kind, _ = regex_plan("a(b|c)")
+        assert kind == "prefix"
+        kind, _ = regex_plan("(a|b)c")
+        assert kind == "scan"
+
+    def test_prefix_extraction(self):
+        assert regex_plan("api-.*") == ("prefix", "api-")
+        assert regex_plan("i5.*") == ("prefix", "i5")
+        # the char before a quantifier is NOT part of the fixed prefix
+        assert regex_plan("abc*") == ("prefix", "ab")
+        assert regex_plan("abc?d") == ("prefix", "ab")
+
+    def test_no_prefix_scan(self):
+        assert regex_plan(".*foo") == ("scan", None)
+        assert regex_plan("[ab]x") == ("scan", None)
+
+    def test_escapes_stay_conservative(self):
+        # "\." could be a literal dot, but we don't claim it
+        kind, _ = regex_plan(r"a\.b")
+        assert kind == "prefix"
+        assert regex_plan(r"a\.b")[1] == "a"
+        assert regex_plan(r"a|b\|c") == ("scan", None) \
+            or regex_plan(r"a|b\|c")[0] == "scan"
+
+
+class TestFrozenPrefixRange:
+    def build(self, values):
+        pairs = [(v.encode(), [i]) for i, v in enumerate(values)]
+        return FrozenLabel.build(pairs), sorted(v.encode() for v in values)
+
+    def test_basic_range(self):
+        fr, svals = self.build(["apple", "apricot", "banana", "cherry",
+                                "ap", "apz"])
+        lo, hi = fr.prefix_range(b"ap")
+        got = [fr.value(vi) for vi in range(lo, hi)]
+        assert got == [v for v in svals if v.startswith(b"ap")]
+
+    def test_no_match(self):
+        fr, _ = self.build(["a", "b", "c"])
+        lo, hi = fr.prefix_range(b"zz")
+        assert lo == hi
+
+    def test_prefix_with_0xff_suffix(self):
+        fr, svals = self.build(["a\xffb", "a\xffc", "b"])
+        lo, hi = fr.prefix_range("a\xff".encode())
+        got = [fr.value(vi) for vi in range(lo, hi)]
+        assert got == [v for v in svals
+                       if v.startswith("a\xff".encode())]
+
+    def test_full_table(self):
+        fr, svals = self.build([f"v{i:03d}" for i in range(50)])
+        lo, hi = fr.prefix_range(b"v")
+        assert (lo, hi) == (0, 50)
+        lo, hi = fr.prefix_range(b"v01")
+        assert hi - lo == 10
+
+
+def _build_index(native: bool):
+    if not native:
+        os.environ["FILODB_NO_NATIVE_INDEX"] = "1"
+    try:
+        idx = PartKeyIndex()
+    finally:
+        os.environ.pop("FILODB_NO_NATIVE_INDEX", None)
+    for i in range(400):
+        key = PartKey.create("gauge", {
+            "_metric_": f"m{i % 4}", "app": f"app-{i % 10}",
+            "instance": f"inst{i:03d}"})
+        idx.add_part_key(i, key, start_time=0, end_time=10**15)
+    return idx
+
+
+@pytest.mark.parametrize("native", [True, False])
+class TestIndexRegexParity:
+    """Rewritten paths must match a naive full-scan reference result."""
+
+    def _naive(self, idx, col, flt):
+        import re
+        rx = re.compile(f"^(?:{flt.pattern})$")
+        out = set()
+        for pid in range(400):
+            k = idx.part_key(pid)
+            if k is None:
+                continue
+            v = k.label_map.get(col)
+            if v is not None and rx.match(v):
+                out.add(pid)
+        return out
+
+    def _query(self, idx, col, pattern, extra_eq=None):
+        filters = [ColumnFilter(col, EqualsRegex(pattern))]
+        if extra_eq:
+            filters.append(ColumnFilter(*extra_eq))
+        return set(idx.part_ids_from_filters(filters, 0, 2**62))
+
+    def test_literal_rewrite(self, native):
+        idx = _build_index(native)
+        assert self._query(idx, "app", "app-3") == \
+            self._naive(idx, "app", EqualsRegex("app-3"))
+
+    def test_alts_rewrite(self, native):
+        idx = _build_index(native)
+        got = self._query(idx, "app", "app-1|app-5|app-9")
+        assert got == self._naive(idx, "app", EqualsRegex("app-1|app-5|app-9"))
+        assert len(got) == 120
+
+    def test_prefix_rewrite(self, native):
+        idx = _build_index(native)
+        got = self._query(idx, "instance", "inst01.*")
+        assert got == self._naive(idx, "instance", EqualsRegex("inst01.*"))
+        assert len(got) == 10
+
+    def test_scan_fallback(self, native):
+        idx = _build_index(native)
+        got = self._query(idx, "instance", ".*5")
+        assert got == self._naive(idx, "instance", EqualsRegex(".*5"))
+
+    def test_regex_with_equals_combo(self, native):
+        idx = _build_index(native)
+        got = self._query(idx, "instance", "inst0.*",
+                          extra_eq=("app", Equals("app-7")))
+        naive = self._naive(idx, "instance", EqualsRegex("inst0.*"))
+        eq = {pid for pid in range(400)
+              if idx.part_key(pid).label_map.get("app") == "app-7"}
+        assert got == naive & eq
+
+    def test_regex_only_query(self, native):
+        idx = _build_index(native)
+        got = self._query(idx, "app", "app-[02].*")
+        assert got == self._naive(idx, "app", EqualsRegex("app-[02].*"))
+
+    def test_time_bounds_respected(self, native):
+        idx = _build_index(native)
+        idx.update_end_time(5, 100)  # pid 5 ended long ago
+        filters = [ColumnFilter("app", EqualsRegex("app-5"))]
+        got = set(idx.part_ids_from_filters(filters, 200, 2**62))
+        assert 5 not in got
+        assert 15 in got
+
+    def test_not_regex_unchanged(self, native):
+        idx = _build_index(native)
+        filters = [ColumnFilter("app", NotEqualsRegex("app-[0-8]"))]
+        got = set(idx.part_ids_from_filters(filters, 0, 2**62))
+        assert got == {pid for pid in range(400)
+                       if idx.part_key(pid).label_map["app"] == "app-9"}
