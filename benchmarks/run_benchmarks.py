@@ -401,6 +401,55 @@ def bench_dict_string():
             "encoded_bytes": len(enc), "unit": "ops/sec"}
 
 
+def bench_mesh_churn():
+    """Mesh engine under ingest churn and shard imbalance (VERDICT r3 #9):
+    q/s with a static store vs with every query preceded by an ingest tick
+    (data_version bump -> batch rebuild + re-upload), on a 10:1 skewed
+    shard distribution."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tests"))
+    from test_mesh_stress import NUM_SHARDS, skewed_store
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.record import (
+        IngestRecord,
+        RecordContainer,
+        SomeData,
+    )
+
+    ms = skewed_store(per_shard=(80, 8, 8, 8), n_samples=120)
+    svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                       engine="mesh")
+    q = 'sum(rate(skew_total[5m])) by (shardtag)'
+    args = (START + 400, 10, START + 1100)
+    svc.query_range(q, *args)  # warm/compile
+    n = 30
+    t0 = time.perf_counter()
+    for _ in range(n):
+        svc.query_range(q, *args)
+    static_qps = n / (time.perf_counter() - t0)
+
+    key = PartKey.create("prom-counter", {
+        "_metric_": "skew_total", "_ws_": "demo", "_ns_": "App-0",
+        "shardtag": "s0", "instance": "i0-0"})
+    shard = ms.get_shard("timeseries", 0)
+    t0 = time.perf_counter()
+    for i in range(n):
+        c = RecordContainer()
+        c.add(IngestRecord(key, (START + (121 + i) * 10) * 1000,
+                           (1e6 + i,)))
+        shard.ingest(SomeData(c, 10_000 + i))
+        svc.query_range(q, *args)
+    churn_qps = n / (time.perf_counter() - t0)
+    eng = svc.mesh_engine
+    return {"metric": "mesh_churn", "static_qps": round(static_qps, 1),
+            "churn_qps": round(churn_qps, 1),
+            "rebuild_overhead_x": round(static_qps / churn_qps, 2),
+            "mesh_hit_rate": round(eng.hit_rate, 3),
+            "skew": "10:1 over 4 shards", "unit": "queries/sec"}
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -413,6 +462,7 @@ ALL = {
     "encoding": bench_encoding,
     "query_odp": bench_query_odp,
     "dict_string": bench_dict_string,
+    "mesh_churn": bench_mesh_churn,
 }
 
 

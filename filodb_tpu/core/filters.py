@@ -48,10 +48,14 @@ _RE_META = set(".^$*+?{}[]|()\\")
 
 
 def _split_top_level_alts(pattern: str) -> list[str]:
-    """Split on top-level ``|`` (escapes consumed, group nesting tracked).
-    An escaped sequence stays in its part verbatim, so parts containing
-    ``\\`` still read as non-literal downstream."""
+    """Split on top-level ``|`` (escapes consumed, group nesting tracked,
+    character classes scanned opaquely — ``(``/``|``/``[`` inside ``[...]``
+    are literals and must not desync the depth counter). An escaped
+    sequence stays in its part verbatim, so parts containing ``\\`` still
+    read as non-literal downstream."""
     parts, cur, depth = [], [], 0
+    in_class = False
+    class_start = -1
     i = 0
     while i < len(pattern):
         ch = pattern[i]
@@ -62,9 +66,22 @@ def _split_top_level_alts(pattern: str) -> list[str]:
                 cur.append(pattern[i])
                 i += 1
             continue
-        if ch in "([":
+        if in_class:
+            # ']' is literal as the first class char ("[]]") or right
+            # after a negation ("[^]]")
+            first = i == class_start + 1 or (
+                i == class_start + 2 and pattern[class_start + 1] == "^")
+            if ch == "]" and not first:
+                in_class = False
+            cur.append(ch)
+            i += 1
+            continue
+        if ch == "[":
+            in_class = True
+            class_start = i
+        elif ch == "(":
             depth += 1
-        elif ch in ")]":
+        elif ch == ")":
             depth -= 1
         if ch == "|" and depth == 0:
             parts.append("".join(cur))

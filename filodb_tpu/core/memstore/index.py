@@ -494,6 +494,24 @@ class PartKeyIndex:
                 out |= ids
         return out
 
+    def _native_query_prep(self, key: tuple):
+        """(pairs_entry, bounds_snapshot) for the native query fast paths
+        — memoized encoded pair buffers + raw bounds addresses."""
+        ent = self._pairs_cache.get(key)
+        if ent is None:
+            from filodb_tpu.memory.native import TagIndexNative
+            blob = TagIndexNative.encode_pairs(list(key))
+            ent = (blob, TagIndexNative.addr_of(blob), len(key))
+            if len(self._pairs_cache) >= 256:
+                self._pairs_cache.pop(next(iter(self._pairs_cache)))
+            self._pairs_cache[key] = ent
+        ba = self._bounds_addr
+        if ba is None or ba[0] is not self._start:
+            ba = self._bounds_addr = (
+                self._start, self._end, self._start.ctypes.data,
+                self._end.ctypes.data, len(self._start))
+        return ent, ba
+
     def part_ids_from_filters(
         self, filters: list[ColumnFilter], start_time: int, end_time: int
     ) -> list[int]:
@@ -507,19 +525,7 @@ class PartKeyIndex:
             # native call (the dominant query shape — shard-key lookups);
             # encoded pair buffers and raw bounds addresses are cached
             key = tuple((f.column, f.filter.value) for f in filters)
-            ent = self._pairs_cache.get(key)
-            if ent is None:
-                from filodb_tpu.memory.native import TagIndexNative
-                blob = TagIndexNative.encode_pairs(list(key))
-                ent = (blob, TagIndexNative.addr_of(blob), len(key))
-                if len(self._pairs_cache) >= 256:
-                    self._pairs_cache.pop(next(iter(self._pairs_cache)))
-                self._pairs_cache[key] = ent
-            ba = self._bounds_addr
-            if ba is None or ba[0] is not self._start:
-                ba = self._bounds_addr = (
-                    self._start, self._end, self._start.ctypes.data,
-                    self._end.ctypes.data, len(self._start))
+            ent, ba = self._native_query_prep(key)
             return self._nt.query_equals(ent[1], ent[2], ba[2], ba[3],
                                          ba[4], start_time, end_time)
         if self._nt is not None and not self._deleted and filters:
@@ -538,19 +544,7 @@ class PartKeyIndex:
                     if not len(allow):
                         return []
                 key = tuple((f.column, f.filter.value) for f in eqs)
-                ent = self._pairs_cache.get(key)
-                if ent is None:
-                    from filodb_tpu.memory.native import TagIndexNative
-                    blob = TagIndexNative.encode_pairs(list(key))
-                    ent = (blob, TagIndexNative.addr_of(blob), len(key))
-                    if len(self._pairs_cache) >= 256:
-                        self._pairs_cache.pop(next(iter(self._pairs_cache)))
-                    self._pairs_cache[key] = ent
-                ba = self._bounds_addr
-                if ba is None or ba[0] is not self._start:
-                    ba = self._bounds_addr = (
-                        self._start, self._end, self._start.ctypes.data,
-                        self._end.ctypes.data, len(self._start))
+                ent, ba = self._native_query_prep(key)
                 return self._nt.query_equals_allow(
                     ent[1], ent[2], allow, ba[2], ba[3], ba[4],
                     start_time, end_time)

@@ -196,7 +196,9 @@ class NativeBackedPartition:
         self.max_chunk_size = max_chunk_size
         self.shard = shard
         self.device_pages = False
-        self._chunks_cache: list[Chunk] = []
+        # lazily allocated on first chunk read: an empty list per series
+        # is ~56B x 1M series of dead weight at scale
+        self._chunks_cache: list[Chunk] | None = None
         self._chunks_ver = -1
 
     @property
@@ -287,7 +289,7 @@ class NativeBackedPartition:
         core, pid = self._core._core, self.part_id
         with self._core.lock:
             ver = int(self._lib.part_version(core, pid))
-            if ver == self._chunks_ver:
+            if ver == self._chunks_ver and self._chunks_cache is not None:
                 return self._chunks_cache
             n = self._lib.part_num_sealed(core, pid)
             ncols = self._lib.part_ncols(core, pid)

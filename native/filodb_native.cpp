@@ -19,6 +19,8 @@
 #include <limits>
 #include <new>
 #include <string>
+#include <string_view>
+#include <memory>
 #include <unordered_map>
 #include <vector>
 
@@ -364,8 +366,20 @@ struct NSealed {
     std::vector<std::string> col_bytes;
 };
 
+// first-class histogram state lives in a side table keyed by pid: only
+// histogram partitions pay for it, keeping sizeof(NPart) lean for the
+// 1M-series scalar case (the ZeroCopyUTF8String-era memory discipline)
+struct HistState {
+    int32_t nb = 0;
+    std::vector<double> les;
+    std::vector<int64_t> rows;  // ts.size() x nb, row-major
+};
+
 struct NPart {
-    std::string key;  // schema_id + label blob (canonical container bytes)
+    // canonical key bytes (schema_id + label blob) interned in the core's
+    // append-only key arena — one copy total (NPart and the by_key map
+    // both view it); the reference's zero-copy label tier analog
+    std::string_view key;
     uint32_t hash = 0;
     bool alive = true;
     int64_t floor_ts = -1;   // dedup floor (recovery / eviction)
@@ -377,14 +391,10 @@ struct NPart {
     std::vector<int64_t> ts;
     std::vector<std::vector<double>> cols;
     std::vector<NSealed> sealed;
-    // first-class histogram column (at most one per schema, like the
-    // reference's prom-histogram): bucket-count rows kept row-major; the
-    // matching cols[] slot carries NaN placeholders so every shape
-    // invariant (lockstep growth, buf copy) holds unchanged
+    // >=0: the schema column index of this partition's histogram column;
+    // bucket state in ShardCore::hist. The cols[] slot carries NaN
+    // placeholders so shape invariants (lockstep growth, buf copy) hold.
     int32_t hist_col = -1;
-    int32_t hist_nb = 0;
-    std::vector<double> hist_les;
-    std::vector<int64_t> hist_rows;  // ts.size() x hist_nb, row-major
 
     int64_t latest() const {
         int64_t t = floor_ts;
@@ -401,16 +411,36 @@ struct ShardCore {
     int32_t max_chunk;
     int32_t groups;
     std::vector<int64_t> watermarks;
-    std::unordered_map<std::string, int32_t> by_key;
+    std::unordered_map<std::string_view, int32_t> by_key;
     std::deque<NPart> parts;  // stable references; index == pid
+    std::unordered_map<int32_t, HistState> hist;  // pid -> hist state
     std::vector<int32_t> new_parts;
     int64_t rows_skipped = 0, rows_ooo = 0, rows_ingested = 0;
     int64_t rows_incompat = 0;  // value shape mismatched the partition
+    // key arena: append-only stable storage for interned key bytes (block
+    // pointers never move; views into blocks stay valid for the core's
+    // lifetime — freed partitions leave small holes until destruction)
+    std::vector<std::unique_ptr<char[]>> key_blocks;
+    size_t key_block_used = 0;
     // encode scratch (single-writer per shard)
     std::vector<int64_t> resid;
     std::vector<uint64_t> words;
     std::vector<uint8_t> packed;
-    std::string scratch_key;
+
+    static constexpr size_t KEY_BLOCK = 1 << 18;
+
+    std::string_view intern_key(const char* d, size_t len) {
+        if (key_blocks.empty()
+            || key_block_used + len > KEY_BLOCK) {
+            size_t cap = len > KEY_BLOCK ? len : KEY_BLOCK;
+            key_blocks.emplace_back(new char[cap]);
+            key_block_used = 0;
+        }
+        char* dst = key_blocks.back().get() + key_block_used;
+        std::memcpy(dst, d, len);
+        key_block_used += len;
+        return std::string_view(dst, len);
+    }
 };
 
 inline uint16_t rd_u16(const uint8_t* p) {
@@ -469,20 +499,20 @@ void encode_xor(ShardCore* c, const double* v, int64_t n, std::string& out) {
 // Hist-2D-delta codec, byte-identical to codecs.encode_hist_2d_delta:
 // u8 codec=4 | u32 n | u32 nb | f64*nb les | nibble_pack(zigzag(
 //   delta-across-time(delta-across-buckets(rows))))
-void encode_hist2d(ShardCore* c, const NPart& p, int64_t n,
+void encode_hist2d(ShardCore* c, const HistState& hs, int64_t n,
                    std::string& out) {
-    uint32_t nb = (uint32_t)p.hist_nb;
+    uint32_t nb = (uint32_t)hs.nb;
     uint8_t head[9];
     head[0] = 4;
     uint32_t n32 = (uint32_t)n;
     std::memcpy(head + 1, &n32, 4);
     std::memcpy(head + 5, &nb, 4);
     out.assign((char*)head, 9);
-    out.append((const char*)p.hist_les.data(), (size_t)nb * 8);
+    out.append((const char*)hs.les.data(), (size_t)nb * 8);
     int64_t total = n * (int64_t)nb;
     if (!total) return;
     c->resid.resize(total);
-    const int64_t* r = p.hist_rows.data();
+    const int64_t* r = hs.rows.data();
     for (int64_t i = 0; i < n; i++) {
         for (int64_t j = 0; j < (int64_t)nb; j++) {
             int64_t bd = r[i * nb + j] - (j ? r[i * nb + j - 1] : 0);
@@ -498,9 +528,14 @@ void encode_hist2d(ShardCore* c, const NPart& p, int64_t n,
     out.append((char*)c->packed.data(), m);
 }
 
-void seal_part(ShardCore* c, NPart& p) {
+void seal_part(ShardCore* c, int32_t pid, NPart& p) {
     int64_t n = (int64_t)p.ts.size();
     if (!n) return;
+    HistState* hs = nullptr;
+    if (p.hist_col >= 0) {
+        auto hit = c->hist.find(pid);
+        if (hit != c->hist.end()) hs = &hit->second;
+    }
     NSealed s;
     s.nrows = (int32_t)n;
     s.start = p.ts[0];
@@ -510,8 +545,8 @@ void seal_part(ShardCore* c, NPart& p) {
     encode_dd(c, p.ts.data(), n, s.ts_bytes);
     s.col_bytes.resize(p.cols.size());
     for (size_t i = 0; i < p.cols.size(); i++) {
-        if ((int32_t)i == p.hist_col)
-            encode_hist2d(c, p, n, s.col_bytes[i]);
+        if ((int32_t)i == p.hist_col && hs != nullptr)
+            encode_hist2d(c, *hs, n, s.col_bytes[i]);
         else
             encode_xor(c, p.cols[i].data(), n, s.col_bytes[i]);
     }
@@ -519,7 +554,7 @@ void seal_part(ShardCore* c, NPart& p) {
     p.sealed.push_back(std::move(s));
     p.ts.clear();
     for (auto& col : p.cols) col.clear();
-    p.hist_rows.clear();
+    if (hs != nullptr) hs->rows.clear();
     p.version++;
 }
 
@@ -639,29 +674,32 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
             off = end;
             continue;
         }
-        c->scratch_key.assign((const char*)d + key_off, key_len);
-        auto it = c->by_key.find(c->scratch_key);
+        std::string_view probe((const char*)d + key_off, key_len);
+        auto it = c->by_key.find(probe);
         NPart* p;
+        int32_t pid;
         if (it == c->by_key.end()) {
-            int32_t pid = (int32_t)c->parts.size();
+            pid = (int32_t)c->parts.size();
             c->parts.emplace_back();
             p = &c->parts.back();
-            p->key = c->scratch_key;
+            p->key = c->intern_key((const char*)d + key_off, key_len);
             p->hash = hash;
             p->cols.resize(nv);
             p->ts.reserve(8);
             for (auto& col : p->cols) col.reserve(8);
             if (rec_hist >= 0) {
                 p->hist_col = rec_hist;
-                p->hist_nb = vnb[rec_hist];
-                p->hist_les.resize(p->hist_nb);
-                std::memcpy(p->hist_les.data(), d + voff[rec_hist] + 3,
-                            (size_t)p->hist_nb * 8);
+                HistState& hs = c->hist[pid];
+                hs.nb = vnb[rec_hist];
+                hs.les.resize(hs.nb);
+                std::memcpy(hs.les.data(), d + voff[rec_hist] + 3,
+                            (size_t)hs.nb * 8);
             }
             c->by_key.emplace(p->key, pid);
             c->new_parts.push_back(pid);
         } else {
-            p = &c->parts[it->second];
+            pid = it->second;
+            p = &c->parts[pid];
         }
         // a record whose hist position disagrees with the partition's
         // shape cannot append without desyncing columns — drop it. An
@@ -672,10 +710,11 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
             if (rec_hist >= 0 && p->hist_col < 0 && p->ts.empty()
                     && p->sealed.empty()) {
                 p->hist_col = rec_hist;
-                p->hist_nb = vnb[rec_hist];
-                p->hist_les.resize(p->hist_nb);
-                std::memcpy(p->hist_les.data(), d + voff[rec_hist] + 3,
-                            (size_t)p->hist_nb * 8);
+                HistState& hs = c->hist[pid];
+                hs.nb = vnb[rec_hist];
+                hs.les.resize(hs.nb);
+                std::memcpy(hs.les.data(), d + voff[rec_hist] + 3,
+                            (size_t)hs.nb * 8);
             } else {
                 c->rows_incompat++;
                 off = end;
@@ -687,16 +726,18 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
             off = end;
             continue;
         }
+        HistState* hsp = nullptr;
         if (p->hist_col >= 0) {
+            hsp = &c->hist[pid];  // one lookup per record, reused below
             uint16_t nb = vnb[p->hist_col];
-            if ((int32_t)nb != p->hist_nb) {
+            if ((int32_t)nb != hsp->nb) {
                 // bucket-scheme change forces a chunk switch (mirrors
                 // TimeSeriesPartition.ingest host semantics)
-                if (!p->ts.empty()) seal_part(c, *p);
-                p->hist_nb = nb;
-                p->hist_les.resize(nb);
+                if (!p->ts.empty()) seal_part(c, pid, *p);
+                hsp->nb = nb;
+                hsp->les.resize(nb);
             }
-            std::memcpy(p->hist_les.data(), d + voff[p->hist_col] + 3,
+            std::memcpy(hsp->les.data(), d + voff[p->hist_col] + 3,
                         (size_t)nb * 8);
         }
         if (p->first_ts < 0) p->first_ts = ts;
@@ -712,15 +753,15 @@ int64_t shard_core_ingest(void* cp, const uint8_t* d, int64_t len,
                 std::memcpy(&x, d + voff[j] + 1, 8);
             p->cols[j].push_back(x);
         }
-        if (p->hist_col >= 0) {
+        if (hsp != nullptr) {
             const uint8_t* counts = d + voff[p->hist_col] + 3
-                + (int64_t)p->hist_nb * 8;
-            size_t base = p->hist_rows.size();
-            p->hist_rows.resize(base + p->hist_nb);
-            std::memcpy(p->hist_rows.data() + base, counts,
-                        (size_t)p->hist_nb * 8);
+                + (int64_t)hsp->nb * 8;
+            size_t base = hsp->rows.size();
+            hsp->rows.resize(base + hsp->nb);
+            std::memcpy(hsp->rows.data() + base, counts,
+                        (size_t)hsp->nb * 8);
         }
-        if ((int32_t)p->ts.size() >= c->max_chunk) seal_part(c, *p);
+        if ((int32_t)p->ts.size() >= c->max_chunk) seal_part(c, pid, *p);
         ingested++;
         off = end;
     }
@@ -754,8 +795,8 @@ int32_t shard_core_drain_new(void* cp, int32_t* out, int32_t cap) {
 // need no host-language key dictionary — this map is authoritative.
 int32_t shard_core_lookup(void* cp, const uint8_t* key, int32_t key_len) {
     ShardCore* c = static_cast<ShardCore*>(cp);
-    c->scratch_key.assign((const char*)key, key_len);
-    auto it = c->by_key.find(c->scratch_key);
+    std::string_view probe((const char*)key, key_len);
+    auto it = c->by_key.find(probe);
     return it == c->by_key.end() ? -1 : it->second;
 }
 
@@ -774,7 +815,7 @@ int64_t shard_core_bootstrap(void* cp, const uint8_t* d, int64_t len) {
         if (off + kl + 14 > len) return -1;
         c->parts.emplace_back();
         NPart& p = c->parts.back();
-        if (kl) p.key.assign((const char*)d + off, kl);
+        int64_t key_off2 = off;
         off += kl;
         p.hash = rd_u32(d + off);
         p.floor_ts = rd_i64(d + off + 4);
@@ -782,10 +823,11 @@ int64_t shard_core_bootstrap(void* cp, const uint8_t* d, int64_t len) {
         uint8_t ncols = d[off + 13];
         off += 14;
         if (p.alive) {
+            // intern only LIVE keys: tombstone bytes would otherwise leak
+            // in the append-only arena on every snapshot restore
+            p.key = c->intern_key((const char*)d + key_off2, kl);
             p.cols.resize(ncols ? ncols : 1);
             c->by_key.emplace(p.key, (int32_t)(c->parts.size() - 1));
-        } else {
-            p.key.clear();
         }
         n++;
     }
@@ -848,13 +890,13 @@ void shard_core_seed_floors(void* cp, const int32_t* pids,
 int32_t shard_core_create_part(void* cp, const uint8_t* key, int32_t key_len,
                                uint32_t hash, int32_t ncols) {
     ShardCore* c = static_cast<ShardCore*>(cp);
-    std::string k((const char*)key, key_len);
-    auto it = c->by_key.find(k);
+    std::string_view probe((const char*)key, key_len);
+    auto it = c->by_key.find(probe);
     if (it != c->by_key.end()) return it->second;
     int32_t pid = (int32_t)c->parts.size();
     c->parts.emplace_back();
     NPart& p = c->parts.back();
-    p.key = std::move(k);
+    p.key = c->intern_key((const char*)key, key_len);
     p.hash = hash;
     p.cols.resize(ncols > 0 ? ncols : 1);
     c->by_key.emplace(p.key, pid);
@@ -865,7 +907,7 @@ int32_t shard_core_key_len(void* cp, int32_t pid) {
     return (int32_t)static_cast<ShardCore*>(cp)->parts[pid].key.size();
 }
 void shard_core_key_copy(void* cp, int32_t pid, uint8_t* out) {
-    const std::string& k = static_cast<ShardCore*>(cp)->parts[pid].key;
+    std::string_view k = static_cast<ShardCore*>(cp)->parts[pid].key;
     std::memcpy(out, k.data(), k.size());
 }
 uint32_t shard_core_part_hash(void* cp, int32_t pid) {
@@ -888,7 +930,7 @@ int64_t part_append(void* cp, int32_t pid, int64_t ts, const double* vals,
     p.ts.push_back(ts);
     for (int32_t j = 0; j < nvals && j < (int32_t)p.cols.size(); j++)
         p.cols[j].push_back(vals[j]);
-    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, p);
+    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, pid, p);
     c->rows_ingested++;
     return 1;
 }
@@ -903,30 +945,31 @@ int64_t part_append_hist(void* cp, int32_t pid, int64_t ts,
     ShardCore* c = static_cast<ShardCore*>(cp);
     NPart& p = c->parts[pid];
     if (nb <= 0 || nb > 4096 || hist_col < 0) return 0;
-    if (p.hist_col < 0 && p.ts.empty() && p.sealed.empty()
-            && p.hist_rows.empty()) {
+    if (p.hist_col < 0 && p.ts.empty() && p.sealed.empty()) {
         p.hist_col = hist_col;  // first sample fixes the hist column
-        p.hist_nb = nb;
-        p.hist_les.assign(les, les + nb);
+        HistState& hs0 = c->hist[pid];
+        hs0.nb = nb;
+        hs0.les.assign(les, les + nb);
     }
     if (hist_col != p.hist_col) return 0;
     if (ts <= p.latest()) return 0;
-    if (nb != p.hist_nb) {
-        if (!p.ts.empty()) seal_part(c, p);
-        p.hist_nb = nb;
-        p.hist_les.resize(nb);
+    HistState& hs = c->hist[pid];
+    if (nb != hs.nb) {
+        if (!p.ts.empty()) seal_part(c, pid, p);
+        hs.nb = nb;
+        hs.les.resize(nb);
     }
-    p.hist_les.assign(les, les + nb);
+    hs.les.assign(les, les + nb);
     if (p.first_ts < 0) p.first_ts = ts;
     p.ts.push_back(ts);
     for (int32_t j = 0; j < (int32_t)p.cols.size(); j++)
         p.cols[j].push_back(
             j < ndv && j != hist_col
                 ? dvals[j] : std::numeric_limits<double>::quiet_NaN());
-    size_t base = p.hist_rows.size();
-    p.hist_rows.resize(base + nb);
-    std::memcpy(p.hist_rows.data() + base, counts, (size_t)nb * 8);
-    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, p);
+    size_t base = hs.rows.size();
+    hs.rows.resize(base + nb);
+    std::memcpy(hs.rows.data() + base, counts, (size_t)nb * 8);
+    if ((int32_t)p.ts.size() >= c->max_chunk) seal_part(c, pid, p);
     c->rows_ingested++;
     return 1;
 }
@@ -935,19 +978,25 @@ int32_t part_hist_col(void* cp, int32_t pid) {
     return static_cast<ShardCore*>(cp)->parts[pid].hist_col;
 }
 int32_t part_hist_nb(void* cp, int32_t pid) {
-    return static_cast<ShardCore*>(cp)->parts[pid].hist_nb;
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    auto it = c->hist.find(pid);
+    return it == c->hist.end() ? 0 : it->second.nb;
 }
 void part_hist_les(void* cp, int32_t pid, double* out) {
-    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
-    std::memcpy(out, p.hist_les.data(), p.hist_les.size() * 8);
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    auto it = c->hist.find(pid);
+    if (it != c->hist.end())
+        std::memcpy(out, it->second.les.data(), it->second.les.size() * 8);
 }
 // copies up to n buffer rows of bucket counts, row-major [n][nb]
 int32_t part_buf_hist_copy(void* cp, int32_t pid, int32_t n, int64_t* out) {
-    NPart& p = static_cast<ShardCore*>(cp)->parts[pid];
-    if (p.hist_nb <= 0) return 0;
-    int32_t have = (int32_t)(p.hist_rows.size() / p.hist_nb);
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    auto it = c->hist.find(pid);
+    if (it == c->hist.end() || it->second.nb <= 0) return 0;
+    HistState& hs = it->second;
+    int32_t have = (int32_t)(hs.rows.size() / hs.nb);
     if (n > have) n = have;
-    std::memcpy(out, p.hist_rows.data(), (size_t)n * p.hist_nb * 8);
+    std::memcpy(out, hs.rows.data(), (size_t)n * hs.nb * 8);
     return n;
 }
 
@@ -993,7 +1042,7 @@ int32_t part_seal_buffer(void* cp, int32_t pid) {
     ShardCore* c = static_cast<ShardCore*>(cp);
     NPart& p = c->parts[pid];
     if (p.ts.empty()) return 0;
-    seal_part(c, p);
+    seal_part(c, pid, p);
     return 1;
 }
 
@@ -1083,9 +1132,9 @@ void part_free(void* cp, int32_t pid) {
     NPart& p = c->parts[pid];
     if (!p.alive) return;
     c->by_key.erase(p.key);
+    c->hist.erase(pid);
     p.alive = false;
-    p.key.clear();
-    p.key.shrink_to_fit();
+    p.key = std::string_view();  // arena bytes leak until core teardown
     p.ts.clear();
     p.ts.shrink_to_fit();
     p.cols.clear();

@@ -177,3 +177,28 @@ class TestIndexRegexParity:
         got = set(idx.part_ids_from_filters(filters, 0, 2**62))
         assert got == {pid for pid in range(400)
                        if idx.part_key(pid).label_map["app"] == "app-9"}
+
+
+class TestCharClassSoundness:
+    """Review regression: metachars inside character classes must not
+    desync the alternation splitter (verified query-dropping bug)."""
+
+    def test_class_hides_alternation(self):
+        assert regex_plan("a[(]x|y") == ("scan", None)
+        assert regex_plan("x[]]|y") == ("scan", None)
+        assert regex_plan("a[^]]b|c") == ("scan", None)
+
+    @pytest.mark.parametrize("native", [True, False])
+    def test_query_results_not_dropped(self, native):
+        if not native:
+            os.environ["FILODB_NO_NATIVE_INDEX"] = "1"
+        try:
+            idx = PartKeyIndex()
+        finally:
+            os.environ.pop("FILODB_NO_NATIVE_INDEX", None)
+        for pid, app in enumerate(["a(x", "y", "zz"]):
+            idx.add_part_key(pid, PartKey.create("gauge", {
+                "_metric_": "m", "app": app}), 0, 10**15)
+        got = set(idx.part_ids_from_filters(
+            [ColumnFilter("app", EqualsRegex("a[(]x|y"))], 0, 2**62))
+        assert got == {0, 1}
