@@ -93,6 +93,70 @@ class DnsSrvDiscovery(SeedDiscovery):
         return [(r.target, r.port) for r in records]
 
 
+@dataclass
+class ConsulDiscovery(SeedDiscovery):
+    """Reference ``ConsulClient.scala`` + the Consul seed strategy of
+    ``ClusterSeedDiscovery``: nodes register themselves with the local
+    Consul agent (PUT ``/v1/agent/service/register``) and discover seeds
+    from the health endpoint (GET ``/v1/health/service/<name>?passing``).
+    Speaks Consul's actual HTTP API via urllib — point it at a real agent
+    or the protocol-level fake in tests."""
+
+    host: str = "127.0.0.1"
+    port: int = 8500
+    service_name: str = "filodb"
+    timeout: float = 5.0
+
+    def _url(self, path: str) -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def discover(self):
+        import json
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    self._url(f"/v1/health/service/{self.service_name}"
+                              "?passing=true"),
+                    timeout=self.timeout) as r:
+                entries = json.loads(r.read())
+        except OSError as e:
+            log.warning("consul discovery for %s failed: %s",
+                        self.service_name, e)
+            return []
+        out = []
+        for e in entries:
+            svc = e.get("Service", {})
+            addr = svc.get("Address") or e.get("Node", {}).get("Address")
+            port = svc.get("Port")
+            if addr and port:
+                out.append((addr, int(port)))
+        # deterministic seed order (the reference sorts addresses so all
+        # nodes elect the same head seed)
+        return sorted(out)
+
+    def register(self, service_id: str, host: str, port: int) -> None:
+        import json
+        import urllib.request
+        payload = json.dumps({
+            "ID": service_id, "Name": self.service_name,
+            "Address": host, "Port": port}).encode()
+        req = urllib.request.Request(
+            self._url("/v1/agent/service/register"), data=payload,
+            method="PUT", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            if r.status >= 300:
+                raise OSError(f"consul register failed: {r.status}")
+
+    def deregister(self, service_id: str) -> None:
+        import urllib.request
+        req = urllib.request.Request(
+            self._url(f"/v1/agent/service/deregister/{service_id}"),
+            data=b"", method="PUT")
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            if r.status >= 300:
+                raise OSError(f"consul deregister failed: {r.status}")
+
+
 # ---------------------------------------------------------------------------
 # remote membership
 

@@ -1,0 +1,105 @@
+"""Consul seed discovery vs a protocol-level fake agent (reference
+``akka-bootstrapper/ConsulClient.scala`` + Consul seed strategy)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from filodb_tpu.coordinator.bootstrap import ConsulDiscovery
+
+
+class FakeConsulAgent:
+    """In-memory Consul agent speaking the /v1 HTTP API subset the
+    bootstrapper uses: service register/deregister + health listing."""
+
+    def __init__(self):
+        self.services: dict[str, dict] = {}
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body=b""):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_PUT(self):
+                ln = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(ln)
+                if self.path == "/v1/agent/service/register":
+                    svc = json.loads(body)
+                    agent.services[svc["ID"]] = svc
+                    return self._send(200)
+                if self.path.startswith("/v1/agent/service/deregister/"):
+                    sid = self.path.rsplit("/", 1)[1]
+                    agent.services.pop(sid, None)
+                    return self._send(200)
+                return self._send(404)
+
+            def do_GET(self):
+                if self.path.startswith("/v1/health/service/"):
+                    name = self.path.split("/")[4].split("?")[0]
+                    entries = [
+                        {"Node": {"Address": s["Address"]},
+                         "Service": {"ID": s["ID"], "Service": s["Name"],
+                                     "Address": s["Address"],
+                                     "Port": s["Port"]}}
+                        for s in agent.services.values()
+                        if s["Name"] == name]
+                    return self._send(200, json.dumps(entries).encode())
+                return self._send(404)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def agent():
+    a = FakeConsulAgent().start()
+    yield a
+    a.stop()
+
+
+class TestConsulDiscovery:
+    def test_register_discover_deregister(self, agent):
+        d = ConsulDiscovery(port=agent.port, service_name="filodb")
+        assert d.discover() == []
+        d.register("node-a", "10.0.0.1", 2552)
+        d.register("node-b", "10.0.0.2", 2552)
+        assert d.discover() == [("10.0.0.1", 2552), ("10.0.0.2", 2552)]
+        d.deregister("node-a")
+        assert d.discover() == [("10.0.0.2", 2552)]
+
+    def test_other_services_filtered(self, agent):
+        d = ConsulDiscovery(port=agent.port, service_name="filodb")
+        d.register("me", "10.0.0.9", 2552)
+        other = ConsulDiscovery(port=agent.port, service_name="unrelated")
+        other.register("them", "10.0.0.8", 9999)
+        assert d.discover() == [("10.0.0.9", 2552)]
+
+    def test_deterministic_seed_order(self, agent):
+        d = ConsulDiscovery(port=agent.port, service_name="filodb")
+        for i in (3, 1, 2):
+            d.register(f"n{i}", f"10.0.0.{i}", 2552)
+        assert d.discover() == [("10.0.0.1", 2552), ("10.0.0.2", 2552),
+                                ("10.0.0.3", 2552)]
+
+    def test_unreachable_agent_yields_no_seeds(self):
+        d = ConsulDiscovery(port=1, service_name="filodb", timeout=0.3)
+        assert d.discover() == []
