@@ -119,7 +119,8 @@ class ShardStats:
         def fn(get):
             def call():
                 s = ref()
-                return get(s) if s is not None else float("nan")
+                # None drops the series from /metrics once the shard dies
+                return get(s) if s is not None else None
             return call
 
         GaugeFn("memstore_index_entries", fn(lambda s: len(s.index)),

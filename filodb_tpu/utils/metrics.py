@@ -50,16 +50,19 @@ class Gauge(Metric):
 class GaugeFn(Metric):
     """Gauge whose value is computed at scrape time from a callback —
     used for state that lives elsewhere (index sizes, pool sizes, arena
-    stats) so scrapes never go stale and no update path is needed."""
+    stats) so scrapes never go stale and no update path is needed. A
+    callback returning ``None`` (e.g. its subject was torn down) drops
+    the series from the exposition instead of rendering NaN."""
 
     def __init__(self, name: str, fn, tags: dict[str, str] | None = None):
         super().__init__(name, tags)
         self.fn = fn
 
     @property
-    def value(self) -> float:
+    def value(self) -> float | None:
         try:
-            return float(self.fn())
+            v = self.fn()
+            return None if v is None else float(v)
         except Exception:
             return float("nan")
 
@@ -113,7 +116,10 @@ def render_prometheus() -> str:
         if isinstance(m, Counter):
             lines.append(f"{m.name}_total{tagstr} {m.value}")
         elif isinstance(m, (Gauge, GaugeFn)):
-            lines.append(f"{m.name}{tagstr} {m.value}")
+            v = m.value
+            if v is None:
+                continue  # subject gone (GaugeFn over a dead shard)
+            lines.append(f"{m.name}{tagstr} {v}")
         elif isinstance(m, Histogram):
             for b in m.bounds:
                 t = tagstr[:-1] + f',le="{b}"}}' if tagstr else f'{{le="{b}"}}'
