@@ -25,39 +25,97 @@ log = logging.getLogger(__name__)
 
 lines_parsed = Counter("gateway_lines_parsed")
 lines_failed = Counter("gateway_lines_failed")
+backpressure_waits = Counter("gateway_backpressure_waits")
+
+from filodb_tpu.utils.metrics import Histogram  # noqa: E402
+
+backpressure_seconds = Histogram("gateway_backpressure_seconds")
 
 
 class ContainerSink:
     """Batches records per shard and flushes to the shard logs (reference
-    ``KafkaContainerSink``)."""
+    ``KafkaContainerSink``), with EXPLICIT bounded backpressure (the
+    reference's reactive-streams demand signalling, SURVEY §2 P7): at most
+    one flush is in flight; producers keep batching into the pending
+    container while it drains, and once ``max_pending`` records are
+    buffered ``add`` BLOCKS the producer thread — TCP then pushes back to
+    the client — until the flush completes. Wait counts/durations surface
+    as ``gateway_backpressure_*`` metrics."""
 
     def __init__(self, logs: dict[int, ReplayLog], num_shards: int,
-                 spread: int = 1, flush_every: int = 512):
+                 spread: int = 1, flush_every: int = 512,
+                 max_pending: int = 16384):
         self.logs = logs
         self.num_shards = num_shards
         self.spread = spread
         self.flush_every = flush_every
+        self.max_pending = max(max_pending, flush_every)
         self._pending = RecordContainer()
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._flushing = False
 
     def add(self, records) -> None:
-        with self._lock:
-            for r in records:
-                self._pending.add(r)
-            if len(self._pending) >= self.flush_every:
-                self._flush_locked()
+        t0 = None
+        while True:
+            batch = None
+            inserted = False
+            with self._cond:
+                if len(self._pending) < self.max_pending:
+                    for r in records:
+                        self._pending.add(r)
+                    inserted = True
+                    if len(self._pending) >= self.flush_every \
+                            and not self._flushing:
+                        batch = self._pending
+                        self._pending = RecordContainer()
+                        self._flushing = True
+                elif not self._flushing:
+                    # buffer full and nobody draining: this producer takes
+                    # the drain, then retries its own insert
+                    batch = self._pending
+                    self._pending = RecordContainer()
+                    self._flushing = True
+                else:
+                    # full AND a drain is in flight: BLOCK (TCP pushes the
+                    # pressure back to the client)
+                    if t0 is None:
+                        t0 = time.perf_counter()
+                        backpressure_waits.inc()
+                    self._cond.wait(timeout=5.0)
+            if batch is not None:
+                self._drain(batch)
+            if inserted:
+                if t0 is not None:
+                    backpressure_seconds.observe(time.perf_counter() - t0)
+                return
 
     def flush(self) -> None:
-        with self._lock:
-            self._flush_locked()
+        while True:
+            with self._cond:
+                while self._flushing:
+                    self._cond.wait(timeout=5.0)
+                if not len(self._pending):
+                    return
+                batch = self._pending
+                self._pending = RecordContainer()
+                self._flushing = True
+            self._drain(batch)
 
-    def _flush_locked(self) -> None:
-        if not len(self._pending):
-            return
-        for shard, cont in route_container(self._pending, self.num_shards,
-                                           self.spread).items():
-            self.logs[shard].append(cont)
-        self._pending = RecordContainer()
+    def _drain(self, batch: RecordContainer) -> None:
+        """Append one owned batch to the shard logs, outside the lock —
+        parsing threads keep batching while IO is in flight. The
+        ``_flushing`` guard keeps appends serialized in batch-swap order,
+        so per-shard record order is preserved (a reordered append would
+        trip the shards' out-of-order drop)."""
+        try:
+            for shard, cont in route_container(batch, self.num_shards,
+                                               self.spread).items():
+                self.logs[shard].append(cont)
+        finally:
+            with self._cond:
+                self._flushing = False
+                self._cond.notify_all()
 
 
 class GatewayServer:
