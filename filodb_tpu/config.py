@@ -22,6 +22,7 @@ DEFAULTS = {
     "wal_server_port": 0,         # serve this node's WAL over TCP (broker)
     "wal_remote": None,           # "host:port" — use a remote log server
     "wal_kafka": None,            # "host:port" — external Kafka broker WAL
+    "consul": None,               # {"host","port","service"} seed discovery
     "store_server_port": 0,       # serve this node's column store over TCP
     "store_remote": None,         # "host:port" — use a remote chunk store
     "http_port": 8080,
@@ -61,6 +62,7 @@ class ServerConfig:
     wal_server_port: int = 0    # serve this node's WAL over TCP (broker)
     wal_remote: str | None = None  # "host:port" — use a remote log server
     wal_kafka: str | None = None  # "host:port" — external Kafka broker
+    consul: dict | None = None    # Consul seed discovery settings
     store_server_port: int = 0    # serve the column store over TCP
     store_remote: str | None = None  # "host:port" — remote chunk store
     http_port: int = 8080
@@ -105,6 +107,7 @@ class ServerConfig:
             wal_server_port=cfg.get("wal_server_port", 0),
             wal_remote=cfg.get("wal_remote"),
             wal_kafka=cfg.get("wal_kafka"),
+            consul=cfg.get("consul"),
             store_server_port=cfg.get("store_server_port", 0),
             store_remote=cfg.get("store_remote"),
             http_port=cfg["http_port"],

@@ -170,6 +170,27 @@ class FiloServer:
                 "join": self._handle_join,
             }).start()
         self.node.executor_port = self.executor.port
+        self._consul = None
+        if cfg.consul:
+            # Consul-backed seed discovery (reference akka-bootstrapper
+            # Consul strategy): resolve seeds from the passing-health
+            # listing; the FIRST registered node (or ourselves, if the
+            # listing is empty) becomes the coordinator. Register after
+            # role resolution so we don't discover ourselves.
+            from filodb_tpu.coordinator.bootstrap import ConsulDiscovery
+            self._consul = ConsulDiscovery(
+                host=cfg.consul.get("host", "127.0.0.1"),
+                port=int(cfg.consul.get("port", 8500)),
+                service_name=cfg.consul.get("service", "filodb"))
+            if not cfg.seeds:
+                found = self._consul.discover()
+                # exclude our own previous registration (restart case);
+                # an empty remainder means we form the cluster
+                cfg.seeds = [f"{h}:{p}" for h, p in found
+                             if not (h in ("127.0.0.1", "localhost")
+                                     and p == cfg.executor_port
+                                     and cfg.executor_port)]
+                log.info("consul discovery: seeds=%s", cfg.seeds)
         services = {}
         if cfg.seeds:
             # member role: register with the coordinator; shard assignments
@@ -256,6 +277,12 @@ class FiloServer:
             self.profiler = SimpleProfiler().start()
         if cfg.enable_failover:
             self._setup_failover()
+        if self._consul is not None:
+            try:
+                self._consul.register(cfg.node_name, "127.0.0.1",
+                                      self.executor.port)
+            except OSError as e:
+                log.warning("consul register failed: %s", e)
         if cfg.downsample and not cfg.seeds:
             self._setup_downsampling(services)
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
@@ -454,6 +481,11 @@ class FiloServer:
             l.close()
         if getattr(self, "log_server", None) is not None:
             self.log_server.stop()  # broker role: port, thread, open logs
+        if getattr(self, "_consul", None) is not None:
+            try:
+                self._consul.deregister(self.config.node_name)
+            except OSError:
+                pass
         if self.store_server is not None:
             self.store_server.shutdown()
         self.column_store.close()

@@ -222,3 +222,134 @@ def test_singleton_failover(tmp_path):
             coord_proc.kill()
         if member is not None:
             member.shutdown()
+
+
+def test_deployment_matrix_consul_remote_store_networked_wal(tmp_path):
+    """Full round-5 deployment shape in one cluster: Consul seed discovery
+    (no explicit seeds anywhere), a remote chunk-store tier shared by both
+    nodes, and the networked WAL broker — zero shared filesystem. Ingest
+    crosses processes; the durability tier survives a member restart."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__))))
+    from test_consul_discovery import FakeConsulAgent
+
+    from filodb_tpu.core.store.remotestore import (
+        ChunkStoreServer,
+        RemoteColumnStore,
+    )
+
+    consul = FakeConsulAgent().start()
+    tier = ChunkStoreServer(root=str(tmp_path / "tier")).start()
+    try:
+        exec_port = _free_port()
+        wal_port = _free_port()
+        coord_cfg = {
+            "node_name": "coord", "data_dir": str(tmp_path / "coord"),
+            "http_port": 0, "gateway_port": _free_port(),
+            "executor_port": exec_port,
+            "wal_server_port": wal_port,
+            "store_remote": f"127.0.0.1:{tier.port}",
+            "consul": {"host": "127.0.0.1", "port": consul.port,
+                       "service": "filodb"},
+            "datasets": {"timeseries": {
+                "num_shards": 4, "min_num_nodes": 2, "spread": 1,
+                "store": {"max_chunk_size": 50, "groups_per_shard": 2}}},
+        }
+        member_cfg = dict(coord_cfg)
+        member_cfg.update({
+            "node_name": "member-1", "data_dir": str(tmp_path / "member"),
+            "http_port": 0, "gateway_port": 0, "executor_port": 0,
+            "wal_server_port": 0,
+            "wal_remote": f"127.0.0.1:{wal_port}",
+        })
+        cfg_path = tmp_path / "coord.json"
+        cfg_path.write_text(json.dumps(coord_cfg))
+        member_path = tmp_path / "member.json"
+        member_path.write_text(json.dumps(member_cfg))
+
+        coord = FiloServer(ServerConfig.load(str(cfg_path))).start()
+        # the coordinator registered itself; the member discovers it via
+        # Consul — its config carries NO seed list
+        assert "coord" in consul.services
+        member = subprocess.Popen(
+            [sys.executable, "-m", "filodb_tpu.standalone", "--config",
+             str(member_path)],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            cwd="/root/repo", stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            deadline = time.monotonic() + 90
+            sm = coord.cluster.shard_managers["timeseries"]
+            while time.monotonic() < deadline:
+                owners = set(filter(None, sm.mapper.owners))
+                if owners == {"coord", "member-1"}:
+                    break
+                assert member.poll() is None, member.stdout.read()[-3000:]
+                time.sleep(0.2)
+            assert set(filter(None, sm.mapper.owners)) == \
+                {"coord", "member-1"}
+
+            with socket.create_connection(
+                    ("127.0.0.1", coord.gateway.port)) as s:
+                for i in range(120):
+                    for inst in range(8):
+                        # distinct _ns_ shard keys spread series over all
+                        # four shards (both nodes own data)
+                        ts_ns = (START + i * 10) * 1_000_000_000
+                        s.sendall(
+                            f"matrix_metric,_ws_=demo,_ns_=App-{inst % 4},"
+                            f"instance=i{inst} value={i} {ts_ns}\n".encode())
+            coord.gateway.sink.flush()
+
+            deadline = time.monotonic() + 60
+            count = 0
+            while time.monotonic() < deadline:
+                body = _get(coord.http.port,
+                            "/promql/timeseries/api/v1/query_range",
+                            query='count(matrix_metric)',
+                            start=START + 1000, end=START + 1000, step=60)
+                res = body["data"]["result"]
+                if res:
+                    count = float(res[0]["values"][0][1])
+                    if count == 8:
+                        break
+                time.sleep(0.3)
+            assert count == 8.0
+
+            # flush the coordinator-owned shards; chunks must land in
+            # the shared REMOTE tier (member shards flush on their own
+            # schedule in the other process)
+            flushed_shards = []
+            expected_keys = 0
+            for sh, owner in enumerate(sm.mapper.owners):
+                if owner == "coord":
+                    shard_obj = coord.memstore.get_shard("timeseries", sh)
+                    shard_obj.flush_all()
+                    flushed_shards.append(sh)
+                    expected_keys += shard_obj.num_partitions
+            assert flushed_shards and expected_keys >= 1
+            probe = RemoteColumnStore("127.0.0.1", tier.port)
+            deadline = time.monotonic() + 30
+            tiered = 0
+            while time.monotonic() < deadline:
+                tiered = sum(
+                    len(probe.scan_part_keys("timeseries", sh))
+                    for sh in flushed_shards)
+                if tiered >= expected_keys:
+                    break
+                time.sleep(0.5)
+            assert tiered >= expected_keys
+            probe.close()
+        finally:
+            member.send_signal(signal.SIGTERM)
+            try:
+                member.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                member.kill()
+            coord.shutdown()
+        # consul: coordinator deregistered on shutdown
+        assert "coord" not in consul.services
+    finally:
+        tier.shutdown()
+        consul.stop()
