@@ -118,14 +118,12 @@ class TestMetricsScrape:
                 ts_ns = (START + i * 10) * 1_000_000_000
                 s.sendall(f"scrape_metric,host=h{i % 5},_ws_=demo,"
                           f"_ns_=App-0 value={i} {ts_ns}\n".encode())
-        deadline = time.monotonic() + 10
+        deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             srv.gateway.sink.flush()
-            text = _scrape(srv.http.port)
-            if "memstore_rows_ingested_total" in text and any(
-                    line.split()[-1] not in ("0", "0.0")
-                    for line in text.splitlines()
-                    if line.startswith("memstore_rows_ingested_total")):
+            ingested = sum(s2.stats.rows_ingested.value
+                           for s2 in srv.memstore.shards_for("timeseries"))
+            if ingested >= 150:  # wait for the FULL batch, not first rows
                 break
             time.sleep(0.3)
         # flush + query so flush/query metric families move too
@@ -169,7 +167,7 @@ class TestMetricsScrape:
                 ts_ns = (START + i * 10) * 1_000_000_000
                 s.sendall(f"fq_metric,host=h1,_ws_=demo,_ns_=App-0 "
                           f"value={i} {ts_ns}\n".encode())
-        deadline = time.monotonic() + 10
+        deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             srv.gateway.sink.flush()
             if any(s2.stats.rows_ingested.value

@@ -69,7 +69,7 @@ def test_two_process_cluster(tmp_path):
         text=True)
     try:
         # wait until both nodes own shards (coordinator assigns on join)
-        deadline = time.monotonic() + 90
+        deadline = time.monotonic() + 180
         sm = coord.cluster.shard_managers["timeseries"]
         while time.monotonic() < deadline:
             owners = set(filter(None, sm.mapper.owners))
@@ -279,7 +279,7 @@ def test_deployment_matrix_consul_remote_store_networked_wal(tmp_path):
             cwd="/root/repo", stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         try:
-            deadline = time.monotonic() + 90
+            deadline = time.monotonic() + 180
             sm = coord.cluster.shard_managers["timeseries"]
             while time.monotonic() < deadline:
                 owners = set(filter(None, sm.mapper.owners))
