@@ -19,16 +19,13 @@ from filodb_tpu.coordinator.ingestion import route_container
 from filodb_tpu.core.record import RecordContainer
 from filodb_tpu.gateway.influx import InfluxParseError, parse_influx_line
 from filodb_tpu.kafka.log import ReplayLog
-from filodb_tpu.utils.metrics import Counter
+from filodb_tpu.utils.metrics import Counter, Histogram
 
 log = logging.getLogger(__name__)
 
 lines_parsed = Counter("gateway_lines_parsed")
 lines_failed = Counter("gateway_lines_failed")
 backpressure_waits = Counter("gateway_backpressure_waits")
-
-from filodb_tpu.utils.metrics import Histogram  # noqa: E402
-
 backpressure_seconds = Histogram("gateway_backpressure_seconds")
 
 
@@ -103,19 +100,30 @@ class ContainerSink:
             self._drain(batch)
 
     def _drain(self, batch: RecordContainer) -> None:
-        """Append one owned batch to the shard logs, outside the lock —
+        """Append owned batches to the shard logs, outside the lock —
         parsing threads keep batching while IO is in flight. The
         ``_flushing`` guard keeps appends serialized in batch-swap order,
         so per-shard record order is preserved (a reordered append would
-        trip the shards' out-of-order drop)."""
-        try:
-            for shard, cont in route_container(batch, self.num_shards,
-                                               self.spread).items():
-                self.logs[shard].append(cont)
-        finally:
+        trip the shards' out-of-order drop). After each drain, a pending
+        buffer that crossed ``flush_every`` mid-drain is taken too —
+        otherwise it would sit unflushed until the next add() (an idle
+        persistent connection could strand records indefinitely)."""
+        while batch is not None:
+            try:
+                for shard, cont in route_container(batch, self.num_shards,
+                                                   self.spread).items():
+                    self.logs[shard].append(cont)
+            finally:
+                with self._cond:
+                    self._flushing = False
+                    self._cond.notify_all()
+            batch = None
             with self._cond:
-                self._flushing = False
-                self._cond.notify_all()
+                if len(self._pending) >= self.flush_every \
+                        and not self._flushing:
+                    batch = self._pending
+                    self._pending = RecordContainer()
+                    self._flushing = True
 
 
 class GatewayServer:

@@ -129,6 +129,17 @@ class FiloServer:
         return ([(e.shard, e.status.name, e.node, e.progress)
                  for e in events], seq, resynced, ep)
 
+    def _handle_role(self):
+        """(role, coord_host, coord_port) — consul bootstrap probes this
+        to find an ESTABLISHED cluster before electing by address. A node
+        still booting answers 'undecided'."""
+        if getattr(self, "is_coordinator", False):
+            return ("coordinator", None, None)
+        ca = getattr(self, "_coord_addr", None)
+        if ca is not None:
+            return ("member", ca[0], ca[1])
+        return ("undecided", None, None)
+
     def _handle_join(self, name: str, host: str, control_port: int):
         """Coordinator side: a remote member joined (reference
         NodeClusterActor member-up). Shard assignment (which calls back to
@@ -168,29 +179,62 @@ class FiloServer:
                 "shard_status": self._handle_shard_status,
                 "shard_events": self._handle_shard_events,
                 "join": self._handle_join,
+                "role": self._handle_role,
             }).start()
         self.node.executor_port = self.executor.port
         self._consul = None
+        self._consul_registered = False
         if cfg.consul:
             # Consul-backed seed discovery (reference akka-bootstrapper
-            # Consul strategy): resolve seeds from the passing-health
-            # listing; the FIRST registered node (or ourselves, if the
-            # listing is empty) becomes the coordinator. Register after
-            # role resolution so we don't discover ourselves.
+            # Consul strategy). Register FIRST, then decide the role:
+            #  - any discovered node answering the "role" control query as
+            #    coordinator (or a member pointing at one) is joined — an
+            #    ESTABLISHED cluster always wins, regardless of boot order;
+            #  - otherwise (everyone racing or unreachable), the lowest
+            #    (host, port) forms the cluster and the rest join it — the
+            #    reference's sorted head-seed election.
             from filodb_tpu.coordinator.bootstrap import ConsulDiscovery
+            from filodb_tpu.coordinator.remote import RemotePlanDispatcher
             self._consul = ConsulDiscovery(
                 host=cfg.consul.get("host", "127.0.0.1"),
                 port=int(cfg.consul.get("port", 8500)),
                 service_name=cfg.consul.get("service", "filodb"))
+            adv = cfg.consul.get("advertise", "127.0.0.1")
+            me = (adv, self.executor.port)
+            try:
+                self._consul.register(cfg.node_name, adv,
+                                      self.executor.port)
+                self._consul_registered = True
+            except OSError as e:
+                log.warning("consul register failed: %s", e)
             if not cfg.seeds:
-                found = self._consul.discover()
-                # exclude our own previous registration (restart case);
-                # an empty remainder means we form the cluster
-                cfg.seeds = [f"{h}:{p}" for h, p in found
-                             if not (h in ("127.0.0.1", "localhost")
-                                     and p == cfg.executor_port
-                                     and cfg.executor_port)]
-                log.info("consul discovery: seeds=%s", cfg.seeds)
+                others = sorted(t for t in self._consul.discover()
+                                if tuple(t) != me)
+                coord_addr = None
+                for h, p in others:
+                    try:
+                        role, ch, cp = RemotePlanDispatcher(h, p).call(
+                            "role")
+                    except (ConnectionError, OSError, RuntimeError):
+                        continue
+                    if role == "coordinator":
+                        coord_addr = (h, p)
+                        break
+                    if role == "member" and ch:
+                        coord_addr = (ch, cp)
+                        break
+                if coord_addr is not None:
+                    cfg.seeds = [f"{coord_addr[0]}:{coord_addr[1]}"]
+                elif others and min(others) < me:
+                    cfg.seeds = [f"{h}:{p}" for h, p in others]
+                # else: we sort lowest (or are alone) -> form the cluster
+                log.info("consul discovery: role=%s seeds=%s",
+                         "member" if cfg.seeds else "coordinator",
+                         cfg.seeds)
+        # role is decided once seeds are final; the "role" control query
+        # (consul bootstrap of later nodes) depends on this being set for
+        # every node, not just failover-enabled ones
+        self.is_coordinator = not cfg.seeds
         services = {}
         if cfg.seeds:
             # member role: register with the coordinator; shard assignments
@@ -204,6 +248,7 @@ class FiloServer:
                         "join", cfg.node_name, "127.0.0.1",
                         self.executor.port)
                     joined = True
+                    self._coord_addr = (host, int(port))
                     break
                 except (ConnectionError, OSError, RuntimeError) as e:
                     log.warning("seed %s unreachable: %s", seed, e)
@@ -277,12 +322,6 @@ class FiloServer:
             self.profiler = SimpleProfiler().start()
         if cfg.enable_failover:
             self._setup_failover()
-        if self._consul is not None:
-            try:
-                self._consul.register(cfg.node_name, "127.0.0.1",
-                                      self.executor.port)
-            except OSError as e:
-                log.warning("consul register failed: %s", e)
         if cfg.downsample and not cfg.seeds:
             self._setup_downsampling(services)
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,

@@ -160,10 +160,15 @@ class TestSinkBackpressure:
         sink.flush()
         rows = [r for c in slowlog.containers for r in c.records]
         assert len(rows) == 240
-        # per-series order preserved (same key everywhere: global order)
-        by_base = {}
+        # per-producer timestamp order preserved across flushed batches
+        # (the single-drain guard exists exactly for this: a reordered
+        # append would trip the shards' out-of-order drop)
+        by_producer: dict[int, list[int]] = {}
         for r in rows:
-            by_base.setdefault(r.timestamp // 1_000_000_000_000, None)
+            producer = (r.timestamp - 1_600_000_000_000) // 1_000_000
+            by_producer.setdefault(producer, []).append(r.timestamp)
+        for ts_list in by_producer.values():
+            assert ts_list == sorted(ts_list)
         # producers actually hit the backpressure wait
         assert backpressure_waits.value > waits0
 
