@@ -99,7 +99,7 @@ def bench_query_hicard():
     keys = counter_series(5000, metric="hicard_total")
     for sd in counter_stream(keys, 60, start_ms=START * 1000, batch=5000):
         shard.ingest(sd)
-    svc = QueryService(ms, "bench", 1, spread=0)
+    svc = QueryService(ms, "bench", 1, spread=0, engine="adaptive")
     q = 'sum(rate(hicard_total[5m]))'
     svc.query_range(q, START + 300, 60, START + 540)  # warm
     n = 20
@@ -124,7 +124,7 @@ def bench_query_and_ingest():
     keys = counter_series(100, metric="qi_total")
     for sd in counter_stream(keys, 720, start_ms=START * 1000):
         shard.ingest(sd)
-    svc = QueryService(ms, "bench", 1, spread=0)
+    svc = QueryService(ms, "bench", 1, spread=0, engine="adaptive")
     q = 'sum(rate(qi_total[5m]))'
     svc.query_range(q, START + 3600, 60, START + 5400)
     stop = threading.Event()
@@ -227,7 +227,7 @@ def bench_hist_query():
     keys = histogram_series(20)
     for sd in histogram_stream(keys, 720, start_ms=START * 1000, batch=2000):
         shard.ingest(sd)
-    svc = QueryService(ms, "bench", 1, spread=0)
+    svc = QueryService(ms, "bench", 1, spread=0, engine="adaptive")
     q = 'histogram_quantile(0.99, sum(rate(http_req_latency[5m])))'
     svc.query_range(q, START + 3600, 60, START + 5400)
     n = 30
