@@ -30,6 +30,16 @@ DEFAULTS = {
     "executor_port": 0,           # plan-shipping server; 0 = ephemeral
     "seeds": [],                  # bootstrap seed addresses
     "enable_failover": False,     # singleton failover via member registry
+    # fault-tolerance knobs (filodb_tpu.utils.resilience.ResilienceConfig);
+    # keys here override that dataclass's defaults at boot
+    "resilience": {
+        "query_timeout_s": 30.0,      # per-query deadline
+        "retry_max_attempts": 2,      # remote dispatch attempts
+        "breaker_failure_threshold": 5,
+        "breaker_reset_s": 10.0,
+        "allow_partial": True,        # degrade instead of fail
+        "partial_max_fraction": 0.5,  # max lost children per gather
+    },
     "datasets": {
         "timeseries": {
             "num_shards": 4,
@@ -77,6 +87,7 @@ class ServerConfig:
     spreads: dict[str, int] = field(default_factory=dict)
     downsample: dict[str, dict] = field(default_factory=dict)
     engines: dict[str, str] = field(default_factory=dict)  # dataset → engine
+    resilience: dict = field(default_factory=dict)  # ResilienceConfig overrides
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -118,7 +129,7 @@ class ServerConfig:
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),
             datasets=datasets, spreads=spreads, downsample=downsample,
-            engines=engines)
+            engines=engines, resilience=cfg.get("resilience", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -16,9 +16,12 @@ from filodb_tpu.promql.parser import TimeStepParams, parse_query
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.exec.plan import ExecContext
 from filodb_tpu.query.model import QueryContext, QueryResult
-from filodb_tpu.utils.metrics import Histogram
+from filodb_tpu.utils.metrics import Histogram, get_counter
+from filodb_tpu.utils.resilience import Deadline
+from filodb_tpu.utils.resilience import config as resilience_config
 
 query_latency = Histogram("query_latency_seconds")
+partial_results = get_counter("filodb_partial_results")
 
 
 @dataclass
@@ -37,6 +40,9 @@ class QueryService:
     # (parallel/adaptive.py) — the default serving posture
     engine: str = "exec"
     mesh: object = None  # jax Mesh override for engine="mesh"
+    # per-query deadline; every socket/HTTP timeout on the distributed
+    # path derives from it (None = resilience-config default)
+    query_timeout_s: float | None = None
     planner: SingleClusterPlanner = field(init=False)
 
     def __post_init__(self):
@@ -187,7 +193,10 @@ class QueryService:
         from filodb_tpu.utils.tracing import span
         with span("plan-materialize"):
             exec_plan = self.planner.materialize(plan, qcontext)
-        ctx = ExecContext(self.memstore, self.dataset, qcontext)
+        timeout_s = self.query_timeout_s if self.query_timeout_s is not None \
+            else resilience_config().query_timeout_s
+        ctx = ExecContext(self.memstore, self.dataset, qcontext,
+                          deadline=Deadline.after(timeout_s))
         with query_latency.time(), span("exec-dispatch"):
             result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
             if materialize:
@@ -200,6 +209,8 @@ class QueryService:
                 ExecPlan._enforce_limits(result.result, qcontext)
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
+        if result.partial:
+            partial_results.inc()
         return result
 
     def _mesh_eligible(self) -> bool:

@@ -27,6 +27,11 @@ import threading
 from filodb_tpu.coordinator.wire import MAX_FRAME, decode, encode
 from filodb_tpu.query.exec.plan import ExecContext, PlanDispatcher
 from filodb_tpu.query.model import QueryContext
+from filodb_tpu.utils.resilience import (
+    FaultInjector,
+    breaker_for,
+    default_retry_policy,
+)
 
 log = logging.getLogger(__name__)
 
@@ -162,26 +167,45 @@ class PlanExecutorServer:
 class RemotePlanDispatcher(PlanDispatcher):
     """Ships a plan subtree to a peer node (the send side of
     ``ActorPlanDispatcher``). One pooled connection per (host, port) per
-    thread."""
+    thread.
+
+    Resilience: the peer's circuit breaker gates every dial (open peer →
+    ``CircuitOpenError`` without touching the network, which scatter-gather
+    tolerates as a lost child); transport failures retry on a fresh socket
+    under the process retry policy (a stale pooled socket — peer restarted —
+    must not fail the first request after reconnect); query dispatch
+    timeouts derive from the query ``Deadline`` on ``ExecContext``."""
 
     _local = threading.local()
 
     __wire_fields__ = ("host", "port", "timeout")
+
+    # transport-failure classes that invalidate the pooled socket. Decode
+    # errors (malformed frame off a half-dead peer) poison the stream the
+    # same way a reset does: the connection must be dropped and redialed.
+    TRANSPORT_ERRORS = (ConnectionError, OSError, EOFError, ValueError)
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host = host
         self.port = port
         self.timeout = timeout
 
-    def _conn(self) -> socket.socket:
+    @property
+    def peer(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _conn(self, timeout: float | None = None) -> socket.socket:
         pool = getattr(self._local, "pool", None)
         if pool is None:
             pool = self._local.pool = {}
         key = (self.host, self.port)
         sock = pool.get(key)
         if sock is None:
-            sock = socket.create_connection((self.host, self.port),
-                                            timeout=self.timeout)
+            FaultInjector.fire("remote.connect", host=self.host,
+                               port=self.port)
+            sock = socket.create_connection(
+                (self.host, self.port),
+                timeout=timeout if timeout is not None else self.timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             secret = cluster_secret()
             if secret is not None:
@@ -192,9 +216,9 @@ class RemotePlanDispatcher(PlanDispatcher):
                     raise ConnectionError("cluster auth rejected")
             pool[key] = sock
         # pooled sockets are shared across dispatcher instances; apply this
-        # dispatcher's timeout (a prior short-timeout ping must not poison a
+        # call's timeout (a prior short-timeout ping must not poison a
         # later long call)
-        sock.settimeout(self.timeout)
+        sock.settimeout(timeout if timeout is not None else self.timeout)
         return sock
 
     def _drop_conn(self):
@@ -206,36 +230,56 @@ class RemotePlanDispatcher(PlanDispatcher):
             except OSError:
                 pass
 
-    def dispatch(self, plan, ctx):
+    def _roundtrip(self, msg: tuple, timeout: float | None = None):
+        """One request/response on the pooled socket; transport failure
+        drops the connection so the next attempt redials."""
         try:
-            sock = self._conn()
-            _send_msg(sock, ("execute", ctx.dataset, plan, ctx.qcontext))
-            resp = _recv_msg(sock)
-        except (ConnectionError, OSError):
+            sock = self._conn(timeout)
+            _send_msg(sock, msg)
+            return _recv_msg(sock)
+        except self.TRANSPORT_ERRORS:
             self._drop_conn()
             raise
+
+    def dispatch(self, plan, ctx):
+        breaker = breaker_for(self.peer)
+        breaker.guard()
+        deadline = getattr(ctx, "deadline", None)
+
+        def attempt():
+            timeout = deadline.timeout(cap=self.timeout,
+                                       what=f"dispatch to {self.peer}") \
+                if deadline is not None else self.timeout
+            FaultInjector.fire("remote.dispatch", host=self.host,
+                               port=self.port)
+            return self._roundtrip(
+                ("execute", ctx.dataset, plan, ctx.qcontext), timeout)
+
+        try:
+            resp = default_retry_policy().call(
+                attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
+        except self.TRANSPORT_ERRORS:
+            breaker.record_failure()
+            raise
+        breaker.record_success()
         if resp[0] == "ok":
             return resp[1]
-        raise RuntimeError(f"remote execution failed: {resp[1]}")
+        raise RuntimeError(
+            f"remote execution failed on {self.peer}: {resp[1]}")
 
     def ping(self) -> bool:
         try:
-            sock = self._conn()
-            _send_msg(sock, ("ping",))
-            return _recv_msg(sock)[0] == "pong"
-        except (ConnectionError, OSError):
-            self._drop_conn()
+            return self._roundtrip(("ping",))[0] == "pong"
+        except self.TRANSPORT_ERRORS:
             return False
 
     def call(self, kind: str, *payload):
-        """Send a control message; returns the handler's response payload."""
-        try:
-            sock = self._conn()
-            _send_msg(sock, (kind, *payload))
-            resp = _recv_msg(sock)
-        except (ConnectionError, OSError):
-            self._drop_conn()
-            raise
+        """Send a control message; returns the handler's response payload.
+        A stale pooled socket (peer restarted between calls) retries once
+        on a fresh connection before surfacing the error."""
+        resp = default_retry_policy().call(
+            lambda: self._roundtrip((kind, *payload)),
+            retry_on=self.TRANSPORT_ERRORS)
         if resp[0] == "ok":
             return resp[1]
         if resp[0] == "pong":

@@ -168,7 +168,12 @@ class ShardManager:
             try:
                 sub(ev)
             except Exception:
-                log.exception("shard event subscriber failed")
+                from filodb_tpu.utils.metrics import get_counter
+                get_counter("filodb_shard_event_errors",
+                            {"dataset": self.dataset}).inc()
+                log.exception("shard event subscriber failed for %s "
+                              "(shard %d -> %s)", self.dataset, ev.shard,
+                              ev.status.name)
         return ev
 
     def events_since(self, since_seq: int, epoch: str | None = None):

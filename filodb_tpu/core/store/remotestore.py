@@ -48,6 +48,7 @@ from filodb_tpu.coordinator.remote import (
 )
 from filodb_tpu.core.store.api import ColumnStore, MetaStore, PartKeyRecord
 from filodb_tpu.memory.chunk import Chunk
+from filodb_tpu.utils.resilience import FaultInjector, breaker_for
 
 log = logging.getLogger(__name__)
 
@@ -222,12 +223,19 @@ class ChunkStoreServer:
 
 
 class _RemoteConn:
-    """One pooled authed connection with reconnect-on-transport-error."""
+    """One pooled authed connection with reconnect-on-transport-error.
+
+    A pooled socket may have gone stale since the previous op (server
+    restart, idle timeout); the first transport failure on a pooled socket
+    is therefore retried once on a fresh connection before surfacing. The
+    peer's circuit breaker short-circuits calls while the store is down.
+    """
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host = host
         self.port = port
         self.timeout = timeout
+        self.peer = f"{host}:{port}"
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
 
@@ -245,20 +253,40 @@ class _RemoteConn:
             self._sock = s
         return self._sock
 
-    def call(self, *msg):
-        with self._lock:
+    def _drop(self) -> None:
+        if self._sock is not None:
             try:
-                sock = self._conn()
-                _send_msg(sock, msg)
-                resp = _recv_msg(sock)
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, msg):
+        FaultInjector.fire("store.call", host=self.host, port=self.port,
+                           op=msg[0])
+        sock = self._conn()
+        _send_msg(sock, msg)
+        return _recv_msg(sock)
+
+    def call(self, *msg):
+        breaker = breaker_for(self.peer)
+        breaker.guard()
+        with self._lock:
+            pooled = self._sock is not None
+            try:
+                try:
+                    resp = self._roundtrip(msg)
+                except (ConnectionError, OSError):
+                    self._drop()
+                    if not pooled:
+                        raise
+                    # stale pooled socket: one retry on a fresh connection
+                    resp = self._roundtrip(msg)
             except (ConnectionError, OSError):
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
+                self._drop()
+                breaker.record_failure()
                 raise
+        breaker.record_success()
         if resp[0] == "ok":
             return resp[1]
         if resp[0] == "pong":

@@ -57,6 +57,29 @@ def _stats_json(result: QueryResult) -> dict:
             "wallTimeMs": round(s.wall_time_s * 1000.0, 3)}
 
 
+def _partial_fields(result: QueryResult) -> dict:
+    """``partial``/``warnings`` response fields for a degraded scatter-gather
+    result (Prom API ``warnings`` convention); empty when complete."""
+    if not getattr(result, "partial", False) \
+            and not getattr(result, "warnings", None):
+        return {}
+    out = {}
+    if result.partial:
+        out["partial"] = True
+    if result.warnings:
+        out["warnings"] = list(result.warnings)
+    return out
+
+
+def _partial_fields_str(result: QueryResult) -> str:
+    """String-renderer form of :func:`_partial_fields` — ``""`` or a
+    leading-comma fragment to splice before the closing brace."""
+    fields = _partial_fields(result)
+    if not fields:
+        return ""
+    return "," + json.dumps(fields, separators=(",", ":"))[1:-1]
+
+
 def matrix_json(result: QueryResult) -> dict:
     m = result.result
     if m.is_histogram:
@@ -73,7 +96,8 @@ def matrix_json(result: QueryResult) -> dict:
             series.append({"metric": _labels_json(key), "values": vals})
     return {"status": "success",
             "data": {"resultType": "matrix", "result": series},
-            "queryStats": _stats_json(result)}
+            "queryStats": _stats_json(result),
+            **_partial_fields(result)}
 
 
 def _labels_json_str(key) -> str:
@@ -122,7 +146,8 @@ def matrix_json_str(result: QueryResult) -> str:
                      % (_labels_json_str(key), body))
     stats = json.dumps(_stats_json(result), separators=(",", ":"))
     return ('{"status":"success","data":{"resultType":"matrix","result":[%s'
-            ']},"queryStats":%s}' % (",".join(parts), stats))
+            ']},"queryStats":%s%s}' % (",".join(parts), stats,
+                                       _partial_fields_str(result)))
 
 
 def vector_json_str(result: QueryResult) -> str:
@@ -133,7 +158,7 @@ def vector_json_str(result: QueryResult) -> str:
     m.materialize()
     if not m.num_steps or not m.num_series:
         return ('{"status":"success","data":{"resultType":"vector",'
-                '"result":[]}}')
+                '"result":[]}%s}' % _partial_fields_str(result))
     k = m.num_steps - 1
     vals = np.asarray(m.values[:, k], np.float64)
     ok = ~np.isnan(vals)
@@ -144,7 +169,7 @@ def vector_json_str(result: QueryResult) -> str:
                                              t, sv[i])
         for i in np.flatnonzero(ok).tolist()]
     return ('{"status":"success","data":{"resultType":"vector","result":'
-            '[%s]}}' % ",".join(parts))
+            '[%s]}%s}' % (",".join(parts), _partial_fields_str(result)))
 
 
 def vector_json(result: QueryResult) -> dict:
@@ -159,7 +184,8 @@ def vector_json(result: QueryResult) -> dict:
             out.append({"metric": _labels_json(key),
                         "value": [m.steps_ms[k] / 1000.0, _fmt(v)]})
     return {"status": "success",
-            "data": {"resultType": "vector", "result": out}}
+            "data": {"resultType": "vector", "result": out},
+            **_partial_fields(result)}
 
 
 def scalar_json(result: QueryResult) -> dict:

@@ -34,6 +34,7 @@ from filodb_tpu.http import promjson
 from filodb_tpu.promql.parser import ParseError, TimeStepParams, parse_query
 from filodb_tpu.query.model import QueryLimitExceeded
 from filodb_tpu.utils.metrics import render_prometheus
+from filodb_tpu.utils.resilience import DeadlineExceeded
 
 log = logging.getLogger(__name__)
 
@@ -137,6 +138,8 @@ class HttpDispatcher:
             return self._json(400, promjson.error_json(str(e)))
         except QueryLimitExceeded as e:
             return self._json(422, promjson.error_json(str(e), "query_limit"))
+        except DeadlineExceeded as e:
+            return self._json(503, promjson.error_json(str(e), "timeout"))
         except Exception as e:  # pragma: no cover
             log.exception("request failed")
             return self._json(500, promjson.error_json(str(e), "internal"))

@@ -139,7 +139,13 @@ class LogServer:
                 return ("ok", True)
             return ("err", f"unknown message {kind!r}")
         except Exception as e:
-            log.exception("log op %s failed", kind)
+            from filodb_tpu.utils.metrics import get_counter
+            topic = "?"
+            if len(msg) >= 3 and isinstance(msg[1], str):
+                topic = f"{msg[1]}/{msg[2]}"
+            get_counter("filodb_log_server_errors",
+                        {"op": str(kind), "topic": topic}).inc()
+            log.exception("log op %s failed for topic %s", kind, topic)
             return ("err", repr(e))
 
     def start(self) -> "LogServer":

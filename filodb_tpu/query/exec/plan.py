@@ -66,6 +66,12 @@ class ExecContext:
     dataset: str
     qcontext: QueryContext = field(default_factory=QueryContext)
     stats: QueryStats = field(default_factory=QueryStats)
+    # per-query deadline (utils.resilience.Deadline); every downstream
+    # socket/HTTP timeout on the distributed path derives from it
+    deadline: object = None
+    # partial scatter-gather state, accumulated by NonLeafExecPlan.gather
+    partial: bool = False
+    warnings: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -94,7 +100,8 @@ class ExecPlan:
         if isinstance(data.values, np.ndarray) \
                 and not getattr(data, "_pending_compact", False):
             self._enforce_limits(data, ctx.qcontext)
-        return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
+        return QueryResult(data, ctx.stats, ctx.qcontext.query_id,
+                           partial=ctx.partial, warnings=list(ctx.warnings))
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:
         raise NotImplementedError
@@ -231,7 +238,8 @@ class SelectRawPartitionsExec(ExecPlan):
         if isinstance(data.values, np.ndarray) \
                 and not getattr(data, "_pending_compact", False):
             self._enforce_limits(data, ctx.qcontext)
-        return QueryResult(data, ctx.stats, ctx.qcontext.query_id)
+        return QueryResult(data, ctx.stats, ctx.qcontext.query_id,
+                           partial=ctx.partial, warnings=list(ctx.warnings))
 
     def _use_device_path(self, shard, schema, col) -> bool:
         """Decode-on-device path: enabled per store config, for scalar float
@@ -271,6 +279,18 @@ class EmptyResultExec(ExecPlan):
 # ---------------------------------------------------------------------------
 # non-leaves
 
+def plan_shards(plan: ExecPlan) -> list[int]:
+    """All shard numbers a subtree reads — names the lost data in partial-
+    result warnings."""
+    out = set()
+    shard = getattr(plan, "shard", None)
+    if shard is not None:
+        out.add(shard)
+    for c in plan.children():
+        out.update(plan_shards(c))
+    return sorted(out)
+
+
 @dataclass
 class NonLeafExecPlan(ExecPlan):
     children_plans: list[ExecPlan] = field(default_factory=list)
@@ -278,9 +298,97 @@ class NonLeafExecPlan(ExecPlan):
     def children(self):
         return self.children_plans
 
+    # child failures tolerated as partial results: transport-level losses
+    # (dead peer, reset connection, open breaker, socket timeout). A
+    # deterministic remote error or limit violation still fails the query.
+    TOLERABLE = (ConnectionError, OSError, TimeoutError)
+
     def gather(self, ctx) -> list[StepMatrix]:
-        return [c.dispatcher.dispatch(c, ctx).result
-                for c in self.children_plans]
+        """Dispatch children concurrently and tolerate per-child failure
+        below the configured threshold (reference: HA scatter-gather
+        routes around lost peers instead of failing the query)."""
+        from filodb_tpu.utils.resilience import (
+            DeadlineExceeded,
+            FaultInjector,
+            config,
+        )
+        children = self.children_plans
+        if ctx.deadline is not None:
+            ctx.deadline.check(type(self).__name__ + ".gather")
+
+        def run(i, c):
+            FaultInjector.fire("gather.child", index=i,
+                               shards=plan_shards(c), plan=c)
+            return c.dispatcher.dispatch(c, ctx)
+
+        # concurrency pays only when children leave the process; local
+        # children keep the serial path (no thread hop on the hot path)
+        remote = any(not isinstance(c.dispatcher, InProcessPlanDispatcher)
+                     for c in children)
+        outcomes: list = [None] * len(children)
+        if remote and len(children) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            # per-gather pool: a shared bounded pool deadlocks on nested
+            # gathers (parents hold workers while waiting on children)
+            with ThreadPoolExecutor(
+                    max_workers=min(len(children), 16),
+                    thread_name_prefix="gather") as ex:
+                futs = [ex.submit(run, i, c)
+                        for i, c in enumerate(children)]
+                for i, f in enumerate(futs):
+                    try:
+                        outcomes[i] = (True, f.result())
+                    except Exception as e:  # noqa: BLE001 — sorted below
+                        outcomes[i] = (False, e)
+        else:
+            for i, c in enumerate(children):
+                try:
+                    outcomes[i] = (True, run(i, c))
+                except Exception as e:  # noqa: BLE001 — sorted below
+                    outcomes[i] = (False, e)
+
+        rc = config()
+        pp = ctx.qcontext.planner_params
+        allow_partial = pp.allow_partial if pp.allow_partial is not None \
+            else rc.allow_partial
+        max_frac = pp.max_partial_fraction \
+            if pp.max_partial_fraction is not None \
+            else rc.partial_max_fraction
+
+        mats, failures = [], []
+        for i, (ok, payload) in enumerate(outcomes):
+            if ok:
+                result = payload
+                # a remote subtree may itself be partial: merge upward.
+                # An in-process child shares THIS ctx, so its warnings are
+                # already here — only genuinely new ones are added.
+                if getattr(result, "partial", False):
+                    ctx.partial = True
+                    ctx.warnings.extend(w for w in result.warnings
+                                        if w not in ctx.warnings)
+                mats.append(result.result)
+                continue
+            err = payload
+            if isinstance(err, DeadlineExceeded) or not allow_partial \
+                    or not isinstance(err, self.TOLERABLE):
+                raise err
+            failures.append((i, plan_shards(children[i]), err))
+
+        if failures:
+            if len(failures) / len(children) > max_frac:
+                lost = sorted({s for _, shards, _ in failures
+                               for s in shards})
+                raise failures[0][2].__class__(
+                    f"{len(failures)}/{len(children)} scatter-gather "
+                    f"children failed (> partial threshold {max_frac}); "
+                    f"lost shards {lost}: {failures[0][2]}")
+            ctx.partial = True
+            for i, shards, err in failures:
+                ctx.warnings.append(
+                    f"partial result: child {i} "
+                    f"(shards {shards or 'n/a'}) lost: "
+                    f"{type(err).__name__}: {err}")
+        return mats
 
 
 @dataclass

@@ -9,6 +9,7 @@ the internal result form.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
@@ -18,6 +19,11 @@ import numpy as np
 from filodb_tpu.query.exec.plan import ExecPlan
 from filodb_tpu.query.exec.transformers import steps_array
 from filodb_tpu.query.model import RangeVectorKey, StepMatrix
+from filodb_tpu.utils.resilience import (
+    FaultInjector,
+    RemoteQueryError,
+    breaker_for,
+)
 
 
 @dataclass
@@ -27,7 +33,7 @@ class PromQlRemoteExec(ExecPlan):
     start: int = 0            # ms
     step: int = 60_000
     end: int = 0
-    timeout_s: float = 30.0
+    timeout_s: float = 30.0   # cap; the query Deadline shortens it
 
     def do_execute(self, ctx) -> StepMatrix:
         qs = urllib.parse.urlencode({
@@ -37,10 +43,33 @@ class PromQlRemoteExec(ExecPlan):
             "step": max(self.step // 1000, 1),
         })
         url = f"{self.endpoint}/api/v1/query_range?{qs}"
-        with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-            body = json.load(r)
+        breaker = breaker_for(self.endpoint)
+        breaker.guard()
+        deadline = getattr(ctx, "deadline", None)
+        timeout = deadline.timeout(cap=self.timeout_s,
+                                   what=f"remote exec {self.endpoint}") \
+            if deadline is not None else self.timeout_s
+        try:
+            FaultInjector.fire("promql.remote", endpoint=self.endpoint)
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                body = json.load(r)
+        except urllib.error.HTTPError as e:
+            # tag with the endpoint instead of leaking a raw urllib
+            # traceback; an HTTP status is the remote ANSWERING — not a
+            # transport failure, so the breaker stays closed
+            raise RemoteQueryError(
+                f"remote query to {self.endpoint} failed: "
+                f"HTTP {e.code} {e.reason}") from e
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            breaker.record_failure()
+            reason = getattr(e, "reason", e)
+            raise ConnectionError(
+                f"remote query to {self.endpoint} unreachable: "
+                f"{reason}") from e
+        breaker.record_success()
         if body.get("status") != "success":
-            raise RuntimeError(f"remote query failed: {body}")
+            raise RemoteQueryError(
+                f"remote query to {self.endpoint} failed: {body}")
         return self._from_matrix_json(body["data"])
 
     def _from_matrix_json(self, data) -> StepMatrix:

@@ -192,6 +192,11 @@ class QueryResult:
     result: StepMatrix
     stats: QueryStats = field(default_factory=QueryStats)
     query_id: str = ""
+    # partial scatter-gather: some children were lost below the failure
+    # threshold (reference HA semantics: degrade, don't fail); the Prom
+    # JSON encoder surfaces these as "partial" + "warnings" fields
+    partial: bool = False
+    warnings: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -205,6 +210,12 @@ class PlannerParams:
     enforce_sample_limit: bool = True
     shard_overrides: list[int] | None = None
     process_failure: bool = True
+    # partial scatter-gather tolerance: when True, a gather tolerates
+    # child failures up to max_partial_fraction of its children and marks
+    # the result partial; above the threshold the query fails. None defers
+    # to the process-wide resilience config defaults.
+    allow_partial: bool | None = None
+    max_partial_fraction: float | None = None
 
 
 @dataclass

@@ -33,6 +33,9 @@ log = logging.getLogger(__name__)
 class FiloServer:
     def __init__(self, config: ServerConfig):
         self.config = config
+        if config.resilience:
+            from filodb_tpu.utils import resilience
+            resilience.configure(**config.resilience)
         os.makedirs(config.data_dir, exist_ok=True)
         self.store_server = None
         if config.store_remote:

@@ -105,6 +105,20 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0)
 
 
+def get_counter(name: str, tags: dict[str, str] | None = None) -> Counter:
+    """Idempotent counter lookup: error-path call sites (flush loops,
+    protocol handlers) increment per-(name, tags) counters without each
+    having to hold a module-level instance — re-registering would reset the
+    running value."""
+    t = ",".join(f"{k}={v}" for k, v in sorted((tags or {}).items()))
+    key = f"{name}{{{t}}}"
+    with _lock:
+        m = _registry.get(key)
+    if isinstance(m, Counter):
+        return m
+    return Counter(name, tags)
+
+
 def render_prometheus() -> str:
     """Expose all metrics in Prometheus text format."""
     lines = []

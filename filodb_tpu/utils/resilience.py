@@ -1,0 +1,391 @@
+"""Resilience primitives for the distributed query path.
+
+Counterpart of the reference's fault-tolerance substrate: Akka supervision +
+phi-accrual failure detection tolerate lost peers (``ShardManager.scala:28``),
+``HighAvailabilityPlanner`` routes around known failures, and queries carry
+a submit-time deadline. Here the same properties are provided as explicit,
+injectable primitives threaded through the exec tree:
+
+- :class:`Deadline` — one per query; every downstream socket/HTTP timeout on
+  the distributed path derives from it instead of a hard-coded constant.
+- :class:`RetryPolicy` — exponential backoff + jitter with a retry budget;
+  clock and sleep are injectable so tests never sleep on the wall clock.
+- :class:`CircuitBreaker` — per-peer closed/open/half-open breaker; open
+  peers are skipped (the scatter-gather treats them as lost children).
+- :class:`FaultInjector` — a process-global registry of named fault sites;
+  tests arm connection errors, slow responses and malformed frames at
+  instrumented call sites to exercise the failure paths deterministically.
+
+Metrics exported through ``utils.metrics``: ``filodb_query_retries_total``,
+``filodb_breaker_state`` (0=closed, 1=half-open, 2=open, per peer) and
+``filodb_partial_results_total``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from filodb_tpu.utils.metrics import Gauge, get_counter
+
+# ---------------------------------------------------------------------------
+# errors
+
+
+class DeadlineExceeded(TimeoutError):
+    """The query's deadline expired (reference: query timeout in
+    ``QueryContext``/actor ask timeouts)."""
+
+
+class CircuitOpenError(ConnectionError):
+    """The peer's circuit breaker is open — the call was skipped without
+    dialing. Subclasses ConnectionError so scatter-gather treats a skipped
+    peer exactly like a lost one (partial result below the threshold)."""
+
+
+class RemoteQueryError(RuntimeError):
+    """A remote endpoint answered with an error (tagged with the endpoint,
+    not a raw transport traceback)."""
+
+
+# ---------------------------------------------------------------------------
+# deadline
+
+
+@dataclass
+class Deadline:
+    """Absolute per-query deadline on an injectable monotonic clock.
+
+    Created once per query (``QueryService``), carried on ``ExecContext``;
+    every socket/HTTP timeout on the distributed path is derived from the
+    remaining time via :meth:`timeout`.
+    """
+
+    deadline_s: float  # absolute instant on ``clock``
+    clock: "callable" = time.monotonic
+
+    @classmethod
+    def after(cls, timeout_s: float, clock=time.monotonic) -> "Deadline":
+        return cls(clock() + timeout_s, clock)
+
+    def remaining(self) -> float:
+        return self.deadline_s - self.clock()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def timeout(self, cap: float | None = None, what: str = "") -> float:
+        """Remaining seconds, optionally capped — the value to hand to a
+        socket/HTTP call. Raises :class:`DeadlineExceeded` when nothing
+        remains, so an exhausted query fails before dialing."""
+        rem = self.remaining()
+        if rem <= 0:
+            raise DeadlineExceeded(
+                f"query deadline exceeded{' before ' + what if what else ''}"
+                f" ({-rem:.3f}s past)")
+        return min(rem, cap) if cap is not None else rem
+
+    def check(self, what: str = "") -> None:
+        if self.expired:
+            raise DeadlineExceeded(
+                f"query deadline exceeded{' in ' + what if what else ''}")
+
+
+# ---------------------------------------------------------------------------
+# retry
+
+_retries_total = get_counter("filodb_query_retries")
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff + full jitter with a total-sleep budget.
+
+    ``sleep``/``rng`` are injectable: deterministic tests pass a recording
+    sleep and a fixed rng, so no test ever waits on the wall clock.
+    """
+
+    max_attempts: int = 3
+    base_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5          # fraction of the backoff randomized
+    budget_s: float | None = None  # cap on total sleep across attempts
+    sleep: "callable" = time.sleep
+    rng: "callable" = random.random
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        raw = min(self.base_backoff_s * (self.multiplier ** (attempt - 1)),
+                  self.max_backoff_s)
+        return raw * (1.0 - self.jitter + self.jitter * self.rng())
+
+    def call(self, fn, retry_on: tuple = (ConnectionError, OSError),
+             deadline: Deadline | None = None, on_retry=None, site: str = ""):
+        """Run ``fn`` with retries. Retries stop when attempts or the sleep
+        budget are exhausted, or when the deadline can no longer cover the
+        next backoff."""
+        slept = 0.0
+        attempt = 1
+        while True:
+            try:
+                return fn()
+            except retry_on as e:
+                if isinstance(e, (CircuitOpenError, DeadlineExceeded)):
+                    raise  # never retry a skip/timeout decision
+                delay = self.backoff(attempt)
+                out_of_attempts = attempt >= self.max_attempts
+                out_of_budget = (self.budget_s is not None
+                                 and slept + delay > self.budget_s)
+                out_of_time = (deadline is not None
+                               and deadline.remaining() <= delay)
+                if out_of_attempts or out_of_budget or out_of_time:
+                    raise
+                _retries_total.inc()
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self.sleep(delay)
+                slept += delay
+                attempt += 1
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+
+CLOSED, HALF_OPEN, OPEN = "closed", "half-open", "open"
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    """Per-peer closed/open/half-open breaker.
+
+    Closed: calls flow; consecutive failures >= ``failure_threshold`` opens
+    it. Open: calls are skipped (:class:`CircuitOpenError`) until
+    ``reset_timeout_s`` elapses, then one probe is admitted (half-open).
+    Half-open: the probe's success closes the breaker; its failure re-opens
+    it for another ``reset_timeout_s``.
+    """
+
+    def __init__(self, key: str, failure_threshold: int = 5,
+                 reset_timeout_s: float = 10.0, clock=time.monotonic):
+        self.key = key
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        self._gauge = Gauge("filodb_breaker_state", {"peer": key})
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> str:
+        if self._state == OPEN and \
+                self.clock() - self._opened_at >= self.reset_timeout_s:
+            self._state = HALF_OPEN
+            self._probing = False
+            self._gauge.set(_STATE_VALUE[HALF_OPEN])
+        return self._state
+
+    @property
+    def is_open(self) -> bool:
+        return self.state == OPEN
+
+    def allow(self) -> bool:
+        """Whether a call may proceed now. In half-open, only a single
+        probe is admitted until it reports back."""
+        with self._lock:
+            st = self._effective_state()
+            if st == CLOSED:
+                return True
+            if st == HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def guard(self) -> None:
+        if not self.allow():
+            raise CircuitOpenError(
+                f"circuit breaker open for peer {self.key}")
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != CLOSED:
+                self._state = CLOSED
+                self._gauge.set(_STATE_VALUE[CLOSED])
+
+    def force_open(self) -> None:
+        """Open immediately — used by the cluster failure detector when a
+        peer is declared down, so queries skip it without paying a connect
+        timeout first."""
+        with self._lock:
+            self._state = OPEN
+            self._opened_at = self.clock()
+            self._probing = False
+            self._gauge.set(_STATE_VALUE[OPEN])
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._state == HALF_OPEN \
+                    or self._failures >= self.failure_threshold:
+                self._state = OPEN
+                self._opened_at = self.clock()
+                self._gauge.set(_STATE_VALUE[OPEN])
+
+
+_breakers: dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker_for(key: str, **defaults) -> CircuitBreaker:
+    """Process-global per-peer breaker registry (one breaker per peer,
+    shared by every dispatcher/connection that talks to it)."""
+    with _breakers_lock:
+        b = _breakers.get(key)
+        if b is None:
+            cfg = dict(config().breaker_defaults)
+            cfg.update(defaults)
+            b = _breakers[key] = CircuitBreaker(key, **cfg)
+        return b
+
+
+def reset_breakers() -> None:
+    """Drop all breaker state (tests)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+# ---------------------------------------------------------------------------
+# process-wide resilience config (defaults; overridable via config.py)
+
+
+@dataclass
+class ResilienceConfig:
+    query_timeout_s: float = 30.0
+    retry_max_attempts: int = 2        # 1 retry on a fresh socket
+    retry_base_backoff_s: float = 0.02
+    retry_max_backoff_s: float = 1.0
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 10.0
+    partial_max_fraction: float = 0.5  # children allowed to fail per gather
+    allow_partial: bool = True
+
+    @property
+    def breaker_defaults(self) -> dict:
+        return {"failure_threshold": self.breaker_failure_threshold,
+                "reset_timeout_s": self.breaker_reset_s}
+
+
+_config = ResilienceConfig()
+
+
+def config() -> ResilienceConfig:
+    return _config
+
+
+def configure(**kw) -> ResilienceConfig:
+    """Apply server-config overrides (``config.py`` ``resilience`` block)."""
+    for k, v in kw.items():
+        if hasattr(_config, k):
+            setattr(_config, k, v)
+    return _config
+
+
+def default_retry_policy(**kw) -> RetryPolicy:
+    c = _config
+    base = dict(max_attempts=c.retry_max_attempts,
+                base_backoff_s=c.retry_base_backoff_s,
+                max_backoff_s=c.retry_max_backoff_s)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+
+
+@dataclass
+class Fault:
+    """One armed fault: raise ``error`` and/or delay, ``times`` times, at a
+    named site, optionally filtered by a ``match`` predicate over the
+    site's context kwargs."""
+
+    error: "BaseException | type | None" = None
+    delay_s: float = 0.0
+    times: int | None = None      # None = unlimited
+    match: "callable | None" = None
+    sleep: "callable" = time.sleep
+    fired: int = 0                # observability for tests
+
+    def _applies(self, ctx: dict) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.match is None or bool(self.match(ctx))
+
+
+class FaultInjector:
+    """Process-global registry of named fault sites.
+
+    Production code calls ``FaultInjector.fire("site", **ctx)`` at
+    instrumented points — a cheap no-op dict lookup unless a test armed a
+    fault there. Instrumented sites:
+
+    - ``gather.child``      (ctx: index, shards, plan) — scatter-gather child
+    - ``remote.dispatch``   (ctx: host, port)  — plan shipping send
+    - ``remote.connect``    (ctx: host, port)  — socket establishment
+    - ``promql.remote``     (ctx: endpoint)    — cross-cluster HTTP exec
+    - ``store.call``        (ctx: host, port, op) — remote column store
+    - ``node.dispatch``     (ctx: node)        — in-cluster node dispatch
+    """
+
+    _faults: dict[str, list[Fault]] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def arm(cls, site: str, error=None, delay_s: float = 0.0,
+            times: int | None = None, match=None,
+            sleep=time.sleep) -> Fault:
+        f = Fault(error=error, delay_s=delay_s, times=times, match=match,
+                  sleep=sleep)
+        with cls._lock:
+            cls._faults.setdefault(site, []).append(f)
+        return f
+
+    @classmethod
+    def fire(cls, site: str, **ctx) -> None:
+        if not cls._faults:  # hot path: nothing armed anywhere
+            return
+        with cls._lock:
+            faults = list(cls._faults.get(site, ()))
+        for f in faults:
+            if not f._applies(ctx):
+                continue
+            f.fired += 1
+            if f.delay_s:
+                f.sleep(f.delay_s)
+            if f.error is not None:
+                err = f.error
+                if isinstance(err, type):
+                    err = err(f"fault injected at {site}")
+                raise err
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._faults.clear()
+
+    @classmethod
+    def armed(cls) -> bool:
+        return bool(cls._faults)

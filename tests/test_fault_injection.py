@@ -1,0 +1,297 @@
+"""Chaos suite: fault-injection tests for the distributed query path.
+
+Kills real executors and arms :class:`FaultInjector` faults at the
+instrumented sites to exercise partial scatter-gather, breaker skips, retry
+exhaustion and deadline enforcement. Deterministic: retries are configured
+with zero backoff and deadlines run on injected clocks — no wall-clock
+sleeps.
+"""
+
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.remote import (
+    PlanExecutorServer,
+    RemotePlanDispatcher,
+)
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.query.exec.plan import (
+    ExecContext,
+    SelectRawPartitionsExec,
+)
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils import resilience
+from filodb_tpu.utils.resilience import (
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    FaultInjector,
+    ResilienceConfig,
+    breaker_for,
+    reset_breakers,
+)
+
+pytestmark = pytest.mark.chaos
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FaultInjector.reset()
+    reset_breakers()
+    # fail-fast posture: no backoff sleeps, short dials
+    resilience.configure(retry_max_attempts=1, retry_base_backoff_s=0.0,
+                         retry_max_backoff_s=0.0)
+    yield
+    FaultInjector.reset()
+    reset_breakers()
+    resilience._config = ResilienceConfig()
+
+
+@pytest.fixture
+def scatter_env():
+    """4 remote executors (one per shard) behind one populated memstore;
+    the planner ships each shard's leaf to its own executor."""
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=60))
+    keys = machine_metrics_series(8)
+    ingest_routed(ms, "timeseries",
+                  gauge_stream(keys, 120, start_ms=START * 1000),
+                  NUM_SHARDS, 2)
+    servers = [PlanExecutorServer(ms).start() for _ in range(NUM_SHARDS)]
+    disps = {s: RemotePlanDispatcher("127.0.0.1", servers[s].port,
+                                     timeout=2.0)
+             for s in range(NUM_SHARDS)}
+    planner = SingleClusterPlanner(
+        "timeseries", NUM_SHARDS, spread=2,
+        dispatcher_for_shard=lambda s: disps[s])
+    yield servers, disps, planner
+    for srv in servers:
+        srv.stop()
+
+
+def _materialize(planner):
+    plan = parse_query("sum(heap_usage)",
+                       TimeStepParams(START + 300, 60, START + 1000))
+    return planner.materialize(plan)
+
+
+def _execute(ep):
+    ctx = ExecContext(TimeSeriesMemStore(), "timeseries",
+                      deadline=Deadline.after(30.0))
+    return ep.dispatcher.dispatch(ep, ctx)
+
+
+class TestPartialScatterGather:
+    def test_all_executors_up_is_complete(self, scatter_env):
+        _, _, planner = scatter_env
+        result = _execute(_materialize(planner))
+        assert not result.partial
+        assert result.warnings == []
+        assert result.result.num_series == 1
+
+    def test_one_killed_executor_yields_partial(self, scatter_env):
+        servers, _, planner = scatter_env
+        servers[2].stop()  # shard 2's executor dies before the scatter
+        result = _execute(_materialize(planner))
+        assert result.partial
+        assert len(result.warnings) == 1
+        # the warning names the lost shards
+        assert "shards [2]" in result.warnings[0]
+        assert result.result.num_series == 1  # 3 of 4 shards still answer
+
+    def test_failures_above_threshold_fail_query(self, scatter_env):
+        servers, _, planner = scatter_env
+        for s in (0, 1, 3):
+            servers[s].stop()  # 3/4 lost > 0.5 threshold
+        with pytest.raises(ConnectionError,
+                           match="scatter-gather children failed"):
+            _execute(_materialize(planner))
+
+    def test_allow_partial_off_fails_on_first_loss(self, scatter_env):
+        servers, _, planner = scatter_env
+        servers[2].stop()
+        resilience.configure(allow_partial=False)
+        with pytest.raises((ConnectionError, OSError)):
+            _execute(_materialize(planner))
+
+    def test_injected_child_fault_names_shard(self, scatter_env):
+        _, _, planner = scatter_env
+        # exact match: the site also fires for enclosing subtrees that span
+        # every shard — only the single-shard leaf child should die
+        FaultInjector.arm("gather.child", error=ConnectionError, times=1,
+                          match=lambda ctx: ctx["shards"] == [1])
+        result = _execute(_materialize(planner))
+        assert result.partial
+        assert "shards [1]" in result.warnings[0]
+
+    def test_deadline_exceeded_is_never_partial(self, scatter_env):
+        _, _, planner = scatter_env
+        clk = FakeClock()
+        # one slow child burns the whole deadline; the query must FAIL with
+        # a timeout, not degrade to a partial result
+        FaultInjector.arm("gather.child", delay_s=100.0, times=1,
+                          sleep=clk.advance,
+                          match=lambda ctx: ctx["shards"] == [0])
+        ep = _materialize(planner)
+        ctx = ExecContext(TimeSeriesMemStore(), "timeseries",
+                          deadline=Deadline.after(30.0, clock=clk.now))
+        with pytest.raises(DeadlineExceeded):
+            ep.dispatcher.dispatch(ep, ctx)
+
+
+class TestBreakerIntegration:
+    def test_open_breaker_peer_is_skipped(self, scatter_env):
+        _, disps, planner = scatter_env
+        breaker_for(disps[3].peer).force_open()
+        result = _execute(_materialize(planner))
+        assert result.partial
+        assert "CircuitOpenError" in result.warnings[0]
+        assert "shards [3]" in result.warnings[0]
+
+    def test_repeated_failures_open_breaker(self, scatter_env):
+        servers, disps, planner = scatter_env
+        resilience.configure(breaker_failure_threshold=2)
+        servers[1].stop()
+        ep = _materialize(planner)
+        _execute(ep)  # failure 1 for shard 1's peer
+        _execute(ep)  # failure 2 → breaker opens
+        assert breaker_for(disps[1].peer).is_open
+        # next query skips the peer without dialing: the dispatch site
+        # never fires for the open peer
+        fault = FaultInjector.arm("remote.dispatch")  # counts, no error
+        result = _execute(ep)
+        assert result.partial
+        assert fault.fired == NUM_SHARDS - 1  # all but the open peer
+
+    def test_dispatch_to_open_breaker_raises_without_dial(self):
+        disp = RemotePlanDispatcher("127.0.0.1", 1)  # nothing listens
+        breaker_for(disp.peer).force_open()
+        connects = FaultInjector.arm("remote.connect")
+        leaf = SelectRawPartitionsExec(shard=0, filters=(), chunk_start=0,
+                                       chunk_end=1)
+        with pytest.raises(CircuitOpenError):
+            disp.dispatch(leaf, ExecContext(None, "timeseries"))
+        assert connects.fired == 0
+
+
+class TestRetryBehavior:
+    def test_retry_exhausts_budget_and_fails(self):
+        resilience.configure(retry_max_attempts=3)
+        before = resilience._retries_total.value
+        fault = FaultInjector.arm("remote.dispatch", error=ConnectionError)
+        disp = RemotePlanDispatcher("127.0.0.1", 1)
+        leaf = SelectRawPartitionsExec(shard=0, filters=(), chunk_start=0,
+                                       chunk_end=1)
+        with pytest.raises(ConnectionError):
+            disp.dispatch(leaf, ExecContext(None, "timeseries"))
+        assert fault.fired == 3  # initial attempt + 2 retries
+        assert resilience._retries_total.value == before + 2
+
+    def test_stale_pooled_socket_retries_on_fresh_connection(self,
+                                                             scatter_env):
+        servers, disps, planner = scatter_env
+        resilience.configure(retry_max_attempts=2)
+        disp = disps[0]
+
+        def leaves(p):
+            cs = p.children()
+            return [p] if not cs else [x for c in cs for x in leaves(c)]
+
+        leaf = next(x for x in leaves(_materialize(planner))
+                    if x.dispatcher is disp)
+        assert disp.ping()  # pools a socket
+        # the peer restarted: the pooled socket is dead but not yet noticed
+        disp._local.pool[(disp.host, disp.port)].close()
+        result = disp.dispatch(leaf, ExecContext(None, "timeseries"))
+        assert result.result is not None  # transparently redialed
+
+
+class TestRemoteStoreFaults:
+    @pytest.fixture
+    def store_env(self, tmp_path):
+        from filodb_tpu.core.store.remotestore import ChunkStoreServer
+        srv = ChunkStoreServer(root=str(tmp_path)).start()
+        yield srv
+        srv.shutdown()
+
+    def test_stale_pooled_socket_retries(self, store_env):
+        from filodb_tpu.core.store.remotestore import _RemoteConn
+        conn = _RemoteConn("127.0.0.1", store_env.port)
+        assert conn.call("ping") is True
+        conn._sock.close()  # server restarted under us
+        assert conn.call("ping") is True  # one retry on a fresh socket
+
+    def test_injected_fault_consumed_by_retry(self, store_env):
+        from filodb_tpu.core.store.remotestore import _RemoteConn
+        conn = _RemoteConn("127.0.0.1", store_env.port)
+        assert conn.call("ping") is True  # pool a socket first
+        fault = FaultInjector.arm("store.call", error=ConnectionError,
+                                  times=1)
+        assert conn.call("ping") is True  # fault hits, fresh-socket retry
+        assert fault.fired == 1
+
+    def test_persistent_failure_opens_breaker(self, store_env):
+        from filodb_tpu.core.store.remotestore import _RemoteConn
+        resilience.configure(breaker_failure_threshold=2)
+        conn = _RemoteConn("127.0.0.1", store_env.port)
+        FaultInjector.arm("store.call", error=ConnectionError)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                conn.call("ping")
+        assert breaker_for(conn.peer).is_open
+        with pytest.raises(CircuitOpenError):
+            conn.call("ping")
+
+
+class TestPromQlRemoteFaults:
+    def _plan(self):
+        from filodb_tpu.query.exec.remote_exec import PromQlRemoteExec
+        return PromQlRemoteExec(endpoint="http://127.0.0.1:1/promql/ts",
+                                promql="up", start=0, step=60_000,
+                                end=60_000, timeout_s=0.5)
+
+    def test_unreachable_endpoint_tagged_connection_error(self):
+        p = self._plan()
+        FaultInjector.arm("promql.remote", error=ConnectionError)
+        with pytest.raises(ConnectionError,
+                           match=r"remote query to http://127\.0\.0\.1:1"):
+            p.do_execute(ExecContext(None, "timeseries"))
+
+    def test_repeated_failures_open_endpoint_breaker(self):
+        p = self._plan()
+        resilience.configure(breaker_failure_threshold=2)
+        FaultInjector.arm("promql.remote", error=ConnectionError)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                p.do_execute(ExecContext(None, "timeseries"))
+        with pytest.raises(CircuitOpenError):
+            p.do_execute(ExecContext(None, "timeseries"))
+
+    def test_exhausted_deadline_fails_before_dialing(self):
+        p = self._plan()
+        clk = FakeClock()
+        fired = FaultInjector.arm("promql.remote")
+        ctx = ExecContext(None, "timeseries",
+                          deadline=Deadline.after(1.0, clock=clk.now))
+        clk.advance(2.0)
+        with pytest.raises(DeadlineExceeded):
+            p.do_execute(ctx)
+        assert fired.fired == 0

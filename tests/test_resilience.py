@@ -1,0 +1,450 @@
+"""Resilience primitives: deadlines, retry policy, circuit breaker, fault
+injector, config plumbing and partial-response rendering.
+
+Every test is deterministic: clocks and sleeps are injected, nothing waits
+on the wall clock.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from filodb_tpu.utils import resilience
+from filodb_tpu.utils.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    DeadlineExceeded,
+    Fault,
+    FaultInjector,
+    ResilienceConfig,
+    RetryPolicy,
+    breaker_for,
+    reset_breakers,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def now(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FaultInjector.reset()
+    reset_breakers()
+    yield
+    FaultInjector.reset()
+    reset_breakers()
+    resilience._config = ResilienceConfig()
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        clk = FakeClock()
+        d = Deadline.after(10.0, clock=clk.now)
+        assert d.remaining() == pytest.approx(10.0)
+        assert not d.expired
+        clk.advance(10.5)
+        assert d.expired
+
+    def test_timeout_derives_from_remaining(self):
+        clk = FakeClock()
+        d = Deadline.after(10.0, clock=clk.now)
+        # plenty of time left: the per-hop cap wins
+        assert d.timeout(cap=3.0) == pytest.approx(3.0)
+        clk.advance(9.0)
+        # less than the cap remains: the deadline wins
+        assert d.timeout(cap=3.0) == pytest.approx(1.0)
+        assert d.timeout() == pytest.approx(1.0)
+
+    def test_timeout_raises_when_exhausted(self):
+        clk = FakeClock()
+        d = Deadline.after(1.0, clock=clk.now)
+        clk.advance(2.0)
+        with pytest.raises(DeadlineExceeded, match="dial"):
+            d.timeout(cap=5.0, what="dial")
+
+    def test_check_raises(self):
+        clk = FakeClock()
+        d = Deadline.after(1.0, clock=clk.now)
+        d.check("gather")  # fine while time remains
+        clk.advance(1.5)
+        with pytest.raises(DeadlineExceeded, match="gather"):
+            d.check("gather")
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        kw.setdefault("rng", lambda: 1.0)  # deterministic: full backoff
+        sleeps = []
+        kw.setdefault("sleep", sleeps.append)
+        return RetryPolicy(**kw), sleeps
+
+    def test_backoff_grows_exponentially_and_caps(self):
+        p, _ = self._policy(base_backoff_s=0.1, multiplier=2.0,
+                            max_backoff_s=0.5)
+        assert p.backoff(1) == pytest.approx(0.1)
+        assert p.backoff(2) == pytest.approx(0.2)
+        assert p.backoff(3) == pytest.approx(0.4)
+        assert p.backoff(4) == pytest.approx(0.5)  # capped
+        assert p.backoff(10) == pytest.approx(0.5)
+
+    def test_jitter_range(self):
+        lo = RetryPolicy(base_backoff_s=1.0, jitter=0.5, rng=lambda: 0.0)
+        hi = RetryPolicy(base_backoff_s=1.0, jitter=0.5, rng=lambda: 1.0)
+        assert lo.backoff(1) == pytest.approx(0.5)
+        assert hi.backoff(1) == pytest.approx(1.0)
+
+    def test_retries_then_succeeds(self):
+        p, sleeps = self._policy(max_attempts=3, base_backoff_s=0.1)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("boom")
+            return "ok"
+
+        assert p.call(flaky) == "ok"
+        assert len(calls) == 3
+        assert sleeps == pytest.approx([0.1, 0.2])
+
+    def test_exhausts_attempts(self):
+        p, sleeps = self._policy(max_attempts=3)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            p.call(dead)
+        assert len(calls) == 3
+        assert len(sleeps) == 2  # no sleep after the final failure
+
+    def test_budget_stops_retries(self):
+        p, sleeps = self._policy(max_attempts=10, base_backoff_s=1.0,
+                                 budget_s=3.0)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            p.call(dead)
+        # backoffs 1s + 2s fill the 3s budget; the third (4s) would burst it
+        assert sleeps == pytest.approx([1.0, 2.0])
+        assert len(calls) == 3
+
+    def test_deadline_stops_retries(self):
+        clk = FakeClock()
+        d = Deadline.after(0.5, clock=clk.now)
+        p, sleeps = self._policy(max_attempts=10, base_backoff_s=1.0)
+        with pytest.raises(ConnectionError):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   deadline=d)
+        assert sleeps == []  # 1s backoff > 0.5s remaining: fail fast
+
+    def test_never_retries_breaker_or_deadline(self):
+        p, sleeps = self._policy(max_attempts=5)
+        with pytest.raises(CircuitOpenError):
+            p.call(lambda: (_ for _ in ()).throw(CircuitOpenError("open")))
+        with pytest.raises(DeadlineExceeded):
+            p.call(lambda: (_ for _ in ()).throw(DeadlineExceeded("late")),
+                   retry_on=(ConnectionError, OSError, TimeoutError))
+        assert sleeps == []
+
+    def test_non_retryable_error_passes_through(self):
+        p, sleeps = self._policy(max_attempts=5)
+        with pytest.raises(ValueError):
+            p.call(lambda: (_ for _ in ()).throw(ValueError("logic bug")))
+        assert sleeps == []
+
+    def test_on_retry_callback_and_counter(self):
+        before = resilience._retries_total.value
+        p, _ = self._policy(max_attempts=2)
+        seen = []
+        with pytest.raises(ConnectionError):
+            p.call(lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                   on_retry=lambda a, e: seen.append((a, type(e).__name__)))
+        assert seen == [(1, "ConnectionError")]
+        assert resilience._retries_total.value == before + 1
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+
+
+class TestCircuitBreaker:
+    def _breaker(self, **kw):
+        clk = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout_s", 10.0)
+        return CircuitBreaker("peer:1", clock=clk.now, **kw), clk
+
+    def test_opens_after_threshold(self):
+        b, _ = self._breaker()
+        assert b.state == "closed"
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError, match="peer:1"):
+            b.guard()
+
+    def test_success_resets_failure_count(self):
+        b, _ = self._breaker()
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == "closed"
+
+    def test_half_open_admits_single_probe(self):
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        assert not b.allow()
+        clk.advance(10.0)
+        assert b.state == "half-open"
+        assert b.allow()        # the probe
+        assert not b.allow()    # concurrent calls still rejected
+
+    def test_probe_success_closes(self):
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed"
+        assert b.allow()
+
+    def test_probe_failure_reopens(self):
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()
+        b.record_failure()      # one failed probe re-opens immediately
+        assert b.state == "open"
+        assert not b.allow()
+        clk.advance(10.0)
+        assert b.allow()        # next probe window
+
+    def test_force_open(self):
+        b, clk = self._breaker()
+        b.force_open()
+        assert b.state == "open"
+        assert not b.allow()
+        clk.advance(10.0)
+        assert b.allow()  # recovers through the normal half-open path
+
+    def test_registry_shares_instances(self):
+        a = breaker_for("host:9000")
+        b = breaker_for("host:9000")
+        c = breaker_for("host:9001")
+        assert a is b
+        assert a is not c
+        reset_breakers()
+        assert breaker_for("host:9000") is not a
+
+    def test_registry_uses_config_defaults(self):
+        resilience.configure(breaker_failure_threshold=2, breaker_reset_s=7.0)
+        b = breaker_for("host:9002")
+        assert b.failure_threshold == 2
+        assert b.reset_timeout_s == 7.0
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+
+
+class TestFaultInjector:
+    def test_noop_when_unarmed(self):
+        FaultInjector.fire("remote.dispatch", host="h", port=1)  # no raise
+
+    def test_raises_armed_error_n_times(self):
+        f = FaultInjector.arm("remote.dispatch", error=ConnectionError,
+                              times=2)
+        for _ in range(2):
+            with pytest.raises(ConnectionError, match="fault injected"):
+                FaultInjector.fire("remote.dispatch", host="h", port=1)
+        FaultInjector.fire("remote.dispatch", host="h", port=1)  # spent
+        assert f.fired == 2
+
+    def test_match_filters_by_context(self):
+        FaultInjector.arm("gather.child", error=ConnectionError,
+                          match=lambda ctx: 2 in ctx["shards"])
+        FaultInjector.fire("gather.child", index=0, shards=[0, 1])
+        with pytest.raises(ConnectionError):
+            FaultInjector.fire("gather.child", index=1, shards=[2, 3])
+
+    def test_delay_uses_injected_sleep(self):
+        slept = []
+        FaultInjector.arm("store.call", delay_s=5.0, sleep=slept.append)
+        FaultInjector.fire("store.call", host="h", port=1, op="read")
+        assert slept == [5.0]
+
+    def test_exception_instance_passthrough(self):
+        FaultInjector.arm("promql.remote", error=OSError("exact instance"))
+        with pytest.raises(OSError, match="exact instance"):
+            FaultInjector.fire("promql.remote", endpoint="e")
+
+    def test_reset(self):
+        FaultInjector.arm("remote.connect", error=ConnectionError)
+        assert FaultInjector.armed()
+        FaultInjector.reset()
+        assert not FaultInjector.armed()
+        FaultInjector.fire("remote.connect", host="h", port=1)
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+
+
+class TestResilienceConfig:
+    def test_configure_overrides_known_keys(self):
+        resilience.configure(query_timeout_s=5.0, retry_max_attempts=7,
+                             unknown_knob=123)  # unknown keys ignored
+        c = resilience.config()
+        assert c.query_timeout_s == 5.0
+        assert c.retry_max_attempts == 7
+        assert not hasattr(c, "unknown_knob")
+
+    def test_default_retry_policy_reflects_config(self):
+        resilience.configure(retry_max_attempts=4,
+                             retry_base_backoff_s=0.5)
+        p = resilience.default_retry_policy()
+        assert p.max_attempts == 4
+        assert p.base_backoff_s == 0.5
+        assert resilience.default_retry_policy(max_attempts=1) \
+            .max_attempts == 1
+
+    def test_server_config_carries_resilience_block(self):
+        from filodb_tpu.config import ServerConfig
+        cfg = ServerConfig.load()
+        assert cfg.resilience["query_timeout_s"] == 30.0
+        resilience.configure(**cfg.resilience)
+        assert resilience.config().allow_partial is True
+
+
+# ---------------------------------------------------------------------------
+# deadline threading through the query service
+
+
+class TestDeadlineDerivation:
+    def test_query_service_stamps_deadline(self):
+        from filodb_tpu.coordinator.query_service import QueryService
+        from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+        from filodb_tpu.core.store.config import StoreConfig
+        from filodb_tpu.query.exec.plan import ExecContext
+
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=60))
+        svc = QueryService(ms, "timeseries", num_shards=1,
+                           query_timeout_s=12.0)
+        seen = {}
+        orig = ExecContext.__init__
+
+        def spy(self, *a, **kw):
+            orig(self, *a, **kw)
+            seen["deadline"] = kw.get("deadline", self.deadline)
+
+        ExecContext.__init__ = spy
+        try:
+            svc.query_range("absent_metric", 1_600_000_000, 60,
+                            1_600_000_600)
+        finally:
+            ExecContext.__init__ = orig
+        d = seen["deadline"]
+        assert d is not None
+        assert 0 < d.remaining() <= 12.0
+
+    def test_remote_dispatch_timeout_derives_from_deadline(self):
+        """No hard-coded 30s on the wire: an exhausted deadline fails the
+        dial before touching the network."""
+        from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+        from filodb_tpu.query.exec.plan import (
+            ExecContext,
+            SelectRawPartitionsExec,
+        )
+
+        clk = FakeClock()
+        disp = RemotePlanDispatcher("127.0.0.1", 1)  # nothing listens
+        ctx = ExecContext(None, "timeseries",
+                          deadline=Deadline.after(1.0, clock=clk.now))
+        clk.advance(2.0)
+        leaf = SelectRawPartitionsExec(shard=0, filters=(), chunk_start=0,
+                                       chunk_end=1)
+        with pytest.raises(DeadlineExceeded):
+            disp.dispatch(leaf, ctx)
+
+
+# ---------------------------------------------------------------------------
+# partial-response rendering
+
+
+def _mk_result(partial, warnings):
+    from filodb_tpu.query.model import (
+        QueryResult,
+        QueryStats,
+        RangeVectorKey,
+        StepMatrix,
+    )
+    m = StepMatrix([RangeVectorKey.of({"_metric_": "up"})],
+                   np.array([[1.0, 2.0]]),
+                   np.array([1000, 2000], dtype=np.int64))
+    return QueryResult(m, QueryStats(), "q1", partial=partial,
+                       warnings=warnings)
+
+
+class TestPartialRendering:
+    def test_matrix_json_includes_partial_fields(self):
+        from filodb_tpu.http import promjson
+        r = _mk_result(True, ["shard 2 lost"])
+        out = promjson.matrix_json(r)
+        assert out["partial"] is True
+        assert out["warnings"] == ["shard 2 lost"]
+
+    def test_matrix_json_str_round_trips(self):
+        from filodb_tpu.http import promjson
+        out = json.loads(promjson.matrix_json_str(_mk_result(
+            True, ["shard 2 lost"])))
+        assert out["partial"] is True
+        assert out["warnings"] == ["shard 2 lost"]
+        assert out["status"] == "success"
+
+    def test_vector_json_str_round_trips(self):
+        from filodb_tpu.http import promjson
+        out = json.loads(promjson.vector_json_str(_mk_result(
+            True, ["w"])))
+        assert out["partial"] is True
+        assert out["warnings"] == ["w"]
+
+    def test_complete_result_omits_fields(self):
+        from filodb_tpu.http import promjson
+        r = _mk_result(False, [])
+        assert "partial" not in promjson.matrix_json(r)
+        out = json.loads(promjson.matrix_json_str(r))
+        assert "partial" not in out
+        assert "warnings" not in out
