@@ -164,10 +164,78 @@ class PlanExecutorServer:
         self.server.server_close()
 
 
+# transport-failure classes that invalidate a pooled socket. Decode
+# errors (malformed frame off a half-dead peer) poison the stream the
+# same way a reset does: the connection must be dropped and redialed.
+# Shared with the remote column-store client so the sets cannot drift.
+TRANSPORT_ERRORS = (ConnectionError, OSError, EOFError, ValueError)
+
+
+class _SocketPool:
+    """Process-level pool of authed sockets, keyed by (host, port).
+
+    Checkout/checkin rather than thread-local: scatter-gather runs
+    children on short-lived worker threads, so sockets bound to thread
+    identity would never be reused (every query would redial and re-auth
+    per child, and dead threads would leak sockets to GC). A socket that
+    hits a transport error is closed by the caller and never checked
+    back in; idle sockets beyond ``idle_cap`` per peer are closed on
+    checkin."""
+
+    def __init__(self, idle_cap: int = 8):
+        self.idle_cap = idle_cap
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list[socket.socket]] = {}
+
+    def checkout(self, key: tuple[str, int]) -> socket.socket | None:
+        with self._lock:
+            idle = self._idle.get(key)
+            return idle.pop() if idle else None
+
+    def checkin(self, key: tuple[str, int], sock: socket.socket) -> None:
+        with self._lock:
+            idle = self._idle.setdefault(key, [])
+            if len(idle) < self.idle_cap:
+                idle.append(sock)
+                return
+        _close_quietly(sock)
+
+    def drop(self, key: tuple[str, int]) -> None:
+        """Close every idle socket for a peer (auth/secret changed,
+        tests forcing a fresh dial)."""
+        with self._lock:
+            idle = self._idle.pop(key, [])
+        for s in idle:
+            _close_quietly(s)
+
+    def clear(self) -> None:
+        with self._lock:
+            all_idle = [s for conns in self._idle.values() for s in conns]
+            self._idle.clear()
+        for s in all_idle:
+            _close_quietly(s)
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+_pool = _SocketPool()
+
+
+def reset_pool() -> None:
+    """Drop all pooled connections (tests)."""
+    _pool.clear()
+
+
 class RemotePlanDispatcher(PlanDispatcher):
     """Ships a plan subtree to a peer node (the send side of
-    ``ActorPlanDispatcher``). One pooled connection per (host, port) per
-    thread.
+    ``ActorPlanDispatcher``). Connections are pooled process-wide per
+    (host, port) — scatter-gather worker threads check them out and back
+    in, so thread churn does not cost redials or re-auth.
 
     Resilience: the peer's circuit breaker gates every dial (open peer →
     ``CircuitOpenError`` without touching the network, which scatter-gather
@@ -176,14 +244,9 @@ class RemotePlanDispatcher(PlanDispatcher):
     must not fail the first request after reconnect); query dispatch
     timeouts derive from the query ``Deadline`` on ``ExecContext``."""
 
-    _local = threading.local()
-
     __wire_fields__ = ("host", "port", "timeout")
 
-    # transport-failure classes that invalidate the pooled socket. Decode
-    # errors (malformed frame off a half-dead peer) poison the stream the
-    # same way a reset does: the connection must be dropped and redialed.
-    TRANSPORT_ERRORS = (ConnectionError, OSError, EOFError, ValueError)
+    TRANSPORT_ERRORS = TRANSPORT_ERRORS
 
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self.host = host
@@ -194,56 +257,47 @@ class RemotePlanDispatcher(PlanDispatcher):
     def peer(self) -> str:
         return f"{self.host}:{self.port}"
 
-    def _conn(self, timeout: float | None = None) -> socket.socket:
-        pool = getattr(self._local, "pool", None)
-        if pool is None:
-            pool = self._local.pool = {}
-        key = (self.host, self.port)
-        sock = pool.get(key)
-        if sock is None:
-            FaultInjector.fire("remote.connect", host=self.host,
-                               port=self.port)
-            sock = socket.create_connection(
-                (self.host, self.port),
-                timeout=timeout if timeout is not None else self.timeout)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            secret = cluster_secret()
-            if secret is not None:
-                _send_msg(sock, ("auth", secret))
-                resp = _recv_msg(sock)
-                if resp[0] != "ok":
-                    sock.close()
-                    raise ConnectionError("cluster auth rejected")
-            pool[key] = sock
-        # pooled sockets are shared across dispatcher instances; apply this
-        # call's timeout (a prior short-timeout ping must not poison a
-        # later long call)
-        sock.settimeout(timeout if timeout is not None else self.timeout)
+    def _dial(self, timeout: float) -> socket.socket:
+        FaultInjector.fire("remote.connect", host=self.host,
+                           port=self.port)
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        secret = cluster_secret()
+        if secret is not None:
+            _send_msg(sock, ("auth", secret))
+            resp = _recv_msg(sock)
+            if resp[0] != "ok":
+                sock.close()
+                raise ConnectionError("cluster auth rejected")
         return sock
 
     def _drop_conn(self):
-        pool = getattr(self._local, "pool", {})
-        sock = pool.pop((self.host, self.port), None)
-        if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        _pool.drop((self.host, self.port))
 
     def _roundtrip(self, msg: tuple, timeout: float | None = None):
-        """One request/response on the pooled socket; transport failure
-        drops the connection so the next attempt redials."""
+        """One request/response on a pooled (or fresh) socket; transport
+        failure closes the connection so the next attempt redials."""
+        t = timeout if timeout is not None else self.timeout
+        key = (self.host, self.port)
+        sock = _pool.checkout(key)
+        if sock is None:
+            sock = self._dial(t)
         try:
-            sock = self._conn(timeout)
+            # pooled sockets are shared across calls; apply this call's
+            # timeout (a prior short-timeout ping must not poison a later
+            # long call)
+            sock.settimeout(t)
             _send_msg(sock, msg)
-            return _recv_msg(sock)
+            resp = _recv_msg(sock)
         except self.TRANSPORT_ERRORS:
-            self._drop_conn()
+            _close_quietly(sock)
             raise
+        _pool.checkin(key, sock)
+        return resp
 
     def dispatch(self, plan, ctx):
         breaker = breaker_for(self.peer)
-        breaker.guard()
         deadline = getattr(ctx, "deadline", None)
 
         def attempt():
@@ -255,13 +309,13 @@ class RemotePlanDispatcher(PlanDispatcher):
             return self._roundtrip(
                 ("execute", ctx.dataset, plan, ctx.qcontext), timeout)
 
-        try:
+        # calling() records a failure only for genuine transport errors —
+        # a DeadlineExceeded (raised before even dialing) or an open
+        # breaker must not count against a healthy peer — and guarantees
+        # a half-open probe reports exactly one outcome
+        with breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
             resp = default_retry_policy().call(
                 attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
-        except self.TRANSPORT_ERRORS:
-            breaker.record_failure()
-            raise
-        breaker.record_success()
         if resp[0] == "ok":
             return resp[1]
         raise RuntimeError(

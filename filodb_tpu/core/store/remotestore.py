@@ -41,6 +41,7 @@ import threading
 import zlib
 
 from filodb_tpu.coordinator.remote import (
+    TRANSPORT_ERRORS,
     _recv_msg,
     _send_msg,
     cluster_secret,
@@ -270,23 +271,26 @@ class _RemoteConn:
 
     def call(self, *msg):
         breaker = breaker_for(self.peer)
-        breaker.guard()
-        with self._lock:
-            pooled = self._sock is not None
-            try:
+        # same transport set as RemotePlanDispatcher (EOFError/ValueError
+        # cover decode errors off a half-dead store); calling() guarantees
+        # every admitted call — including a half-open probe — reports
+        # exactly one breaker outcome even if an unexpected error escapes
+        with breaker.calling(transport_errors=TRANSPORT_ERRORS):
+            with self._lock:
+                pooled = self._sock is not None
                 try:
-                    resp = self._roundtrip(msg)
-                except (ConnectionError, OSError):
+                    try:
+                        resp = self._roundtrip(msg)
+                    except TRANSPORT_ERRORS:
+                        self._drop()
+                        if not pooled:
+                            raise
+                        # stale pooled socket: one retry on a fresh
+                        # connection
+                        resp = self._roundtrip(msg)
+                except TRANSPORT_ERRORS:
                     self._drop()
-                    if not pooled:
-                        raise
-                    # stale pooled socket: one retry on a fresh connection
-                    resp = self._roundtrip(msg)
-            except (ConnectionError, OSError):
-                self._drop()
-                breaker.record_failure()
-                raise
-        breaker.record_success()
+                    raise
         if resp[0] == "ok":
             return resp[1]
         if resp[0] == "pong":

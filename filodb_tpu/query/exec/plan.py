@@ -323,19 +323,35 @@ class NonLeafExecPlan(ExecPlan):
 
         # concurrency pays only when children leave the process; local
         # children keep the serial path (no thread hop on the hot path)
-        remote = any(not isinstance(c.dispatcher, InProcessPlanDispatcher)
-                     for c in children)
+        n_remote = sum(1 for c in children
+                       if not isinstance(c.dispatcher,
+                                         InProcessPlanDispatcher))
         outcomes: list = [None] * len(children)
-        if remote and len(children) > 1:
+        if n_remote and len(children) > 1:
             from concurrent.futures import ThreadPoolExecutor
             # per-gather pool: a shared bounded pool deadlocks on nested
-            # gathers (parents hold workers while waiting on children)
+            # gathers (parents hold workers while waiting on children).
+            # Remote transport connections are pooled process-wide (keyed
+            # by peer), so short-lived workers don't cost redials.
             with ThreadPoolExecutor(
-                    max_workers=min(len(children), 16),
+                    max_workers=min(n_remote, 16),
                     thread_name_prefix="gather") as ex:
-                futs = [ex.submit(run, i, c)
-                        for i, c in enumerate(children)]
-                for i, f in enumerate(futs):
+                # only remote children go to the pool: in-process children
+                # execute against THIS ctx, whose stats/warnings mutations
+                # are not thread-safe — they run on the calling thread
+                # (below) while the remote dispatches are in flight
+                futs = {i: ex.submit(run, i, c)
+                        for i, c in enumerate(children)
+                        if not isinstance(c.dispatcher,
+                                          InProcessPlanDispatcher)}
+                for i, c in enumerate(children):
+                    if i in futs:
+                        continue
+                    try:
+                        outcomes[i] = (True, run(i, c))
+                    except Exception as e:  # noqa: BLE001 — sorted below
+                        outcomes[i] = (False, e)
+                for i, f in futs.items():
                     try:
                         outcomes[i] = (True, f.result())
                     except Exception as e:  # noqa: BLE001 — sorted below

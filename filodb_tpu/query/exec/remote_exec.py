@@ -44,29 +44,40 @@ class PromQlRemoteExec(ExecPlan):
         })
         url = f"{self.endpoint}/api/v1/query_range?{qs}"
         breaker = breaker_for(self.endpoint)
-        breaker.guard()
-        deadline = getattr(ctx, "deadline", None)
-        timeout = deadline.timeout(cap=self.timeout_s,
-                                   what=f"remote exec {self.endpoint}") \
-            if deadline is not None else self.timeout_s
-        try:
-            FaultInjector.fire("promql.remote", endpoint=self.endpoint)
-            with urllib.request.urlopen(url, timeout=timeout) as r:
-                body = json.load(r)
-        except urllib.error.HTTPError as e:
-            # tag with the endpoint instead of leaking a raw urllib
-            # traceback; an HTTP status is the remote ANSWERING — not a
-            # transport failure, so the breaker stays closed
-            raise RemoteQueryError(
-                f"remote query to {self.endpoint} failed: "
-                f"HTTP {e.code} {e.reason}") from e
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            breaker.record_failure()
-            reason = getattr(e, "reason", e)
-            raise ConnectionError(
-                f"remote query to {self.endpoint} unreachable: "
-                f"{reason}") from e
-        breaker.record_success()
+        # calling() guarantees the breaker sees exactly one outcome per
+        # admitted call — a half-open probe can never stay pending
+        with breaker.calling(transport_errors=(urllib.error.URLError,
+                                               ConnectionError,
+                                               OSError)) as outcome:
+            deadline = getattr(ctx, "deadline", None)
+            timeout = deadline.timeout(cap=self.timeout_s,
+                                       what=f"remote exec {self.endpoint}") \
+                if deadline is not None else self.timeout_s
+            try:
+                FaultInjector.fire("promql.remote", endpoint=self.endpoint)
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    body = json.load(r)
+            except urllib.error.HTTPError as e:
+                # tag with the endpoint instead of leaking a raw urllib
+                # traceback; an HTTP status is the remote ANSWERING — the
+                # transport is healthy, so the breaker closes
+                outcome.success()
+                raise RemoteQueryError(
+                    f"remote query to {self.endpoint} failed: "
+                    f"HTTP {e.code} {e.reason}") from e
+            except json.JSONDecodeError as e:
+                # malformed body off a half-dead peer poisons the exchange
+                # the same way a reset does
+                outcome.failure()
+                raise RemoteQueryError(
+                    f"remote query to {self.endpoint} returned malformed "
+                    f"JSON: {e}") from e
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                outcome.failure()
+                reason = getattr(e, "reason", e)
+                raise ConnectionError(
+                    f"remote query to {self.endpoint} unreachable: "
+                    f"{reason}") from e
         if body.get("status") != "success":
             raise RemoteQueryError(
                 f"remote query to {self.endpoint} failed: {body}")

@@ -26,6 +26,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from filodb_tpu.utils.metrics import Gauge, get_counter
@@ -243,6 +244,66 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self.clock()
                 self._gauge.set(_STATE_VALUE[OPEN])
+
+    def cancel_probe(self) -> None:
+        """The admitted call produced no transport verdict (deadline
+        expired before dialing, fault injected at an off-path site):
+        free the half-open probe slot so a later call may probe again.
+        Without this, an exception that bypasses record_success/
+        record_failure would leave ``_probing`` set and wedge the
+        breaker half-open forever."""
+        with self._lock:
+            self._probing = False
+
+    @contextmanager
+    def calling(self, transport_errors: tuple = (ConnectionError, OSError)):
+        """Admit one call (:meth:`guard`) and guarantee exactly one
+        outcome on every exit path: clean exit records success, a
+        ``transport_errors`` exception records failure (except
+        :class:`CircuitOpenError`/:class:`DeadlineExceeded` — a skip or
+        deadline verdict says nothing about the peer's health), and any
+        other exception releases the probe slot without a verdict.
+
+        The yielded handle lets the body record an outcome explicitly
+        first (e.g. an HTTP error status means the peer ANSWERED —
+        transport healthy — even though the call raises); whichever of
+        success/failure/release happens first wins.
+        """
+        self.guard()
+        outcome = _BreakerOutcome(self)
+        try:
+            yield outcome
+        except transport_errors as e:
+            if not isinstance(e, (CircuitOpenError, DeadlineExceeded)):
+                outcome.failure()
+            raise
+        else:
+            outcome.success()
+        finally:
+            outcome.release()
+
+
+class _BreakerOutcome:
+    """One-shot outcome handle yielded by :meth:`CircuitBreaker.calling`."""
+
+    def __init__(self, breaker: CircuitBreaker):
+        self._breaker = breaker
+        self._done = False
+
+    def success(self) -> None:
+        if not self._done:
+            self._done = True
+            self._breaker.record_success()
+
+    def failure(self) -> None:
+        if not self._done:
+            self._done = True
+            self._breaker.record_failure()
+
+    def release(self) -> None:
+        if not self._done:
+            self._done = True
+            self._breaker.cancel_probe()
 
 
 _breakers: dict[str, CircuitBreaker] = {}

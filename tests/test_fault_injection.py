@@ -14,6 +14,8 @@ from filodb_tpu.coordinator.planner import SingleClusterPlanner
 from filodb_tpu.coordinator.remote import (
     PlanExecutorServer,
     RemotePlanDispatcher,
+    _pool,
+    reset_pool,
 )
 from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
 from filodb_tpu.core.store.config import StoreConfig
@@ -55,12 +57,14 @@ class FakeClock:
 def _clean():
     FaultInjector.reset()
     reset_breakers()
+    reset_pool()
     # fail-fast posture: no backoff sleeps, short dials
     resilience.configure(retry_max_attempts=1, retry_base_backoff_s=0.0,
                          retry_max_backoff_s=0.0)
     yield
     FaultInjector.reset()
     reset_breakers()
+    reset_pool()
     resilience._config = ResilienceConfig()
 
 
@@ -191,6 +195,21 @@ class TestBreakerIntegration:
             disp.dispatch(leaf, ExecContext(None, "timeseries"))
         assert connects.fired == 0
 
+    def test_deadline_expiry_is_not_a_breaker_failure(self):
+        """Regression: a burst of tight-deadline queries must not open a
+        healthy peer's breaker — the deadline expires before dialing."""
+        resilience.configure(breaker_failure_threshold=1)
+        disp = RemotePlanDispatcher("127.0.0.1", 1)
+        clk = FakeClock()
+        leaf = SelectRawPartitionsExec(shard=0, filters=(), chunk_start=0,
+                                       chunk_end=1)
+        ctx = ExecContext(None, "timeseries",
+                          deadline=Deadline.after(1.0, clock=clk.now))
+        clk.advance(2.0)
+        with pytest.raises(DeadlineExceeded):
+            disp.dispatch(leaf, ctx)
+        assert breaker_for(disp.peer).state == "closed"
+
 
 class TestRetryBehavior:
     def test_retry_exhausts_budget_and_fails(self):
@@ -219,7 +238,8 @@ class TestRetryBehavior:
                     if x.dispatcher is disp)
         assert disp.ping()  # pools a socket
         # the peer restarted: the pooled socket is dead but not yet noticed
-        disp._local.pool[(disp.host, disp.port)].close()
+        for sock in _pool._idle[(disp.host, disp.port)]:
+            sock.close()
         result = disp.dispatch(leaf, ExecContext(None, "timeseries"))
         assert result.result is not None  # transparently redialed
 
@@ -295,3 +315,26 @@ class TestPromQlRemoteFaults:
         with pytest.raises(DeadlineExceeded):
             p.do_execute(ctx)
         assert fired.fired == 0
+
+    def test_http_error_probe_closes_breaker(self):
+        """Regression: an HTTP error status during the half-open probe
+        means the peer ANSWERED — the breaker must close, not wedge
+        half-open forever."""
+        import urllib.error
+        from filodb_tpu.utils.resilience import RemoteQueryError
+        resilience.configure(breaker_reset_s=0.0)
+        p = self._plan()
+        b = breaker_for(p.endpoint)
+        b.force_open()  # reset 0s → half-open on the next call
+        FaultInjector.arm("promql.remote",
+                          error=urllib.error.HTTPError(
+                              p.endpoint, 503, "unavailable", None, None))
+        with pytest.raises(RemoteQueryError, match="HTTP 503"):
+            p.do_execute(ExecContext(None, "timeseries"))
+        assert b.state == "closed"
+        # and subsequent calls are admitted (would raise CircuitOpenError
+        # if the probe slot had wedged)
+        FaultInjector.reset()
+        FaultInjector.arm("promql.remote", error=ConnectionError)
+        with pytest.raises(ConnectionError):
+            p.do_execute(ExecContext(None, "timeseries"))

@@ -259,6 +259,63 @@ class TestCircuitBreaker:
         clk.advance(10.0)
         assert b.allow()  # recovers through the normal half-open path
 
+    def test_cancel_probe_frees_slot(self):
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        assert b.allow()
+        b.cancel_probe()
+        assert b.state == "half-open"
+        assert b.allow()  # the slot is free for a later probe
+
+    def test_calling_records_success_and_failure(self):
+        b, _ = self._breaker()
+        with b.calling():
+            pass
+        assert b.state == "closed"
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                with b.calling():
+                    raise ConnectionError("down")
+        assert b.state == "open"
+
+    def test_calling_non_transport_error_releases_probe(self):
+        """Regression: an exception outside the transport set during a
+        half-open probe must release the slot — before, it left
+        ``_probing`` set and the breaker wedged half-open forever."""
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        with pytest.raises(RuntimeError):
+            with b.calling():
+                raise RuntimeError("remote answered with an app error")
+        assert b.state == "half-open"
+        assert b.allow()  # the next call may probe again
+
+    def test_calling_excludes_deadline_verdicts(self):
+        """A deadline expiry says nothing about the peer's health, even
+        though DeadlineExceeded is an OSError via TimeoutError."""
+        b, _ = self._breaker(failure_threshold=1)
+        with pytest.raises(DeadlineExceeded):
+            with b.calling():
+                raise DeadlineExceeded("out of time")
+        assert b.state == "closed"
+
+    def test_calling_body_outcome_wins(self):
+        """The body may record first (HTTP error status: the peer
+        ANSWERED, so the probe succeeds even though the call raises)."""
+        b, clk = self._breaker()
+        for _ in range(3):
+            b.record_failure()
+        clk.advance(10.0)
+        with pytest.raises(RuntimeError):
+            with b.calling() as outcome:
+                outcome.success()
+                raise RuntimeError("tagged remote error")
+        assert b.state == "closed"
+
     def test_registry_shares_instances(self):
         a = breaker_for("host:9000")
         b = breaker_for("host:9000")
