@@ -5,6 +5,14 @@ the number a dashboard fleet actually experiences (the reference's JMH
 benches stop at the query engine; this covers the full serving stack).
 
     python benchmarks/serving.py [--clients 8] [--seconds 15] [--cpu]
+
+Dashboard mode (--dashboard) measures the extent result cache on the
+workload it exists for: N panels re-rendered every refresh with the window
+slid one step, against a store that keeps ingesting. Cache-on and cache-off
+services share one memstore and every refresh cross-checks their answers,
+so the speedup number is only reported if zero stale reads occurred.
+
+    python benchmarks/serving.py --dashboard [--series 8192] [--cpu]
 """
 
 from __future__ import annotations
@@ -25,6 +33,148 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 START = 1_600_000_000
 
 
+def dashboard(args):
+    """Sliding-dashboard bench: extent result cache on vs off, live ingest.
+
+    In-process (no HTTP) so the number isolates the query path the cache
+    fronts; the HTTP rendered-response cache can't help here because every
+    refresh has different start/end params.
+    """
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.query import result_cache as rc
+    from filodb_tpu.query.model import PlannerParams, QueryContext
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    num_shards = 4
+    interval_ms = 30_000
+    step = 60
+    window_s = 21_600                    # 6h big-scan dashboard window
+    base_samples = 800                   # ~6.7h of history before t0
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        ms.setup("timeseries", s,
+                 StoreConfig(max_chunk_size=400, groups_per_shard=4,
+                             retention_ms=10**15))
+    # two namespaces so the router populates every shard at spread=1
+    half = args.series // 2
+    keysets = [machine_metrics_series(half, ns="App-2"),
+               machine_metrics_series(args.series - half, ns="App-3")]
+    t_ing0 = time.perf_counter()
+    for kk in keysets:
+        ingest_routed(ms, "timeseries",
+                      gauge_stream(kk, base_samples, start_ms=START * 1000,
+                                   interval_ms=interval_ms, seed=9),
+                      num_shards, spread=1)
+    ingest_s = time.perf_counter() - t_ing0
+
+    plain = QueryService(ms, "timeseries", num_shards, spread=1)
+    # short extents: under live ingest only the head extent re-evaluates
+    # each refresh, and its cost scales with extent+lookback length
+    cached = QueryService(ms, "timeseries", num_shards, spread=1,
+                          result_cache={"extent_steps": 8})
+
+    panels = [
+        "sum(rate(heap_usage[5m]))",
+        "sum by (host) (rate(heap_usage[5m]))",
+        "avg_over_time(heap_usage[5m])",
+        "max_over_time(heap_usage[10m])",
+        "max by (host) (avg_over_time(heap_usage[5m]))",
+    ]
+
+    def check_equiv(a, b, promql):
+        m0, m1 = a.result, b.result
+        i0 = {k: i for i, k in enumerate(m0.keys)}
+        i1 = {k: i for i, k in enumerate(m1.keys)}
+        if set(i0) != set(i1):
+            return f"{promql}: key sets differ"
+        for k, i in i0.items():
+            va = np.asarray(m0.values[i])
+            vb = np.asarray(m1.values[i1[k]])
+            if not np.array_equal(np.isnan(va), np.isnan(vb)):
+                return f"{promql}: NaN masks differ for {k}"
+            # float32 prefix sums over a 6h, 800-sample scan carry up to
+            # ~1e-3 absolute noise vs per-extent scans (eps x prefix
+            # magnitude); a stale head step would differ by a random-walk
+            # increment, O(0.1-10), so detection power is intact
+            if not np.allclose(va, vb, rtol=1e-3, atol=5e-3,
+                               equal_nan=True):
+                m = ~np.isnan(va)
+                d = np.abs(va[m] - vb[m])
+                j = int(np.argmax(d))
+                at = int(np.nonzero(m)[0][j])
+                return (f"{promql}: values differ for {k}: "
+                        f"max |d|={float(d[j]):.2e} at step {at}/"
+                        f"{len(va)} (a={float(va[m][j]):.6g} "
+                        f"b={float(vb[m][j]):.6g})")
+        return None
+
+    qe0 = START + (base_samples - 1) * interval_ms // 1000  # last sample
+    plain_lat, cached_lat, cold_lat = [], [], []
+    stale = []
+    samples_done = base_samples
+    for refresh in range(args.refreshes):
+        # live ingest: data keeps arriving between refreshes (appended
+        # synchronously so cache-on and cache-off compare the same store;
+        # delta-only — value continuity across batches doesn't matter here)
+        if refresh:
+            t_new = START * 1000 + samples_done * interval_ms
+            new_samples = step * 1000 // interval_ms
+            for kk in keysets:
+                ingest_routed(
+                    ms, "timeseries",
+                    gauge_stream(kk, new_samples, start_ms=t_new,
+                                 interval_ms=interval_ms,
+                                 seed=100 + refresh),
+                    num_shards, spread=1)
+            samples_done += new_samples
+        qe = qe0 + refresh * step
+        qs = qe - window_s
+        for promql in panels:
+            # big-scan panels return series x steps well past the default
+            # sample limit; raise it (fresh context per query)
+            t0 = time.perf_counter()
+            r_cached = cached.query_range(promql, qs, step, qe, QueryContext(
+                planner_params=PlannerParams(sample_limit=50_000_000)))
+            t1 = time.perf_counter()
+            r_plain = plain.query_range(promql, qs, step, qe, QueryContext(
+                planner_params=PlannerParams(sample_limit=50_000_000)))
+            t2 = time.perf_counter()
+            (cold_lat if refresh == 0 else cached_lat).append(t1 - t0)
+            plain_lat.append(t2 - t1)
+            err = check_equiv(r_plain, r_cached, promql)
+            if err:
+                stale.append(f"refresh {refresh}: {err}")
+
+    def pct(xs, p):
+        return round(float(np.percentile(np.array(xs), p)) * 1000, 2)
+
+    out = {
+        "metric": "dashboard_refresh_latency",
+        "series": args.series,
+        "panels": len(panels),
+        "refreshes": args.refreshes,
+        "window_s": window_s,
+        "step_s": step,
+        "ingest_seconds": round(ingest_s, 1),
+        "cache_off_p50_ms": pct(plain_lat, 50),
+        "cache_off_p99_ms": pct(plain_lat, 99),
+        "cache_cold_p50_ms": pct(cold_lat, 50),
+        "cache_warm_p50_ms": pct(cached_lat, 50),
+        "cache_warm_p99_ms": pct(cached_lat, 99),
+        "warm_speedup_p50": round(
+            pct(plain_lat, 50) / max(pct(cached_lat, 50), 1e-9), 1),
+        "cache_hits": int(rc.cache_hits.value),
+        "cache_misses": int(rc.cache_misses.value),
+        "cache_bytes": int(cached.result_cache.nbytes),
+        "stale_reads": stale[:5] if stale else 0,
+    }
+    print(json.dumps(out))
+    return 1 if stale else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--clients", type=int, default=8)
@@ -33,12 +183,19 @@ def main(argv=None):
                          "log-replica serving plane)")
     ap.add_argument("--seconds", type=float, default=15.0)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--dashboard", action="store_true",
+                    help="sliding-dashboard bench of the extent result "
+                         "cache (in-process, cache on vs off)")
+    ap.add_argument("--series", type=int, default=8192)
+    ap.add_argument("--refreshes", type=int, default=20)
     args = ap.parse_args(argv)
     if args.cpu:
         import jax
         import jax._src.xla_bridge as xb
         xb._backend_factories.pop("axon", None)  # hangs when tunnel is down
         jax.config.update("jax_platforms", "cpu")
+    if args.dashboard:
+        return dashboard(args)
 
     from filodb_tpu.client import FiloClient
     from filodb_tpu.config import ServerConfig

@@ -40,6 +40,16 @@ DEFAULTS = {
         "allow_partial": True,        # degrade instead of fail
         "partial_max_fraction": 0.5,  # max lost children per gather
     },
+    # extent result cache (filodb_tpu.query.result_cache.ResultCacheConfig):
+    # range queries split at step-aligned extent boundaries; extents ending
+    # before the mutable horizon cache without a version stamp, so live
+    # ingest only recomputes the head
+    "result_cache": {
+        "enabled": True,
+        "extent_steps": 32,           # extent length in steps
+        "max_bytes": 256 * 1024 * 1024,
+        "ooo_allowance_ms": 300_000,  # out-of-order arrival allowance
+    },
     "datasets": {
         "timeseries": {
             "num_shards": 4,
@@ -88,6 +98,7 @@ class ServerConfig:
     downsample: dict[str, dict] = field(default_factory=dict)
     engines: dict[str, str] = field(default_factory=dict)  # dataset → engine
     resilience: dict = field(default_factory=dict)  # ResilienceConfig overrides
+    result_cache: dict = field(default_factory=dict)  # ResultCacheConfig block
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -129,7 +140,8 @@ class ServerConfig:
             executor_port=cfg["executor_port"], seeds=cfg["seeds"],
             enable_failover=cfg.get("enable_failover", False),
             datasets=datasets, spreads=spreads, downsample=downsample,
-            engines=engines, resilience=cfg.get("resilience", {}))
+            engines=engines, resilience=cfg.get("resilience", {}),
+            result_cache=cfg.get("result_cache", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -7,6 +7,7 @@ parse → plan → execute against the memstore, returning StepMatrix results.
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 
@@ -43,13 +44,25 @@ class QueryService:
     # per-query deadline; every socket/HTTP timeout on the distributed
     # path derives from it (None = resilience-config default)
     query_timeout_s: float | None = None
+    # extent result cache (filodb_tpu.query.result_cache): a config dict /
+    # ResultCacheConfig / ResultCache / True enables it; None or False
+    # disables. Sits in front of exec, mesh, and adaptive engines alike.
+    result_cache: object = None
     planner: SingleClusterPlanner = field(init=False)
+
+    # monotonic construction serial: response-cache keys must survive a
+    # service being torn down and a new one allocated at the same address
+    # (id() aliases; a serial never does)
+    _serial_counter = itertools.count(1)
 
     def __post_init__(self):
         self.planner = SingleClusterPlanner(
             self.dataset, self.num_shards, self.spread,
             time_split_ms=self.time_split_ms)
         self._plan_cache: dict = {}
+        self.serial = next(QueryService._serial_counter)
+        from filodb_tpu.query.result_cache import ResultCache
+        self.result_cache = ResultCache.from_config(self.result_cache)
         self.mesh_engine = None
         if self.engine == "mesh":
             from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
@@ -66,10 +79,11 @@ class QueryService:
         from filodb_tpu.utils.tracing import span
         params = TimeStepParams(start_sec, step_sec, end_sec)
         with span("parse", promql=promql):
-            plan = parse_query(promql, params, self.lookback_ms)
+            plan = self._parse_cached(promql, params)
         return self.execute_logical(plan, qcontext)
 
-    def query_range_many(self, queries, workers: int = 8) -> list:
+    def query_range_many(self, queries, workers: int = 8,
+                         return_errors: bool = False) -> list:
         """Execute many in-flight range queries and return results in order.
         Counterpart of the reference QueryActor's concurrent dispatch on its
         ForkJoin query scheduler (``QueryActor.scala:233-237``; the JMH
@@ -82,51 +96,104 @@ class QueryService:
         link a per-query fetch costs a full RTT (~90ms measured through the
         axon tunnel); one coalesced transfer amortizes it across the whole
         batch. Each element of ``queries`` is
-        ``(promql, start_sec, step_sec, end_sec)``."""
+        ``(promql, start_sec, step_sec, end_sec)``.
+
+        The extent result cache is consulted per query first; cache-answered
+        queries skip the mesh dispatch and the batch fetch entirely (their
+        matrices are already host-resident).
+
+        With ``return_errors=True`` a failing query yields its exception at
+        its own position instead of poisoning the whole batch — one bad
+        query costs only itself, not an O(n) sequential re-run."""
         import numpy as np
 
         t0 = time.perf_counter()
-        plans = []
-        for q in queries:
+        n = len(queries)
+        plans: list = [None] * n
+        outcomes: list = [None] * n  # QueryResult | Exception per query
+        for i, q in enumerate(queries):
             promql, start_sec, step_sec, end_sec = q
             params = TimeStepParams(start_sec, step_sec, end_sec)
-            plans.append(self._parse_cached(promql, params))
+            try:
+                plans[i] = self._parse_cached(promql, params)
+            except Exception as e:  # noqa: BLE001
+                if not return_errors:
+                    raise
+                outcomes[i] = e
 
-        mesh_results = [None] * len(plans)
-        if self.mesh_engine is not None and self._mesh_eligible():
+        if self.result_cache is not None:
+            for i, plan in enumerate(plans):
+                if plan is None or outcomes[i] is not None:
+                    continue
+                try:
+                    r = self.result_cache.execute(self, plan, QueryContext())
+                except Exception as e:  # noqa: BLE001
+                    if not return_errors:
+                        raise
+                    outcomes[i] = e
+                    continue
+                if r is not None:
+                    outcomes[i] = r
+        pending = [i for i in range(n)
+                   if outcomes[i] is None and plans[i] is not None]
+
+        from filodb_tpu.query.model import QueryStats
+        stats_list = {i: QueryStats() for i in pending}
+        mesh_results = {i: None for i in pending}
+        if pending and self.mesh_engine is not None and self._mesh_eligible():
             # one device program per shared plan signature (micro-batched
             # step grids); unsupported plans fall through to the exec path
-            from filodb_tpu.query.model import QueryStats
-            stats_list = [QueryStats() for _ in plans]
-            mesh_results = self.mesh_engine.execute_many(
-                plans, self.memstore, self.dataset, stats_list)
+            try:
+                mr = self.mesh_engine.execute_many(
+                    [plans[i] for i in pending], self.memstore, self.dataset,
+                    [stats_list[i] for i in pending])
+            except Exception as e:  # noqa: BLE001
+                if not return_errors:
+                    raise
+                mr = [None] * len(pending)  # per-item exec fallback below
+            for j, i in enumerate(pending):
+                mesh_results[i] = mr[j]
 
-        results = []
-        mesh_idx = []
-        for i, plan in enumerate(plans):
-            data = mesh_results[i]
-            if data is not None:
-                mesh_idx.append(i)
-                results.append(QueryResult(data, stats_list[i], None))
+        deferred = set()
+        for i in pending:
+            if mesh_results[i] is not None:
+                outcomes[i] = QueryResult(mesh_results[i], stats_list[i],
+                                          None)
+                deferred.add(i)
             else:
-                results.append(self.execute_logical(plan, materialize=False))
+                try:
+                    outcomes[i] = self._execute_uncached(
+                        plans[i], materialize=False)
+                except Exception as e:  # noqa: BLE001
+                    if not return_errors:
+                        raise
+                    outcomes[i] = e
         # Coalesced device→host fetch: stack same-shaped lazy result buffers
         # into one device array per shape and fetch each stack once. A
         # per-query fetch costs a full RTT through the tunnel; one stacked
         # transfer amortizes it across the whole in-flight batch.
         import jax.numpy as jnp
         by_shape: dict[tuple, list[int]] = {}
-        for i, r in enumerate(results):
+        for i in pending:
+            r = outcomes[i]
+            if isinstance(r, Exception):
+                continue
             v = r.result.values
             if not isinstance(v, np.ndarray):
                 by_shape.setdefault((v.shape, str(v.dtype)), []).append(i)
         from filodb_tpu.query.exec.plan import ExecPlan
-        deferred = set(mesh_idx)
         for idxs in by_shape.values():
-            stacked = np.asarray(jnp.stack([results[i].result.values
-                                            for i in idxs]))
+            try:
+                stacked = np.asarray(jnp.stack([outcomes[i].result.values
+                                                for i in idxs]))
+            except Exception as e:  # noqa: BLE001
+                if not return_errors:
+                    raise
+                for i in idxs:
+                    outcomes[i] = e
+                continue
             for j, i in enumerate(idxs):
-                results[i].result.values = stacked[j]
+                outcomes[i].result.values = stacked[j]
                 deferred.add(i)
         # limits + stats AFTER materialization, so deferred compaction has
         # dropped empty series first (enforcing on the pre-compaction count
@@ -134,16 +201,22 @@ class QueryService:
         # mesh AND exec-path results whose fetch was deferred to this batch
         wall = time.perf_counter() - t0
         for i in sorted(deferred):
-            data = results[i].result.materialize()
-            qcontext = QueryContext()
-            ExecPlan._enforce_limits(data, qcontext)
-            results[i].stats.result_series = data.num_series
+            try:
+                data = outcomes[i].result.materialize()
+                qcontext = QueryContext()
+                ExecPlan._enforce_limits(data, qcontext)
+            except Exception as e:  # noqa: BLE001
+                if not return_errors:
+                    raise
+                outcomes[i] = e
+                continue
+            outcomes[i].stats.result_series = data.num_series
             # batched execution: the whole pass's wall time is every
             # member's latency (they completed together)
-            results[i].stats.wall_time_s = wall
-            if not results[i].query_id:
-                results[i].query_id = qcontext.query_id
-        return results
+            outcomes[i].stats.wall_time_s = wall
+            if not outcomes[i].query_id:
+                outcomes[i].query_id = qcontext.query_id
+        return outcomes
 
     def _parse_cached(self, promql: str, params: TimeStepParams):
         """PromQL parse memo — the concurrent workload cycles few distinct
@@ -168,6 +241,22 @@ class QueryService:
     def execute_logical(self, plan: lp.LogicalPlan,
                         qcontext: QueryContext | None = None,
                         materialize: bool = True) -> QueryResult:
+        qcontext = qcontext or QueryContext()
+        if self.result_cache is not None and materialize:
+            # extent result cache in front of every engine; None = plan
+            # shape (or deployment) the splitter won't touch — fall through
+            cached = self.result_cache.execute(self, plan, qcontext)
+            if cached is not None:
+                # partial results only come out of _execute_uncached (the
+                # cache's surrender path), which already counts them
+                return cached
+        return self._execute_uncached(plan, qcontext, materialize)
+
+    def _execute_uncached(self, plan: lp.LogicalPlan,
+                          qcontext: QueryContext | None = None,
+                          materialize: bool = True) -> QueryResult:
+        """Engine execution without the extent cache — the cache itself
+        evaluates per-extent sub-queries through here."""
         qcontext = qcontext or QueryContext()
         t0 = time.perf_counter()
         if isinstance(plan, (lp.LabelValues, lp.LabelNames,
@@ -324,13 +413,19 @@ class QueryBatcher:
             except queue.Empty:
                 pass
             try:
+                # per-item error capture: one poison query surfaces at its
+                # own position without forcing the old O(n) sequential
+                # re-run of the whole batch
                 results = self.svc.query_range_many(
-                    [it["params"] for it in items])
+                    [it["params"] for it in items], return_errors=True)
                 for it, r in zip(items, results):
-                    it["result"] = r
-            except Exception:
-                # isolate the failing query: run each alone so errors are
-                # attributed to their own request
+                    if isinstance(r, Exception):
+                        it["error"] = r
+                    else:
+                        it["result"] = r
+            except Exception:  # pragma: no cover - defensive
+                # a failure that escaped per-item capture (batch machinery
+                # itself); isolate by running each alone
                 for it in items:
                     try:
                         it["result"] = self.svc.query_range(*it["params"])

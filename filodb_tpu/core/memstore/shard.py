@@ -206,6 +206,12 @@ class TimeSeriesShard:
         from filodb_tpu.utils.bloom import BloomFilter
         self.evicted_keys = BloomFilter(
             store_config.evicted_pk_bloom_filter_capacity)
+        # ingest high-water timestamp (max record ts applied this process
+        # lifetime); the result cache derives its mutable horizon from it.
+        # -1 until the first ingest: a shard that hasn't ingested yet could
+        # legitimately receive rows at ANY timestamp, so nothing is
+        # provably immutable.
+        self._max_ingested_ts = -1
         if store_config.native_ingest \
                 and not store_config.trace_part_key_substrings \
                 and not store_config.device_pages:
@@ -224,6 +230,12 @@ class TimeSeriesShard:
         """Monotonic version bumped by every ingested row; query caches key
         on it."""
         return self.stats.rows_ingested.value + self.stats.partitions_purged.value
+
+    @property
+    def max_ingested_ts(self) -> int:
+        """Max record timestamp this shard has seen (both ingest lanes);
+        -1 before any ingest."""
+        return self._max_ingested_ts
 
     # ---- partition lifecycle --------------------------------------------
 
@@ -434,6 +446,10 @@ class TimeSeriesShard:
         n = core.ingest(raw, offset)
         if n < 0:
             return -1
+        from filodb_tpu.core.record import container_max_ts
+        mx = container_max_ts(raw)
+        if mx > self._max_ingested_ts:
+            self._max_ingested_ts = mx
         if core.stat(4):
             self._drain_native_parts()
         skipped, ooo = core.stat(1), core.stat(2)
@@ -480,6 +496,8 @@ class TimeSeriesShard:
             if part.ingest(rec.timestamp, rec.values):
                 n += 1
                 last_ts = rec.timestamp
+                if rec.timestamp > self._max_ingested_ts:
+                    self._max_ingested_ts = rec.timestamp
             else:
                 self.stats.out_of_order_dropped.inc()
         self._ingested_offset = max(self._ingested_offset, offset)

@@ -204,6 +204,27 @@ class RecordContainer:
         return c
 
 
+def container_max_ts(raw: bytes) -> int:
+    """Max record timestamp in a serialized v2 container, or -1.
+
+    A header-only scan (rec_len + the fixed-offset i64 ts per record): the
+    native ingest lane never builds Python records, but the shard still
+    needs its ingest high-water timestamp for the result cache's mutable
+    horizon."""
+    if not raw or raw[0] != 2:
+        return -1
+    (n,) = struct.unpack_from("<I", raw, 1)
+    off = 5
+    mx = -1
+    for _ in range(n):
+        (rec_len,) = struct.unpack_from("<I", raw, off)
+        (ts,) = struct.unpack_from("<q", raw, off + 8)
+        if ts > mx:
+            mx = ts
+        off += 4 + rec_len
+    return mx
+
+
 class BytesContainer:
     """A container backed by its serialized bytes, parsed lazily.
 

@@ -48,7 +48,16 @@ class ResponseCache:
     front of the reference; here it is built in). Keys are the RESOLVED
     query parameters, so an instant query defaulting to server time never
     aliases across seconds. A version bump (any ingest into any shard of
-    the dataset) orphans every entry for that service."""
+    the dataset) orphans every entry for that service.
+
+    Layering with the extent result cache
+    (``filodb_tpu/query/result_cache.py``): this cache sits OUTSIDE it and
+    memoizes fully-rendered JSON bytes — a hit here skips parse, execute,
+    and render, but only for byte-identical requests against an unchanged
+    dataset (idle servers, repeated panels). Under live ingest the version
+    stamp bumps every row and this cache contributes nothing; the extent
+    cache below still answers the immutable bulk of each query and
+    recomputes only the mutable head."""
 
     def __init__(self, cap: int = 1024):
         from collections import OrderedDict
@@ -94,10 +103,16 @@ def response_cache_key(svc, kind: str, params: tuple) -> tuple:
     """Canonical response-cache key, shared by both fronts so entries are
     keyed identically regardless of which server parsed the request.
     ``params`` is (query, start, step, end) for ranges; instant queries
-    key on (query, resolved_time) — extra positions are ignored."""
+    key on (query, resolved_time) — extra positions are ignored.
+
+    Services are identified by their monotonic construction ``serial``,
+    never ``id()``: a new service allocated at a freed service's address
+    would alias its cache entries (stale responses for a different
+    dataset/epoch)."""
+    serial = getattr(svc, "serial", None) or id(svc)
     if kind == "instant":
-        return (id(svc), "instant", params[0], params[1])
-    return (id(svc), "range", *params)
+        return (serial, "instant", params[0], params[1])
+    return (serial, "range", *params)
 
 
 def parse_time(s: str) -> float:

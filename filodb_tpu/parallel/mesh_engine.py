@@ -377,7 +377,7 @@ class MeshQueryEngine:
             # AggregateMapReduce). The metric label is dropped first — the
             # exec path drops it in range-function output keys before
             # grouping, so `by (_metric_)` must group on nothing there too.
-            keys = [RangeVectorKey.of(p.part_key.label_map) for p in parts]
+            keys = [p.part_key.range_vector_key for p in parts]
             if low0.agg is None:
                 gids = np.zeros(len(keys), np.int32)
                 out_keys = []

@@ -203,8 +203,7 @@ class SelectRawPartitionsExec(ExecPlan):
                     batch = build_batch(sparts, self.chunk_start,
                                         self.chunk_end, col,
                                         extra_chunks=extra_chunks)
-                keys = [RangeVectorKey.of(p.part_key.label_map)
-                        for p in sparts]
+                keys = [p.part_key.range_vector_key for p in sparts]
                 is_counter = schema.data.columns[col].is_counter
                 if len(shard.batch_cache) >= shard.batch_cache_cap:
                     shard.batch_cache.pop(next(iter(shard.batch_cache)))

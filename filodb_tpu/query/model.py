@@ -53,6 +53,16 @@ class RangeVectorKey:
             object.__setattr__(self, "_no_metric", cached)
         return cached
 
+    def __hash__(self) -> int:
+        # dict-key hot (label-aligning thousands of series per query, e.g.
+        # the extent-merge path); the dataclass-generated hash recomputes
+        # the labels-tuple hash on every call — memoize per instance
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = hash(self.labels)
+            object.__setattr__(self, "_hash", h)
+        return h
+
     def __str__(self) -> str:
         return "{" + ",".join(f"{k}={v}" for k, v in self.labels) + "}"
 

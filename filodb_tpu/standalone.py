@@ -292,7 +292,8 @@ class FiloServer:
                 self.cluster.setup_dataset(ing_cfg, logs)
                 services[name] = self.cluster.query_service(
                     name, cfg.spreads.get(name, 1),
-                    engine=cfg.engines.get(name, "mesh"))
+                    engine=cfg.engines.get(name, "mesh"),
+                    result_cache=cfg.result_cache)
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
             self.cluster.start_failure_detector()
@@ -497,7 +498,8 @@ class FiloServer:
                 self.cluster._on_event(dataset, ev)
             svc = self.cluster.query_service(
                 dataset, cfg.spreads.get(dataset, 1),
-                engine=cfg.engines.get(dataset, "mesh"))
+                engine=cfg.engines.get(dataset, "mesh"),
+                result_cache=cfg.result_cache)
             self.http.services[dataset] = svc
             self.cluster.on_heartbeat.append(
                 lambda n=dataset: poll_remote_statuses(self.cluster, n))

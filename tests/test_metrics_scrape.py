@@ -75,6 +75,16 @@ EXPECTED_NAMES = [
     "memstore_chunk_ram_bytes",
 ]
 
+# extent result cache (filodb_tpu.query.result_cache) — registered the
+# moment a cache-enabled service is built (standalone default-on)
+RESULT_CACHE_NAMES = [
+    "filodb_result_cache_hits_total",
+    "filodb_result_cache_misses_total",
+    "filodb_result_cache_partial_hits_total",
+    "filodb_result_cache_evictions_total",
+    "filodb_result_cache_bytes",
+]
+
 
 def _free_port():
     with socket.socket() as s:
@@ -145,6 +155,21 @@ class TestMetricsScrape:
         missing = [n for n in EXPECTED_NAMES if n not in names_present]
         assert not missing, f"missing metric families: {missing}"
         assert len([n for n in EXPECTED_NAMES if n in names_present]) >= 40
+
+        # result-cache counters are exposed, and the range query above
+        # (splittable: sum(rate(...))) actually drove them
+        missing_rc = [n for n in RESULT_CACHE_NAMES
+                      if n not in names_present]
+        assert not missing_rc, f"missing result-cache metrics: {missing_rc}"
+
+        def total(name):
+            return sum(float(line.rsplit(" ", 1)[1])
+                       for line in text.splitlines()
+                       if line.startswith(name + "{") or
+                       line.split(" ")[0] == name)
+
+        assert total("filodb_result_cache_hits_total") \
+            + total("filodb_result_cache_misses_total") >= 1
 
         # per-shard tagging: both shards of THIS dataset expose the
         # counter (the registry is process-wide; other tests' datasets may

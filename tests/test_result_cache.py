@@ -1,0 +1,394 @@
+"""Extent result cache: split+cached evaluation must be indistinguishable
+from uncached single-shot evaluation.
+
+Property-style equivalence across plan shapes (aggregated rates, over_time
+functions, binary joins, histogram quantiles), including seams where an
+extent boundary lands mid-lookback-window; plus the safety properties:
+partial (fault-injected) results are never cached, mutable-horizon entries
+self-invalidate under live ingest, and unsafe plan shapes bypass wholesale.
+
+Equivalence is semantic, not bit-level: the windowed kernels are
+prefix-sum based, so evaluating a step over a different chunk batch can
+differ in the final ulp of the kernel dtype. Asserted: identical key sets,
+identical step grids, identical NaN masks, values allclose at kernel-dtype
+tolerance.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.query import result_cache as rc
+from filodb_tpu.query.result_cache import (
+    ResultCache,
+    ResultCacheConfig,
+    plan_signature,
+    split_extents,
+    splittable_grid,
+)
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    histogram_series,
+    histogram_stream,
+    machine_metrics_series,
+)
+from filodb_tpu.utils.resilience import FaultInjector, reset_breakers
+
+NUM_SHARDS = 4
+START = 1_600_000_000  # epoch sec
+INTERVAL = 10_000
+N_SAMPLES = 720
+STEP = 60  # query step, seconds
+
+QS = START + 100        # deliberately extent-unaligned query start
+QE = START + 7000
+
+
+def build_store():
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    streams = [
+        gauge_stream(machine_metrics_series(10, ns="App-2"), N_SAMPLES,
+                     start_ms=START * 1000, interval_ms=INTERVAL, seed=11),
+        counter_stream(counter_series(6, ns="App-1"), N_SAMPLES,
+                       start_ms=START * 1000, interval_ms=INTERVAL, seed=3,
+                       reset_every=250),
+        histogram_stream(histogram_series(4), N_SAMPLES,
+                         start_ms=START * 1000, interval_ms=INTERVAL,
+                         seed=7),
+    ]
+    for stream in streams:
+        ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+@pytest.fixture(scope="module")
+def store():
+    return build_store()
+
+
+@pytest.fixture(scope="module")
+def plain(store):
+    return QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+
+
+@pytest.fixture
+def cached(store):
+    # extent_steps=7 with a 5m window: every extent boundary lands inside
+    # some series' lookback window (420s extents vs 300s windows)
+    return QueryService(store, "timeseries", NUM_SHARDS, spread=1,
+                        result_cache={"extent_steps": 7})
+
+
+def assert_equivalent(direct, split):
+    m0, m1 = direct.result, split.result
+    i0 = {k: i for i, k in enumerate(m0.keys)}
+    i1 = {k: i for i, k in enumerate(m1.keys)}
+    assert set(i0) == set(i1)
+    if m0.num_series:
+        assert np.array_equal(m0.steps_ms, m1.steps_ms)
+        if m0.les is not None or m1.les is not None:
+            assert np.array_equal(np.asarray(m0.les), np.asarray(m1.les))
+    for k, i in i0.items():
+        a = np.asarray(m0.values[i])
+        b = np.asarray(m1.values[i1[k]])
+        assert np.array_equal(np.isnan(a), np.isnan(b)), k
+        # kernel-dtype tolerance (float32 on default config)
+        assert np.allclose(a, b, rtol=2e-5, atol=1e-9, equal_nan=True), k
+
+
+PLAN_SHAPES = [
+    "sum(rate(http_requests_total[5m]))",
+    "increase(http_requests_total[5m])",
+    "avg_over_time(heap_usage[3m])",
+    "max_over_time(heap_usage[7m])",
+    "sum by (host) (rate(heap_usage[2m]))",
+    "count(avg_over_time(heap_usage[3m]))",
+    # binary join (grouped keys, one-to-one)
+    "sum(rate(http_requests_total[5m]))"
+    " / sum(increase(http_requests_total[5m]))",
+    # scalar-vector arithmetic
+    "avg_over_time(heap_usage[3m]) * 2 + 1",
+    # histogram quantile over aggregated bucket rates
+    "histogram_quantile(0.9, sum by (le) (rate(http_req_latency[5m])))",
+    # raw histogram-valued matrix through the cache
+    "rate(http_req_latency[5m])",
+    "topk(3, avg_over_time(heap_usage[3m]))",
+    # plain selector sampling (PeriodicSeries, no window)
+    "heap_usage",
+]
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("promql", PLAN_SHAPES)
+    def test_cold_and_warm_match_single_shot(self, plain, cached, promql):
+        direct = plain.query_range(promql, QS, STEP, QE)
+        cold = cached.query_range(promql, QS, STEP, QE)
+        warm = cached.query_range(promql, QS, STEP, QE)
+        assert_equivalent(direct, cold)
+        assert_equivalent(direct, warm)
+
+    def test_seam_mid_lookback_window(self, plain, cached):
+        # 90s step with 7-step extents: boundary every 630s, lookback 300s
+        # — windows straddle boundaries at non-step-multiple offsets
+        q = "sum(rate(http_requests_total[5m]))"
+        for shift in (0, 1, 3, 5):
+            s, e = QS + shift * 90, QS + 4000 + shift * 90
+            assert_equivalent(plain.query_range(q, s, 90, e),
+                              cached.query_range(q, s, 90, e))
+
+    def test_sliding_window_reuses_extents(self, plain, cached):
+        q = "avg_over_time(heap_usage[3m])"
+        cached.query_range(q, QS, STEP, QE)
+        h0, m0 = rc.cache_hits.value, rc.cache_misses.value
+        direct = plain.query_range(q, QS + STEP, STEP, QE + STEP)
+        slid = cached.query_range(q, QS + STEP, STEP, QE + STEP)
+        assert_equivalent(direct, slid)
+        # full-extent caching: a one-step slide with no intervening ingest
+        # re-reads every extent (including the head — same full extent,
+        # same version) without a single re-evaluation
+        n_slid = len(split_extents((QS + STEP) * 1000, STEP * 1000,
+                                   (QE + STEP) * 1000, 7))
+        assert rc.cache_hits.value - h0 == n_slid
+        assert rc.cache_misses.value - m0 == 0
+        # extending past the cached tail extent misses only the new extent
+        p0 = rc.cache_partial_hits.value
+        h1, m1 = rc.cache_hits.value, rc.cache_misses.value
+        ext_s = 7 * STEP
+        far = QE + 2 * ext_s  # guaranteed beyond the cached tail extent
+        assert_equivalent(plain.query_range(q, QS, STEP, far),
+                          cached.query_range(q, QS, STEP, far))
+        assert rc.cache_hits.value - h1 >= 10
+        assert 1 <= rc.cache_misses.value - m1 <= 3
+        assert rc.cache_partial_hits.value == p0 + 1
+
+    def test_unaligned_starts_share_interior_extents(self, cached):
+        q = "sum(rate(http_requests_total[5m]))"
+        cached.query_range(q, QS, STEP, QE)
+        h0 = rc.cache_hits.value
+        cached.query_range(q, QS + 7 * STEP, STEP, QE)  # one extent shorter
+        assert rc.cache_hits.value > h0
+
+
+class TestSplitMath:
+    def test_split_extents_cover_grid_exactly(self):
+        for start in (0, 100, 419_000, 420_000):
+            for total in (1, 7, 8, 50):
+                step = 60_000
+                end = start + (total - 1) * step
+                exts = split_extents(start, step, end, 7)
+                # coverage: concatenated per-extent grids == full grid
+                got = np.concatenate([np.arange(es, ee + 1, step)
+                                      for es, ee in exts])
+                want = np.arange(start, end + 1, step)
+                assert np.array_equal(got, want), (start, total)
+                # alignment: interior boundaries are absolute multiples
+                for es, ee in exts[:-1]:
+                    assert (ee + step) // (7 * step) != es // (7 * step)
+
+    def test_signature_blanks_only_evaluation_range(self):
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+        p1 = parse_query("sum(rate(http_requests_total[5m]))",
+                         TimeStepParams(QS, STEP, QE), 300_000)
+        p2 = parse_query("sum(rate(http_requests_total[5m]))",
+                         TimeStepParams(QS + 600, STEP, QE + 600), 300_000)
+        p3 = parse_query("sum(rate(http_requests_total[6m]))",
+                         TimeStepParams(QS, STEP, QE), 300_000)
+        assert plan_signature(p1) == plan_signature(p2)
+        assert plan_signature(p1) != plan_signature(p3)
+        assert hash(plan_signature(p1)) == hash(plan_signature(p2))
+
+    def test_splittable_grid_bypasses(self):
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+
+        def grid(q, step=STEP):
+            return splittable_grid(
+                parse_query(q, TimeStepParams(QS, step, QE), 300_000))
+
+        assert grid("sum(rate(heap_usage[5m]))") is not None
+        # instant query: step 0
+        assert splittable_grid(parse_query(
+            "heap_usage", TimeStepParams(QS, 0, QS), 300_000)) is None
+        # subquery / absent / sort / limit
+        assert grid("max_over_time(rate(heap_usage[1m])[10m:1m])") is None
+        assert grid("absent_over_time(heap_usage[5m])") is None
+        assert grid("sort(avg_over_time(heap_usage[3m]))") is None
+        # @ modifier pins evaluation time
+        assert grid(f"avg_over_time(heap_usage[3m] @ {START + 500})") is None
+
+
+class TestSafety:
+    def test_partial_results_never_cached(self, store):
+        FaultInjector.reset()
+        reset_breakers()
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1,
+                           result_cache={"extent_steps": 7})
+        try:
+            # every gather loses exactly the shard-0 leaf (tolerable,
+            # below the partial threshold at the 4-way fan-out) → every
+            # evaluation, extent or whole, comes back partial
+            FaultInjector.arm(
+                "gather.child", error=ConnectionError,
+                match=lambda ctx: list(ctx.get("shards") or []) == [0])
+            r = svc.query_range("sum(rate(http_requests_total[5m]))",
+                                QS, STEP, QE)
+            assert r.partial
+            assert len(svc.result_cache) == 0  # nothing stored
+        finally:
+            FaultInjector.reset()
+            reset_breakers()
+        # with faults cleared, the same query is whole and correct again —
+        # nothing partial was left behind to serve
+        r2 = svc.query_range("sum(rate(http_requests_total[5m]))",
+                             QS, STEP, QE)
+        assert not r2.partial
+        assert len(svc.result_cache) > 0
+
+    def test_live_ingest_invalidates_head_not_history(self):
+        # fresh store so ingest here can't interfere with other tests
+        ms = TimeSeriesMemStore()
+        for s in range(NUM_SHARDS):
+            ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                                  groups_per_shard=4))
+        keys = machine_metrics_series(12, ns="App-9")
+        keys2 = machine_metrics_series(12, ns="App-8")
+        for kk in (keys, keys2):
+            ingest_routed(ms, "timeseries",
+                          gauge_stream(kk, 360, start_ms=START * 1000,
+                                       interval_ms=INTERVAL, seed=5),
+                          NUM_SHARDS, spread=1)
+        svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                           result_cache={"extent_steps": 7})
+        plain = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        q = "avg_over_time(heap_usage[3m])"
+        qs, qe = START + 100, START + 3500
+        svc.query_range(q, qs, STEP, qe)  # populate
+        # live ingest: 60 more samples continuing the stream
+        for kk in (keys, keys2):
+            ingest_routed(ms, "timeseries",
+                          gauge_stream(kk, 420, start_ms=START * 1000,
+                                       interval_ms=INTERVAL, seed=5),
+                          NUM_SHARDS, spread=1)
+        # zero stale reads: the cached head must not mask the new rows
+        assert_equivalent(plain.query_range(q, qs, STEP, qe),
+                          svc.query_range(q, qs, STEP, qe))
+
+    def test_immutable_extents_survive_version_bumps(self):
+        ms = TimeSeriesMemStore()
+        for s in range(NUM_SHARDS):
+            ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                                  groups_per_shard=4))
+        keys = machine_metrics_series(12, ns="App-9")
+        keys2 = machine_metrics_series(12, ns="App-8")
+        for kk in (keys, keys2):
+            ingest_routed(ms, "timeseries",
+                          gauge_stream(kk, 720, start_ms=START * 1000,
+                                       interval_ms=INTERVAL, seed=5),
+                          NUM_SHARDS, spread=1)
+        # precondition: every shard ingested, so the horizon is real —
+        # an empty shard (max_ts -1) conservatively disables immutability
+        assert all(s.max_ingested_ts > 0
+                   for s in ms.shards_for("timeseries"))
+        svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                           result_cache={"extent_steps": 7})
+        q = "avg_over_time(heap_usage[3m])"
+        # query well behind the horizon (max ts - 300s allowance)
+        qs, qe = START + 100, START + 3000
+        svc.query_range(q, qs, STEP, qe)
+        h0 = rc.cache_hits.value
+        # bump data_version far past the head (new rows near max ts only)
+        for kk in (keys, keys2):
+            ingest_routed(ms, "timeseries",
+                          gauge_stream(kk, 740, start_ms=START * 1000,
+                                       interval_ms=INTERVAL, seed=5),
+                          NUM_SHARDS, spread=1)
+        svc.query_range(q, qs, STEP, qe)
+        # every extent of the historical window is immutable: all hits
+        assert rc.cache_hits.value - h0 == len(
+            split_extents(qs * 1000, STEP * 1000, qe * 1000, 7))
+
+    def test_eviction_respects_byte_budget(self, store):
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1,
+                           result_cache={"extent_steps": 7,
+                                         "max_bytes": 20_000})
+        e0 = rc.cache_evictions.value
+        for i in range(6):
+            svc.query_range(f"avg_over_time(heap_usage[{i + 2}m])",
+                            QS, STEP, QE)
+        assert svc.result_cache.nbytes <= 20_000
+        assert rc.cache_evictions.value > e0
+
+    def test_remote_shards_bypass(self, store):
+        # a coordinator facade claiming more shards than are local must
+        # not trust local versions/horizons
+        from filodb_tpu.promql.parser import TimeStepParams
+        svc = QueryService(store, "timeseries", NUM_SHARDS + 1, spread=1,
+                           result_cache={"extent_steps": 7})
+        plan = svc._parse_cached("avg_over_time(heap_usage[3m])",
+                                 TimeStepParams(QS, STEP, QE))
+        assert svc.result_cache.execute(svc, plan) is None
+
+    def test_instant_queries_bypass(self, plain, cached):
+        d = plain.query_instant("sum(heap_usage)", START + 3000)
+        c = cached.query_instant("sum(heap_usage)", START + 3000)
+        assert_equivalent(d, c)
+        assert len(cached.result_cache) == 0
+
+
+class TestBatchErrors:
+    def test_poison_query_isolated(self, cached):
+        good = ("avg_over_time(heap_usage[3m])", QS, STEP, QE)
+        bad_parse = ("sum(rate(heap_usage[5m])", QS, STEP, QE)  # unbalanced
+        out = cached.query_range_many([good, bad_parse, good],
+                                      return_errors=True)
+        assert not isinstance(out[0], Exception)
+        assert isinstance(out[1], Exception)
+        assert not isinstance(out[2], Exception)
+        assert_equivalent(out[0], out[2])
+
+    def test_batcher_surfaces_per_item_errors(self, cached):
+        from filodb_tpu.coordinator.query_service import QueryBatcher
+        b = QueryBatcher(cached)
+        r = b.query_range("avg_over_time(heap_usage[3m])", QS, STEP, QE)
+        assert r.result.num_series > 0
+        with pytest.raises(Exception):
+            b.query_range("sum(rate(heap_usage[5m])", QS, STEP, QE)
+
+    def test_default_raise_behavior_unchanged(self, cached):
+        with pytest.raises(Exception):
+            cached.query_range_many(
+                [("sum(rate(heap_usage[5m])", QS, STEP, QE)])
+
+
+class TestResponseCacheKey:
+    def test_serial_not_id(self, store):
+        from filodb_tpu.http.server import response_cache_key
+        a = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        b = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        assert a.serial != b.serial
+        pa = response_cache_key(a, "range", ("q", 1, 2, 3))
+        pb = response_cache_key(b, "range", ("q", 1, 2, 3))
+        assert pa != pb
+        assert pa[0] == a.serial  # stable across the service's lifetime
+
+
+class TestConfig:
+    def test_from_config_forms(self):
+        assert ResultCache.from_config(None) is None
+        assert ResultCache.from_config(False) is None
+        assert ResultCache.from_config({"enabled": False}) is None
+        assert isinstance(ResultCache.from_config(True), ResultCache)
+        c = ResultCache.from_config({"extent_steps": 5, "max_bytes": 123})
+        assert c.config.extent_steps == 5
+        assert c.config.max_bytes == 123
+        cc = ResultCacheConfig(extent_steps=9)
+        assert ResultCache.from_config(cc).config.extent_steps == 9
+        same = ResultCache(ResultCacheConfig())
+        assert ResultCache.from_config(same) is same
