@@ -1,0 +1,115 @@
+"""Distributed aggregation pushdown benchmark: wire bytes + root latency
+vs series cardinality, pushdown on/off.
+
+The reference ships one row per group from each leaf node
+(``AggrOverRangeVectors.scala``); this measures what that buys on our
+TCP plan-shipping path: every shard child of a ``sum(rate(...)) by``
+query executes on a remote ``PlanExecutorServer`` and the root either
+gathers full per-series matrices (pushdown off) or per-group partials
+(pushdown on). Frame compression is active in both modes, so the
+reported reduction is attributable to the pushdown alone.
+
+    python benchmarks/dist_agg.py            # standalone, one JSON line
+    python benchmarks/run_benchmarks.py --only dist_agg
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+N_SAMPLES = 40
+INTERVAL_MS = 15_000
+REPEAT = 3
+
+QUERY = "sum(rate(heap_usage[2m])) by (host)"
+QS = START + 150
+QE = START + N_SAMPLES * (INTERVAL_MS // 1000)
+STEP = 60
+
+
+def _build(cardinality: int):
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=400,
+                                              groups_per_shard=4))
+    stream = gauge_stream(machine_metrics_series(cardinality), N_SAMPLES,
+                          start_ms=START * 1000, interval_ms=INTERVAL_MS,
+                          batch=1000, seed=5)
+    ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+def _measure(svc, mode: str):
+    """(min wall seconds, wire bytes received per query) for one mode."""
+    from filodb_tpu.coordinator import remote as rm
+
+    svc.planner.agg_pushdown = mode
+    svc.query_range(QUERY, QS, STEP, QE)  # warm compile + connections
+    best, nbytes = float("inf"), 0
+    for _ in range(REPEAT):
+        b0 = rm.BYTES_RECEIVED.value
+        t0 = time.perf_counter()
+        svc.query_range(QUERY, QS, STEP, QE)
+        best = min(best, time.perf_counter() - t0)
+        nbytes = rm.BYTES_RECEIVED.value - b0
+    return best, nbytes
+
+
+def bench_dist_agg(cardinalities=(1024, 8192)):
+    from filodb_tpu.coordinator import remote as rm
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.coordinator.remote import (
+        PlanExecutorServer,
+        RemotePlanDispatcher,
+        reset_pool,
+    )
+
+    points = []
+    for card in cardinalities:
+        ms = _build(card)
+        srv = PlanExecutorServer(ms).start()
+        disp = RemotePlanDispatcher("127.0.0.1", srv.port)
+        svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        svc.planner.dispatcher_for_shard = lambda s: disp
+        try:
+            t_off, b_off = _measure(svc, "off")
+            t_on, b_on = _measure(svc, "auto")
+        finally:
+            srv.stop()
+            reset_pool()
+        points.append({
+            "series": card,
+            "bytes_off": b_off, "bytes_on": b_on,
+            "bytes_reduction_x": round(b_off / max(b_on, 1), 1),
+            "latency_off_ms": round(t_off * 1e3, 1),
+            "latency_on_ms": round(t_on * 1e3, 1),
+        })
+    ratio = (rm.COMPRESS_BYTES_IN.value
+             / max(rm.COMPRESS_BYTES_OUT.value, 1))
+    return {"metric": "dist_agg_pushdown", "query": QUERY,
+            "shards": NUM_SHARDS, "remote": True,
+            "points": points,
+            "wire_compression_ratio": round(ratio, 2),
+            "unit": "bytes + ms per query"}
+
+
+def main():
+    out = bench_dist_agg()
+    out["benchmark"] = "dist_agg"
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

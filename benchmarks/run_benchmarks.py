@@ -450,6 +450,12 @@ def bench_mesh_churn():
             "skew": "10:1 over 4 shards", "unit": "queries/sec"}
 
 
+def _bench_dist_agg():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dist_agg import bench_dist_agg
+    return bench_dist_agg()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -463,6 +469,7 @@ ALL = {
     "query_odp": bench_query_odp,
     "dict_string": bench_dict_string,
     "mesh_churn": bench_mesh_churn,
+    "dist_agg": _bench_dist_agg,
 }
 
 

@@ -20,6 +20,7 @@ from filodb_tpu.query.exec.plan import (
     DistConcatExec,
     EmptyResultExec,
     ExecPlan,
+    InProcessPlanDispatcher,
     PlanDispatcher,
     ReduceAggregateExec,
     ScalarBinaryOperationExec,
@@ -31,6 +32,12 @@ from filodb_tpu.query.exec.plan import (
     VectorFromScalarExec,
 )
 from filodb_tpu.query.model import QueryContext
+from filodb_tpu.utils.metrics import get_counter
+
+# two-phase aggregation pushdown decisions: Aggregate materializations that
+# pushed a map stage into the children vs kept the full-gather path
+PUSHDOWN_APPLIED = get_counter("filodb_agg_pushdown_applied")
+PUSHDOWN_BYPASSED = get_counter("filodb_agg_pushdown_bypassed")
 
 
 class QueryPlanner:
@@ -175,9 +182,49 @@ class SingleClusterPlanner(QueryPlanner):
 
     # -- aggregates / joins --
 
+    # two-phase pushdown policy: "auto" pushes the map stage only when at
+    # least one child leaves the process (the win is wire bytes; local
+    # multi-shard plans keep the single big device reduce), "always" pushes
+    # whenever the shape allows (tests/benchmarks), "off" never pushes
+    agg_pushdown: str = "auto"
+
+    def _agg_pushdown_leaves(self, plan: lp.Aggregate,
+                             inner: ExecPlan) -> "list[ExecPlan] | None":
+        """Selector leaves to push the map stage into, or None to bypass.
+
+        Shape gate: the map stage rides the leaf transformer chains, so the
+        inner plan must be a plain scatter-gather of selector leaves (any
+        intermediate transformer or non-leaf child would see
+        already-aggregated rows)."""
+        if self.agg_pushdown == "off" or plan.op not in tf.AGG_PUSHDOWN_OPS:
+            return None
+        if isinstance(inner, SelectRawPartitionsExec):
+            leaves = [inner]
+        elif (isinstance(inner, DistConcatExec) and not inner.transformers
+              and all(isinstance(c, SelectRawPartitionsExec)
+                      for c in inner.children_plans)):
+            leaves = inner.children_plans
+        else:
+            return None
+        if self.agg_pushdown != "always" and all(
+                isinstance(c.dispatcher, InProcessPlanDispatcher)
+                for c in leaves):
+            return None  # all-local: keep the single big device reduce
+        return leaves
+
     def _mat_Aggregate(self, plan: lp.Aggregate, q) -> ExecPlan:
         inner = self._walk(plan.vector, q)
         params = tuple(p for p in plan.params)
+        leaves = self._agg_pushdown_leaves(plan, inner)
+        if leaves is not None:
+            PUSHDOWN_APPLIED.inc()
+            for c in leaves:
+                c.add_transformer(tf.AggregatePartialMapper(
+                    plan.op, params, plan.by, plan.without))
+            return ReduceAggregateExec(children_plans=leaves, op=plan.op,
+                                       params=params, by=plan.by,
+                                       without=plan.without, pushdown=True)
+        PUSHDOWN_BYPASSED.inc()
         return ReduceAggregateExec(children_plans=[inner], op=plan.op,
                                    params=params, by=plan.by,
                                    without=plan.without)

@@ -23,17 +23,48 @@ import socket
 import socketserver
 import struct
 import threading
+import zlib
 
 from filodb_tpu.coordinator.wire import MAX_FRAME, decode, encode
 from filodb_tpu.query.exec.plan import ExecContext, PlanDispatcher
 from filodb_tpu.query.model import QueryContext
+from filodb_tpu.utils.metrics import GaugeFn, get_counter
 from filodb_tpu.utils.resilience import (
     FaultInjector,
     breaker_for,
     default_retry_policy,
 )
+from filodb_tpu.utils.tracing import span
 
 log = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# frame compression. The length word's high bit flags a zlib-compressed
+# payload (MAX_FRAME < 2^31 keeps the bit free); both sides always DECODE
+# compressed frames, but only SEND them after the ("hello", {"compress":
+# True}) capability exchange, so a pre-compression peer never receives a
+# frame it cannot parse — its reply to the hello is ("err", ...), which the
+# dialer records as "no compression" and the connection stays usable.
+
+_FLAG_COMPRESSED = 0x8000_0000
+WIRE_COMPRESS_MIN = 4096  # frames below this aren't worth the zlib cycles
+WIRE_COMPRESS_LEVEL = 3  # favor throughput; payloads are pickled arrays
+
+FRAMES_COMPRESSED = get_counter("filodb_wire_frames_compressed")
+FRAMES_RAW = get_counter("filodb_wire_frames_raw")
+COMPRESS_BYTES_IN = get_counter("filodb_wire_compress_bytes_in")
+COMPRESS_BYTES_OUT = get_counter("filodb_wire_compress_bytes_out")
+BYTES_SENT = get_counter("filodb_remote_bytes_sent")
+BYTES_RECEIVED = get_counter("filodb_remote_bytes_received")
+
+GaugeFn("filodb_wire_compression_ratio",
+        lambda: (COMPRESS_BYTES_IN.value / COMPRESS_BYTES_OUT.value)
+        if COMPRESS_BYTES_OUT.value else None)
+
+# per-peer capability memo (keyed (host, port)): False once a peer rejects
+# the hello, so later dials skip the doomed exchange. Sockets can't carry
+# the flag themselves (socket.socket defines __slots__).
+_peer_caps: dict[tuple[str, int], bool] = {}
 
 
 def cluster_secret() -> str | None:
@@ -51,6 +82,7 @@ def make_authed_handler(get_secret, handle, log_label: str):
         def handle(self):
             secret = get_secret()
             authed = secret is None
+            compress = False  # per-connection: set by the hello exchange
             try:
                 while True:
                     msg = _recv_msg(self.request,
@@ -64,7 +96,17 @@ def make_authed_handler(get_secret, handle, log_label: str):
                             continue
                         _send_msg(self.request, ("err", "auth required"))
                         return  # drop the unauthenticated connection
-                    _send_msg(self.request, handle(msg))
+                    if msg[0] == "hello" and len(msg) == 2 \
+                            and isinstance(msg[1], dict):
+                        # capability exchange (shared by every framed
+                        # server so the protocol cannot drift); the reply
+                        # itself is never compressed — the client only
+                        # learns our capability from it
+                        compress = bool(msg[1].get("compress"))
+                        _send_msg(self.request,
+                                  ("ok", {"compress": compress}))
+                        continue
+                    _send_msg(self.request, handle(msg), compress=compress)
             except (ConnectionError, EOFError, OSError):
                 pass
             except Exception as e:  # pragma: no cover
@@ -77,22 +119,55 @@ def make_authed_handler(get_secret, handle, log_label: str):
     return Handler
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
+def _send_msg(sock: socket.socket, obj, compress: bool = False) -> int:
+    """Frame and send one message; returns bytes written to the wire."""
     payload = encode(obj)
     if len(payload) > MAX_FRAME:
         raise ValueError(f"frame {len(payload)} exceeds cap {MAX_FRAME}")
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
+    word = len(payload)
+    if compress and len(payload) >= WIRE_COMPRESS_MIN:
+        packed = zlib.compress(payload, WIRE_COMPRESS_LEVEL)
+        if len(packed) < len(payload):
+            COMPRESS_BYTES_IN.inc(len(payload))
+            COMPRESS_BYTES_OUT.inc(len(packed))
+            FRAMES_COMPRESSED.inc()
+            payload = packed
+            word = len(payload) | _FLAG_COMPRESSED
+        else:  # incompressible — ship raw rather than grow the frame
+            FRAMES_RAW.inc()
+    else:
+        FRAMES_RAW.inc()
+    sock.sendall(struct.pack("<I", word) + payload)
+    return 4 + len(payload)
 
 
 AUTH_FRAME_CAP = 4096  # pre-auth frames must be tiny (auth messages are)
 
 
-def _recv_msg(sock: socket.socket, cap: int = MAX_FRAME):
+def _recv_frame(sock: socket.socket, cap: int = MAX_FRAME):
+    """Receive one frame; returns (decoded message, wire bytes read)."""
     hdr = _recv_exact(sock, 4)
-    (ln,) = struct.unpack("<I", hdr)
+    (word,) = struct.unpack("<I", hdr)
+    ln = word & ~_FLAG_COMPRESSED
     if ln > cap:
         raise ConnectionError(f"frame {ln} exceeds cap {cap}")
-    return decode(_recv_exact(sock, ln))
+    payload = _recv_exact(sock, ln)
+    if word & _FLAG_COMPRESSED:
+        # bounded inflate: cap what a hostile/buggy peer can expand to —
+        # the decompressed payload obeys the same cap as a raw frame
+        d = zlib.decompressobj()
+        try:
+            payload = d.decompress(payload, cap + 1)
+        except zlib.error as e:
+            raise ConnectionError(f"bad compressed frame: {e}") from e
+        if len(payload) > cap or d.unconsumed_tail:
+            raise ConnectionError(
+                f"decompressed frame exceeds cap {cap}")
+    return decode(payload), 4 + ln
+
+
+def _recv_msg(sock: socket.socket, cap: int = MAX_FRAME):
+    return _recv_frame(sock, cap)[0]
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -270,6 +345,20 @@ class RemotePlanDispatcher(PlanDispatcher):
             if resp[0] != "ok":
                 sock.close()
                 raise ConnectionError("cluster auth rejected")
+        key = (self.host, self.port)
+        if _peer_caps.get(key) is not False:
+            # negotiate frame compression; a pre-compression peer answers
+            # ("err", "unknown message 'hello'") and the connection stays
+            # usable — remember the refusal so later dials skip the
+            # exchange
+            try:
+                _send_msg(sock, ("hello", {"compress": True}))
+                resp = _recv_msg(sock)
+            except TRANSPORT_ERRORS:
+                _close_quietly(sock)
+                raise
+            _peer_caps[key] = (resp[0] == "ok" and isinstance(resp[1], dict)
+                               and bool(resp[1].get("compress")))
         return sock
 
     def _drop_conn(self):
@@ -288,12 +377,15 @@ class RemotePlanDispatcher(PlanDispatcher):
             # timeout (a prior short-timeout ping must not poison a later
             # long call)
             sock.settimeout(t)
-            _send_msg(sock, msg)
-            resp = _recv_msg(sock)
+            nsent = _send_msg(sock, msg,
+                              compress=_peer_caps.get(key, False))
+            resp, nrecv = _recv_frame(sock)
         except self.TRANSPORT_ERRORS:
             _close_quietly(sock)
             raise
         _pool.checkin(key, sock)
+        BYTES_SENT.inc(nsent)
+        BYTES_RECEIVED.inc(nrecv)
         return resp
 
     def dispatch(self, plan, ctx):
@@ -313,7 +405,8 @@ class RemotePlanDispatcher(PlanDispatcher):
         # a DeadlineExceeded (raised before even dialing) or an open
         # breaker must not count against a healthy peer — and guarantees
         # a half-open probe reports exactly one outcome
-        with breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
+        with span("dispatch", peer=self.peer), \
+                breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
             resp = default_retry_policy().call(
                 attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
         if resp[0] == "ok":

@@ -24,6 +24,7 @@ snapshots (encoded chunks are immutable; the write buffer is copied on read).
 from __future__ import annotations
 
 import logging
+import time as _time
 from dataclasses import dataclass, field
 
 from filodb_tpu.core.memstore.index import INGESTING, PartKeyIndex
@@ -503,7 +504,6 @@ class TimeSeriesShard:
         self._ingested_offset = max(self._ingested_offset, offset)
         self.stats.rows_ingested.inc(n)
         if last_ts > 0:
-            import time as _time
             self.stats.ingestion_clock_delay.set(
                 int(_time.time() * 1000) - last_ts)
         return n
@@ -517,7 +517,6 @@ class TimeSeriesShard:
     def flush_group(self, group: int, ingestion_time: int | None = None) -> int:
         """Flush all dirty partitions in a group (reference ``doFlushSteps``).
         Returns number of chunks written."""
-        import time as _time
         if ingestion_time is None:
             ingestion_time = int(_time.time() * 1000)
         written = 0
@@ -635,7 +634,6 @@ class TimeSeriesShard:
         persisted chunk timestamp so WAL replay of rows that were flushed
         just before the crash (ingested mid-flush, above the checkpoint) is
         deduplicated instead of double-written."""
-        import time as _time
         t0 = _time.perf_counter()
         try:
             return self._recover_index_inner()
@@ -723,7 +721,6 @@ class TimeSeriesShard:
     def snapshot_index(self) -> int:
         """Serialize + persist the index snapshot (reference: the Lucene
         index directory surviving restarts). Returns snapshot bytes."""
-        import time as _time
         from filodb_tpu.core.memstore.index_snapshot import save_snapshot
         chunk_token, pk_token = self.column_store.update_tokens(
             self.dataset, self.shard_num)
@@ -740,7 +737,6 @@ class TimeSeriesShard:
     def purge_expired(self, now_ms: int) -> int:
         """Drop partitions whose data is entirely past retention
         (reference TTL purge ``TimeSeriesShard.scala:838``)."""
-        import time as _time
         cutoff = now_ms - self.config.retention_ms
         purged = 0
         t0 = _time.perf_counter()
@@ -872,7 +868,6 @@ class TimeSeriesShard:
         memory fits the shard budget (reference eviction under memory
         pressure with time-ordered reclaim, ``BlockManager`` "time-ordered"
         lists). Returns chunks evicted."""
-        import time as _time
         budget = budget_bytes if budget_bytes is not None \
             else self.config.shard_mem_mb * 1024 * 1024
         used = self.chunk_bytes()

@@ -28,6 +28,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # newer jax exports shard_map at top level (check_vma kwarg)
+    _shard_map = jax.shard_map
+except AttributeError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 from filodb_tpu.query.engine.kernels import fdtype
 
 
@@ -317,7 +326,7 @@ def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
 
         in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
                                     window, raw)
-        return jax.shard_map(
+        return _shard_map(
             kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P("shard", None) if agg is None else P(None, None),
             check_vma=False,
@@ -354,7 +363,7 @@ def make_distributed_sum_rate(mesh: Mesh, num_groups: int):
 
         in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
                                     window, raw)
-        return jax.shard_map(
+        return _shard_map(
             kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, None),
             check_vma=False,
@@ -515,7 +524,7 @@ def make_distributed_sum_rate_ring(mesh: Mesh, num_groups: int):
 
         in_specs, args = _mesh_call(ts, vals, valid, group_ids, steps,
                                     window, raw)
-        return jax.shard_map(
+        return _shard_map(
             kernel, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, None),
             check_vma=False,

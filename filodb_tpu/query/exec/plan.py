@@ -7,10 +7,14 @@ gather, ``PlanDispatcher.scala:20,31`` / ``InProcessPlanDispatcher``,
 ``DistConcatExec``, reduce-aggregate execs, ``BinaryJoinExec``,
 ``SetOperatorExec``, ``StitchRvsExec``, scalar execs.
 
-Distribution note: unlike the reference, cross-node *aggregation* does not use
-host-side partial-aggregate shipping — the distributed path reduces on device
-via mesh collectives (``filodb_tpu/parallel``). The host exec tree performs
-scatter (per-shard leaves) and concat/join/aggregate on gathered matrices.
+Distribution note: cross-node aggregation is two-phase, like the reference's
+``AggregateMapReduce``-on-leaf design — the planner pushes a map stage
+(``AggregatePartialMapper``) into each per-shard/remote child so peers ship
+one partial row per group instead of one per series, and
+``ReduceAggregateExec`` folds those partials incrementally at the root with
+op-correct merge semantics (``quantile``/``count_values`` bypass to the
+full-gather path; see ``doc/dist_agg.md``). The device mesh path
+(``filodb_tpu/parallel``) additionally reduces on device via collectives.
 """
 
 from __future__ import annotations
@@ -306,6 +310,18 @@ class NonLeafExecPlan(ExecPlan):
         """Dispatch children concurrently and tolerate per-child failure
         below the configured threshold (reference: HA scatter-gather
         routes around lost peers instead of failing the query)."""
+        mats: list[StepMatrix] = []
+        self.gather_each(ctx, mats.append)
+        return mats
+
+    def gather_each(self, ctx, fold) -> None:
+        """Streaming gather: dispatch children concurrently and feed each
+        successful child's matrix to ``fold`` as it becomes available
+        instead of holding all gathered matrices. Children settle in child
+        order (deterministic downstream row order — topk tie-breaks and
+        concat layout must not depend on completion timing), so an
+        out-of-order remote completion buffers in its future until its
+        predecessors settle; the common case folds one child at a time."""
         from filodb_tpu.utils.resilience import (
             DeadlineExceeded,
             FaultInjector,
@@ -315,19 +331,55 @@ class NonLeafExecPlan(ExecPlan):
         if ctx.deadline is not None:
             ctx.deadline.check(type(self).__name__ + ".gather")
 
+        rc = config()
+        pp = ctx.qcontext.planner_params
+        allow_partial = pp.allow_partial if pp.allow_partial is not None \
+            else rc.allow_partial
+        max_frac = pp.max_partial_fraction \
+            if pp.max_partial_fraction is not None \
+            else rc.partial_max_fraction
+        failures: list[tuple[int, list[int], Exception]] = []
+
         def run(i, c):
             FaultInjector.fire("gather.child", index=i,
                                shards=plan_shards(c), plan=c)
             return c.dispatcher.dispatch(c, ctx)
+
+        def settle(i, ok, payload):
+            if ok:
+                result = payload
+                # a remote subtree may itself be partial: merge upward.
+                # An in-process child shares THIS ctx, so its warnings are
+                # already here — only genuinely new ones are added.
+                if getattr(result, "partial", False):
+                    ctx.partial = True
+                    ctx.warnings.extend(w for w in result.warnings
+                                        if w not in ctx.warnings)
+                fold(result.result)
+                return
+            err = payload
+            if isinstance(err, DeadlineExceeded) or not allow_partial \
+                    or not isinstance(err, self.TOLERABLE):
+                raise err
+            failures.append((i, plan_shards(children[i]), err))
+
+        pending: dict[int, tuple[bool, object]] = {}
+        next_i = 0
+
+        def offer(i, ok, payload):
+            nonlocal next_i
+            pending[i] = (ok, payload)
+            while next_i in pending:
+                settle(next_i, *pending.pop(next_i))
+                next_i += 1
 
         # concurrency pays only when children leave the process; local
         # children keep the serial path (no thread hop on the hot path)
         n_remote = sum(1 for c in children
                        if not isinstance(c.dispatcher,
                                          InProcessPlanDispatcher))
-        outcomes: list = [None] * len(children)
         if n_remote and len(children) > 1:
-            from concurrent.futures import ThreadPoolExecutor
+            from concurrent.futures import ThreadPoolExecutor, as_completed
             # per-gather pool: a shared bounded pool deadlocks on nested
             # gathers (parents hold workers while waiting on children).
             # Remote transport connections are pooled process-wide (keyed
@@ -339,55 +391,33 @@ class NonLeafExecPlan(ExecPlan):
                 # execute against THIS ctx, whose stats/warnings mutations
                 # are not thread-safe — they run on the calling thread
                 # (below) while the remote dispatches are in flight
-                futs = {i: ex.submit(run, i, c)
+                futs = {ex.submit(run, i, c): i
                         for i, c in enumerate(children)
                         if not isinstance(c.dispatcher,
                                           InProcessPlanDispatcher)}
+                remote_idx = set(futs.values())
                 for i, c in enumerate(children):
-                    if i in futs:
+                    if i in remote_idx:
                         continue
                     try:
-                        outcomes[i] = (True, run(i, c))
-                    except Exception as e:  # noqa: BLE001 — sorted below
-                        outcomes[i] = (False, e)
-                for i, f in futs.items():
+                        outcome = (True, run(i, c))
+                    except Exception as e:  # noqa: BLE001 — sorted in settle
+                        outcome = (False, e)
+                    offer(i, *outcome)
+                for f in as_completed(futs):
+                    i = futs[f]
                     try:
-                        outcomes[i] = (True, f.result())
-                    except Exception as e:  # noqa: BLE001 — sorted below
-                        outcomes[i] = (False, e)
+                        outcome = (True, f.result())
+                    except Exception as e:  # noqa: BLE001 — sorted in settle
+                        outcome = (False, e)
+                    offer(i, *outcome)
         else:
             for i, c in enumerate(children):
                 try:
-                    outcomes[i] = (True, run(i, c))
-                except Exception as e:  # noqa: BLE001 — sorted below
-                    outcomes[i] = (False, e)
-
-        rc = config()
-        pp = ctx.qcontext.planner_params
-        allow_partial = pp.allow_partial if pp.allow_partial is not None \
-            else rc.allow_partial
-        max_frac = pp.max_partial_fraction \
-            if pp.max_partial_fraction is not None \
-            else rc.partial_max_fraction
-
-        mats, failures = [], []
-        for i, (ok, payload) in enumerate(outcomes):
-            if ok:
-                result = payload
-                # a remote subtree may itself be partial: merge upward.
-                # An in-process child shares THIS ctx, so its warnings are
-                # already here — only genuinely new ones are added.
-                if getattr(result, "partial", False):
-                    ctx.partial = True
-                    ctx.warnings.extend(w for w in result.warnings
-                                        if w not in ctx.warnings)
-                mats.append(result.result)
-                continue
-            err = payload
-            if isinstance(err, DeadlineExceeded) or not allow_partial \
-                    or not isinstance(err, self.TOLERABLE):
-                raise err
-            failures.append((i, plan_shards(children[i]), err))
+                    outcome = (True, run(i, c))
+                except Exception as e:  # noqa: BLE001 — sorted in settle
+                    outcome = (False, e)
+                offer(i, *outcome)
 
         if failures:
             if len(failures) / len(children) > max_frac:
@@ -403,7 +433,6 @@ class NonLeafExecPlan(ExecPlan):
                     f"partial result: child {i} "
                     f"(shards {shards or 'n/a'}) lost: "
                     f"{type(err).__name__}: {err}")
-        return mats
 
 
 @dataclass
@@ -419,23 +448,42 @@ class DistConcatExec(NonLeafExecPlan):
 
 @dataclass
 class ReduceAggregateExec(NonLeafExecPlan):
-    """Gather child matrices then aggregate (see module docstring on why this
-    is single-phase on host; the mesh path reduces on device)."""
+    """Root reduce stage of the aggregation (see module docstring).
+
+    Single-phase form (``pushdown=False``): gather raw per-series child
+    matrices and run the whole ``AggregateMapReduce`` at the root.
+    Two-phase form (``pushdown=True``): children carry an
+    ``AggregatePartialMapper`` in their transformer chains and ship one
+    (partial) row per group; this node folds those partials incrementally
+    as children arrive and finalizes multi-component ops (avg, stddev,
+    stdvar) once — peak root memory scales with group count, not series
+    cardinality."""
 
     op: str = "sum"
     params: tuple = ()
     by: tuple[str, ...] = ()
     without: tuple[str, ...] = ()
+    pushdown: bool = False
 
     def do_execute(self, ctx) -> StepMatrix:
-        from filodb_tpu.query.exec.transformers import AggregateMapReduce
+        from filodb_tpu.query.exec.transformers import (
+            AggregateMapReduce,
+            PartialAggregateFolder,
+        )
+        if self.pushdown:
+            folder = PartialAggregateFolder(self.op, self.params, self.by,
+                                            self.without)
+            self.gather_each(ctx, folder.fold)
+            return folder.finalize()
         data = StepMatrix.concat(self.gather(ctx))
         return AggregateMapReduce(self.op, self.params, self.by,
                                   self.without).apply(data)
 
     def __repr__(self):
+        pd = ", pushdown" if self.pushdown else ""
         return (f"ReduceAggregateExec(op={self.op}, by={self.by}, "
-                f"without={self.without}, {len(self.children_plans)} children)")
+                f"without={self.without}{pd}, "
+                f"{len(self.children_plans)} children)")
 
 
 @dataclass

@@ -272,27 +272,26 @@ class AggregateMapReduce(RangeVectorTransformer):
 
         if self.op == "count_values":
             label = str(self.params[0])
-            # host-side: distinct values become output series
-            out_map: dict[tuple[RangeVectorKey, str], np.ndarray] = {}
-            vals = data.values
+            # host-side: distinct values become output series. One
+            # vectorized np.unique over (group, value, step) triples —
+            # the former Python triple loop was O(groups × steps × uniques)
+            vals = np.asarray(data.values)
             K = data.num_steps
-            for gi, gk in enumerate(out_keys):
-                members = np.where(gids == gi)[0]
-                sub = vals[members]  # [m, K]
-                for k_step in range(K):
-                    col = sub[:, k_step]
-                    col = col[~np.isnan(col)]
-                    for val, cnt in zip(*np.unique(col, return_counts=True)):
-                        vstr = _fmt_value(val)
-                        key = (gk, vstr)
-                        if key not in out_map:
-                            out_map[key] = np.full(K, np.nan)
-                        out_map[key][k_step] = cnt
+            mask = ~np.isnan(vals)
+            g = np.broadcast_to(gids[:, None], vals.shape)[mask]
+            s = np.broadcast_to(np.arange(K)[None, :], vals.shape)[mask]
+            v = vals[mask]
+            triples = np.stack([g.astype(np.float64), v,
+                                s.astype(np.float64)], axis=1)
+            uniq, counts = np.unique(triples, axis=0, return_counts=True)
+            # distinct (group, value) pairs become the output rows
+            pairs, row_of = np.unique(uniq[:, :2], axis=0,
+                                      return_inverse=True)
+            values = np.full((len(pairs), K), np.nan)
+            values[row_of, uniq[:, 2].astype(np.int64)] = counts
             keys = [RangeVectorKey(tuple(sorted(
-                list(gk.labels) + [(label, vstr)])))
-                for (gk, vstr) in out_map]
-            values = (np.stack(list(out_map.values()))
-                      if out_map else np.zeros((0, K)))
+                list(out_keys[int(gi)].labels) + [(label, _fmt_value(val))])))
+                for gi, val in pairs]
             return StepMatrix(keys, values, data.steps_ms)
 
         raise ValueError(f"unknown aggregation {self.op}")
@@ -302,6 +301,183 @@ def _fmt_value(v: float) -> str:
     if v == int(v):
         return str(int(v))
     return repr(v)
+
+
+# ---------------------------------------------------------------------------
+# two-phase aggregation pushdown (map stage on children, reduce at the root)
+
+# reserved label carrying a partial component name ("sum" / "sumsq" /
+# "count") from the map stage to the root reduce; never a real series label
+AGG_PART_LABEL = "__agg_part__"
+
+# ops whose partials re-reduce with op-correct semantics at the root.
+# quantile and count_values need every raw series at once — they stay on
+# the declared bypass list (full-gather path).
+AGG_PUSHDOWN_OPS = frozenset((
+    "sum", "min", "max", "count", "avg", "group", "stddev", "stdvar",
+    "topk", "bottomk"))
+AGG_PUSHDOWN_BYPASS = frozenset(("quantile", "count_values"))
+
+
+def _grouped(op: str, v, g, num_groups: int, is_hist: bool):
+    """agg_kernel, vmapped over the bucket axis for histogram matrices."""
+    if is_hist:
+        import jax
+        return jax.vmap(lambda vb: agg_kernel(op, vb, g, num_groups),
+                        in_axes=2, out_axes=2)(v)
+    return agg_kernel(op, v, g, num_groups)
+
+
+def _part_key(gk: RangeVectorKey, comp: str) -> RangeVectorKey:
+    return RangeVectorKey(tuple(sorted(gk.labels
+                                       + ((AGG_PART_LABEL, comp),))))
+
+
+@dataclass
+class AggregatePartialMapper(RangeVectorTransformer):
+    """Map stage of two-phase aggregation pushdown (the reference runs
+    ``AggregateMapReduce`` on each leaf node): emits per-group PARTIAL rows
+    so remote children ship one row per group instead of one per series.
+
+    sum/min/max/count/group emit the local aggregate directly (count
+    re-reduces via sum at the root); avg ships (sum, count) and
+    stddev/stdvar ship (sum, sum-of-squares, count) as component rows
+    tagged with ``AGG_PART_LABEL``; topk/bottomk emit the shard's k
+    candidate series per group — exact after the root re-rank, because each
+    step's global top-k is a subset of the union of per-shard top-k's."""
+
+    op: str
+    params: tuple = ()
+    by: tuple[str, ...] = ()
+    without: tuple[str, ...] = ()
+
+    def apply(self, data: StepMatrix) -> StepMatrix:
+        if data.num_series == 0:
+            return data
+        amr = AggregateMapReduce(self.op, self.params, self.by, self.without)
+        if self.op in ("sum", "min", "max", "count", "group", "topk",
+                       "bottomk"):
+            return amr.apply(data)
+        if self.op == "avg":
+            comps = ("sum", "count")
+        elif self.op in ("stddev", "stdvar"):
+            comps = ("sum", "sumsq", "count")
+        else:
+            raise ValueError(f"aggregation {self.op!r} is not "
+                             f"pushdown-capable")
+        gids, out_keys = amr._group_ids(data.keys)
+        G = len(out_keys)
+        v = jnp.asarray(data.values)
+        g = jnp.asarray(gids)
+        hist = data.is_histogram
+        keys: list[RangeVectorKey] = []
+        parts = []
+        for comp in comps:
+            if comp == "sumsq":
+                part = _grouped("sum", v * v, g, G, hist)
+            else:
+                part = _grouped(comp, v, g, G, hist)
+            parts.append(part)
+            keys.extend(_part_key(gk, comp) for gk in out_keys)
+        return StepMatrix(keys, jnp.concatenate(parts, axis=0),
+                          data.steps_ms, data.les)
+
+
+def _reduce_by_key(m: StepMatrix, op: str) -> StepMatrix:
+    """Merge rows with identical keys using ``op`` (root combine of
+    pushdown partials: group labels are already reduced on partial rows,
+    so grouping is plain full-key identity)."""
+    uniq: dict[RangeVectorKey, int] = {}
+    gids = np.empty(m.num_series, np.int32)
+    for i, k in enumerate(m.keys):
+        gids[i] = uniq.setdefault(k, len(uniq))
+    G = len(uniq)
+    if G == m.num_series:
+        return m  # all keys distinct: nothing to merge
+    out = _grouped(op, jnp.asarray(m.values), jnp.asarray(gids), G,
+                   m.is_histogram)
+    return StepMatrix(list(uniq), np.asarray(out), m.steps_ms, m.les)
+
+
+def _split_components(m: StepMatrix, comps: tuple[str, ...]):
+    """Partial rows → (base keys, one aligned [G, K] array per component)."""
+    rows: dict[str, dict[RangeVectorKey, np.ndarray]] = {c: {} for c in comps}
+    for i, k in enumerate(m.keys):
+        lm = dict(k.labels)
+        comp = lm.pop(AGG_PART_LABEL, None)
+        if comp not in rows:
+            raise ValueError(f"partial aggregate row lacks a valid "
+                             f"{AGG_PART_LABEL} component: {k}")
+        rows[comp][RangeVectorKey(tuple(sorted(lm.items())))] = m.values[i]
+    keys = list(rows[comps[0]])
+    arrs = []
+    for c in comps:
+        if set(rows[c]) != set(keys):
+            raise ValueError("misaligned partial aggregate components")
+        arrs.append(np.stack([rows[c][k] for k in keys]) if keys
+                    else m.values[:0])
+    return keys, arrs
+
+
+class PartialAggregateFolder:
+    """Root reduce stage of two-phase pushdown: folds per-child partial
+    matrices AS THEY ARRIVE — the accumulator stays at O(groups) rows, so
+    peak root memory no longer scales with fan-out × cardinality — then
+    finalizes multi-component ops (avg, stddev/stdvar)."""
+
+    # how partial rows combine across children, per original op
+    _COMBINE = {"sum": "sum", "count": "sum", "min": "min", "max": "max",
+                "group": "group", "avg": "sum", "stddev": "sum",
+                "stdvar": "sum"}
+
+    def __init__(self, op: str, params=(), by=(), without=()):
+        self.op = op
+        self.params = params
+        self.by = by
+        self.without = without
+        self._acc: StepMatrix | None = None
+
+    def fold(self, m: StepMatrix) -> None:
+        if m is None or m.num_series == 0:
+            return
+        m.materialize()  # partial rows are tiny; fold on host
+        if self._acc is None or self._acc.num_series == 0:
+            self._acc = m
+            return
+        both = StepMatrix.concat([self._acc, m])
+        if self.op in ("topk", "bottomk"):
+            # re-rank the accumulated candidate union after every fold so
+            # the accumulator stays at ≤ groups × k live rows
+            self._acc = AggregateMapReduce(
+                self.op, self.params, self.by, self.without).apply(both)
+        else:
+            self._acc = _reduce_by_key(both, self._COMBINE[self.op])
+
+    def finalize(self) -> StepMatrix:
+        acc = self._acc
+        if acc is None:
+            return StepMatrix.empty()
+        acc.materialize()
+        if self.op == "avg":
+            keys, (s, cnt) = _split_components(acc, ("sum", "count"))
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out = np.where(np.nan_to_num(cnt) > 0, s / cnt, np.nan)
+            return StepMatrix(keys, out, acc.steps_ms, acc.les)
+        if self.op in ("stddev", "stdvar"):
+            keys, (s, s2, cnt) = _split_components(
+                acc, ("sum", "sumsq", "count"))
+            # the sum-of-squares difference cancels catastrophically in
+            # low precision; do the root math in float64 (the kernel-dtype
+            # partials still bound equivalence to ~kernel tolerance)
+            s, s2, cnt = (x.astype(np.float64) for x in (s, s2, cnt))
+            with np.errstate(invalid="ignore", divide="ignore"):
+                mean = s / cnt
+                var = np.maximum(s2 / cnt - mean * mean, 0.0)
+                out = np.where(np.nan_to_num(cnt) > 0,
+                               var if self.op == "stdvar" else np.sqrt(var),
+                               np.nan)
+            return StepMatrix(keys, out, acc.steps_ms, acc.les)
+        return acc
 
 
 @dataclass

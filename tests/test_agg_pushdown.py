@@ -1,0 +1,409 @@
+"""Two-phase aggregation pushdown: the pushed-down (map on children,
+reduce at root) plan must be indistinguishable from the single-phase
+full-gather plan for every pushdown-capable op, locally and over TCP
+plan shipping, including partial results with a lost child and result
+cache hits across the two plan forms.
+
+Equivalence is semantic, not bit-level: partials reduce per shard before
+the root combine, so float32 kernel sums associate differently — asserted
+at kernel-dtype tolerance (stddev/stdvar looser: the sum-of-squares
+difference cancels catastrophically in low precision).
+
+Also covers the wire-frame compression that rides along: flag-bit framing,
+negotiation with pre-compression peers, and the bounded-inflate guard.
+"""
+
+import socket
+import struct
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator import planner as planner_mod
+from filodb_tpu.coordinator import remote as remote_mod
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.remote import (
+    PlanExecutorServer,
+    RemotePlanDispatcher,
+    _recv_frame,
+    _recv_msg,
+    _send_msg,
+    reset_pool,
+)
+from filodb_tpu.coordinator.wire import decode, encode
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.query.exec import transformers as tf
+from filodb_tpu.query.exec.plan import (
+    DistConcatExec,
+    ReduceAggregateExec,
+    SelectRawPartitionsExec,
+)
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    histogram_series,
+    histogram_stream,
+    machine_metrics_series,
+)
+from filodb_tpu.utils.resilience import reset_breakers
+
+NUM_SHARDS = 4
+START = 1_600_000_000
+QS = START + 100
+QE = START + 2000
+STEP = 60
+
+
+def build_store():
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    streams = [
+        gauge_stream(machine_metrics_series(10, ns="App-2"), 240,
+                     start_ms=START * 1000, interval_ms=10_000, seed=11),
+        counter_stream(counter_series(6, ns="App-1"), 240,
+                       start_ms=START * 1000, interval_ms=10_000, seed=3,
+                       reset_every=100),
+        histogram_stream(histogram_series(4), 240,
+                         start_ms=START * 1000, interval_ms=10_000, seed=7),
+    ]
+    for stream in streams:
+        ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+@pytest.fixture(scope="module")
+def store():
+    return build_store()
+
+
+@pytest.fixture(scope="module")
+def svc(store):
+    return QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+
+
+def assert_equivalent(a, b, rtol=2e-5):
+    m0, m1 = a.result, b.result
+    i0 = {k: i for i, k in enumerate(m0.keys)}
+    i1 = {k: i for i, k in enumerate(m1.keys)}
+    assert set(i0) == set(i1), set(i0) ^ set(i1)
+    if m0.num_series:
+        assert np.array_equal(m0.steps_ms, m1.steps_ms)
+    for k, i in i0.items():
+        x = np.asarray(m0.values[i])
+        y = np.asarray(m1.values[i1[k]])
+        assert np.array_equal(np.isnan(x), np.isnan(y)), k
+        assert np.allclose(x, y, rtol=rtol, atol=1e-9, equal_nan=True), k
+
+
+# every pushdown-capable op (with by / without / ungrouped forms), the
+# bypass ops, and shapes layered above the aggregate
+OP_QUERIES = [
+    ("sum(heap_usage)", 2e-5),
+    ("sum(heap_usage) by (host)", 2e-5),
+    ("sum(rate(http_requests_total[5m])) by (job)", 2e-5),
+    ("sum(heap_usage) without (host)", 2e-5),
+    ("avg(heap_usage) by (host)", 2e-5),
+    ("avg(heap_usage)", 2e-5),
+    ("count(heap_usage) without (host)", 2e-5),
+    ("count(heap_usage)", 2e-5),
+    ("min(heap_usage) by (host)", 2e-5),
+    ("max(heap_usage)", 2e-5),
+    ("group(heap_usage) by (host)", 2e-5),
+    ("stddev(heap_usage) by (host)", 2e-3),
+    ("stdvar(heap_usage)", 2e-3),
+    ("topk(3, heap_usage)", 2e-5),
+    ("topk(2, heap_usage) by (host)", 2e-5),
+    ("bottomk(2, heap_usage) by (host)", 2e-5),
+    # declared bypass list: identical because neither form pushes down
+    ("quantile(0.9, heap_usage) by (host)", 2e-5),
+    ('count_values("v", heap_usage)', 2e-5),
+    # histogram-valued matrices aggregate per bucket
+    ("sum(rate(http_req_latency[5m])) by (host)", 2e-5),
+    ("histogram_quantile(0.9, sum(rate(http_req_latency[5m])))", 2e-5),
+    # transforms above the aggregate see identical inputs
+    ("abs(sum(heap_usage) by (host)) * 2", 2e-5),
+]
+
+
+class TestLocalEquivalence:
+    @pytest.mark.parametrize("promql,rtol", OP_QUERIES)
+    def test_pushed_matches_unpushed(self, svc, promql, rtol):
+        svc.planner.agg_pushdown = "off"
+        unpushed = svc.query_range(promql, QS, STEP, QE)
+        svc.planner.agg_pushdown = "always"
+        try:
+            pushed = svc.query_range(promql, QS, STEP, QE)
+        finally:
+            svc.planner.agg_pushdown = "auto"
+        assert_equivalent(unpushed, pushed, rtol)
+
+
+class TestPlanShapes:
+    def _materialize(self, mode, dispatcher_for_shard=None,
+                     promql="sum(heap_usage) by (host)"):
+        pl = SingleClusterPlanner("timeseries", NUM_SHARDS, spread=1,
+                                  dispatcher_for_shard=dispatcher_for_shard)
+        pl.agg_pushdown = mode
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+        plan = parse_query(promql, TimeStepParams(QS, STEP, QE))
+        return pl.materialize(plan)
+
+    def test_always_pushes_map_stage_into_leaves(self):
+        ep = self._materialize("always")
+        assert isinstance(ep, ReduceAggregateExec) and ep.pushdown
+        assert len(ep.children_plans) == NUM_SHARDS
+        for leaf in ep.children_plans:
+            assert isinstance(leaf, SelectRawPartitionsExec)
+            assert isinstance(leaf.transformers[-1],
+                              tf.AggregatePartialMapper)
+
+    def test_auto_all_local_bypasses(self):
+        # local shards keep the single big device reduce: the win is wire
+        # bytes, and there is no wire
+        ep = self._materialize("auto")
+        assert isinstance(ep, ReduceAggregateExec) and not ep.pushdown
+        assert isinstance(ep.children_plans[0], DistConcatExec)
+
+    def test_auto_remote_pushes(self):
+        disp = RemotePlanDispatcher("127.0.0.1", 65000)
+        ep = self._materialize("auto", dispatcher_for_shard=lambda s: disp)
+        assert ep.pushdown
+
+    def test_off_never_pushes(self):
+        disp = RemotePlanDispatcher("127.0.0.1", 65000)
+        ep = self._materialize("off", dispatcher_for_shard=lambda s: disp)
+        assert not ep.pushdown
+
+    @pytest.mark.parametrize("promql", [
+        "quantile(0.9, heap_usage) by (host)",
+        'count_values("v", heap_usage)',
+    ])
+    def test_bypass_ops_never_push(self, promql):
+        ep = self._materialize("always", promql=promql)
+        assert isinstance(ep, ReduceAggregateExec) and not ep.pushdown
+
+    def test_decision_counters_move(self):
+        a0 = planner_mod.PUSHDOWN_APPLIED.value
+        b0 = planner_mod.PUSHDOWN_BYPASSED.value
+        self._materialize("always")
+        self._materialize("off")
+        assert planner_mod.PUSHDOWN_APPLIED.value == a0 + 1
+        assert planner_mod.PUSHDOWN_BYPASSED.value == b0 + 1
+
+    def test_pushdown_plan_round_trips_on_wire(self):
+        ep = self._materialize("always")
+        rt = decode(encode(ep))
+        assert isinstance(rt, ReduceAggregateExec) and rt.pushdown
+        mapper = rt.children_plans[0].transformers[-1]
+        assert isinstance(mapper, tf.AggregatePartialMapper)
+        assert (mapper.op, mapper.by) == ("sum", ("host",))
+
+
+class TestRemoteDispatch:
+    @pytest.fixture()
+    def remote_env(self, store):
+        reset_breakers()
+        reset_pool()
+        srv = PlanExecutorServer(store).start()
+        disp = RemotePlanDispatcher("127.0.0.1", srv.port)
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        svc.planner.dispatcher_for_shard = lambda s: disp
+        yield svc
+        srv.stop()
+        reset_pool()
+
+    @pytest.mark.parametrize("promql,rtol", [
+        ("sum(heap_usage) by (host)", 2e-5),
+        ("avg(rate(http_requests_total[5m])) by (job)", 2e-5),
+        ("stddev(heap_usage)", 2e-3),
+        ("topk(2, heap_usage) by (host)", 2e-5),
+    ])
+    def test_remote_pushdown_equivalence(self, remote_env, promql, rtol):
+        svc = remote_env
+        svc.planner.agg_pushdown = "off"
+        unpushed = svc.query_range(promql, QS, STEP, QE)
+        svc.planner.agg_pushdown = "auto"  # remote children: auto pushes
+        pushed = svc.query_range(promql, QS, STEP, QE)
+        assert_equivalent(unpushed, pushed, rtol)
+
+    def test_pushdown_ships_fewer_bytes(self, remote_env):
+        svc = remote_env
+        promql = "sum(heap_usage) by (host)"
+
+        def received(mode):
+            svc.planner.agg_pushdown = mode
+            before = remote_mod.BYTES_RECEIVED.value
+            svc.query_range(promql, QS, STEP, QE)
+            return remote_mod.BYTES_RECEIVED.value - before
+
+        off, on = received("off"), received("auto")
+        assert 0 < on < off
+
+    def test_lost_child_partial_equivalence(self, store):
+        # shard 3's peer is dead: both plan forms degrade to the same
+        # partial result (3 of 4 children) instead of failing
+        reset_breakers()
+        reset_pool()
+        srv = PlanExecutorServer(store).start()
+        live = RemotePlanDispatcher("127.0.0.1", srv.port)
+        with socket.socket() as s:  # a port with nothing listening
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]
+        dead = RemotePlanDispatcher("127.0.0.1", dead_port, timeout=2.0)
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        svc.planner.dispatcher_for_shard = \
+            lambda sh: dead if sh == 3 else live
+        try:
+            svc.planner.agg_pushdown = "off"
+            unpushed = svc.query_range("sum(heap_usage) by (host)",
+                                       QS, STEP, QE)
+            reset_breakers()
+            svc.planner.agg_pushdown = "auto"
+            pushed = svc.query_range("sum(heap_usage) by (host)",
+                                     QS, STEP, QE)
+        finally:
+            srv.stop()
+            reset_pool()
+            reset_breakers()
+        assert unpushed.partial and pushed.partial
+        assert any("shards [3]" in w for w in pushed.warnings)
+        assert_equivalent(unpushed, pushed)
+
+
+class TestResultCacheAcrossPlanForms:
+    def test_pushed_and_unpushed_hit_the_same_entries(self, store):
+        # the cache keys on the LOGICAL plan: whether the exec tree pushed
+        # the map stage down must not change the cache identity
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1,
+                           result_cache={"extent_steps": 7})
+        from filodb_tpu.query import result_cache as rc
+        promql = "sum(rate(http_requests_total[5m])) by (job)"
+        svc.planner.agg_pushdown = "off"
+        unpushed = svc.query_range(promql, QS, STEP, QE)
+        hits_before = rc.cache_hits.value
+        svc.planner.agg_pushdown = "always"
+        pushed = svc.query_range(promql, QS, STEP, QE)
+        assert rc.cache_hits.value > hits_before
+        assert_equivalent(unpushed, pushed)
+
+
+# ---------------------------------------------------------------------------
+# wire-frame compression
+
+
+def _sockpair():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+class TestWireCompression:
+    def test_large_frame_round_trips_compressed(self):
+        a, b = _sockpair()
+        try:
+            msg = ("ok", ["x" * 200] * 500)  # compressible, > threshold
+            n = _send_msg(a, msg, compress=True)
+            raw_len = len(encode(msg))
+            assert n < 4 + raw_len  # actually shrank on the wire
+            got, nrecv = _recv_frame(b)
+            assert got == msg and nrecv == n
+        finally:
+            a.close()
+            b.close()
+
+    def test_small_frame_stays_raw(self):
+        a, b = _sockpair()
+        try:
+            n = _send_msg(a, ("ping",), compress=True)
+            hdr = b.recv(4, socket.MSG_PEEK)
+            (word,) = struct.unpack("<I", hdr)
+            assert not word & remote_mod._FLAG_COMPRESSED
+            assert _recv_msg(b) == ("ping",)
+            assert n == 4 + (word & ~remote_mod._FLAG_COMPRESSED)
+        finally:
+            a.close()
+            b.close()
+
+    def test_uncompressed_peer_frames_still_decode(self):
+        a, b = _sockpair()
+        try:
+            _send_msg(a, ("ok", True))  # compress=False: legacy framing
+            assert _recv_msg(b) == ("ok", True)
+        finally:
+            a.close()
+            b.close()
+
+    def test_bounded_inflate_rejects_bombs(self):
+        # a tiny compressed frame expanding past the cap must be refused
+        # before it allocates, like an oversized raw frame
+        a, b = _sockpair()
+        try:
+            packed = zlib.compress(b"\x00" * 4_000_000, 9)
+            a.sendall(struct.pack(
+                "<I", len(packed) | remote_mod._FLAG_COMPRESSED) + packed)
+            with pytest.raises(ConnectionError):
+                _recv_frame(b, cap=65536)
+        finally:
+            a.close()
+            b.close()
+
+    def test_negotiation_with_pre_compression_peer(self, store):
+        # emulate an old server: same framing, no hello support — the
+        # dialer records the refusal and the connection stays usable
+        def old_server(srv_sock, stop):
+            while not stop.is_set():
+                try:
+                    conn, _ = srv_sock.accept()
+                except OSError:
+                    return
+                with conn:
+                    try:
+                        while True:
+                            msg = _recv_msg(conn)
+                            if msg[0] == "ping":
+                                _send_msg(conn, ("pong",))
+                            else:
+                                _send_msg(conn, (
+                                    "err", f"unknown message {msg[0]!r}"))
+                    except (ConnectionError, OSError):
+                        pass
+
+        reset_pool()
+        srv_sock = socket.socket()
+        srv_sock.bind(("127.0.0.1", 0))
+        srv_sock.listen(1)
+        port = srv_sock.getsockname()[1]
+        stop = threading.Event()
+        t = threading.Thread(target=old_server, args=(srv_sock, stop),
+                             daemon=True)
+        t.start()
+        try:
+            disp = RemotePlanDispatcher("127.0.0.1", port, timeout=5.0)
+            assert disp.ping()  # hello rejected, connection survives
+            assert remote_mod._peer_caps[("127.0.0.1", port)] is False
+        finally:
+            stop.set()
+            srv_sock.close()
+            reset_pool()
+            remote_mod._peer_caps.pop(("127.0.0.1", port), None)
+
+    def test_new_peers_negotiate_compression(self, store):
+        reset_pool()
+        srv = PlanExecutorServer(store).start()
+        try:
+            disp = RemotePlanDispatcher("127.0.0.1", srv.port)
+            assert disp.ping()
+            assert remote_mod._peer_caps[("127.0.0.1", srv.port)] is True
+        finally:
+            srv.stop()
+            reset_pool()
+            remote_mod._peer_caps.pop(("127.0.0.1", srv.port), None)

@@ -85,6 +85,19 @@ RESULT_CACHE_NAMES = [
     "filodb_result_cache_bytes",
 ]
 
+# distributed-aggregation pushdown + wire transport (coordinator/planner.py,
+# coordinator/remote.py) — registered at import, standalone imports both
+DIST_AGG_NAMES = [
+    "filodb_agg_pushdown_applied_total",
+    "filodb_agg_pushdown_bypassed_total",
+    "filodb_remote_bytes_sent_total",
+    "filodb_remote_bytes_received_total",
+    "filodb_wire_frames_compressed_total",
+    "filodb_wire_frames_raw_total",
+    "filodb_wire_compress_bytes_in_total",
+    "filodb_wire_compress_bytes_out_total",
+]
+
 
 def _free_port():
     with socket.socket() as s:
@@ -161,6 +174,13 @@ class TestMetricsScrape:
         missing_rc = [n for n in RESULT_CACHE_NAMES
                       if n not in names_present]
         assert not missing_rc, f"missing result-cache metrics: {missing_rc}"
+
+        # distributed-aggregation pushdown + wire counters are exposed
+        # (decision-counter movement is covered in test_agg_pushdown.py —
+        # the mesh engine can satisfy this query without planner
+        # materialization, so movement here would be engine-dependent)
+        missing_da = [n for n in DIST_AGG_NAMES if n not in names_present]
+        assert not missing_da, f"missing dist-agg metrics: {missing_da}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])
