@@ -1,0 +1,125 @@
+"""Overload-protection benchmark: ramped concurrent clients against a node
+with a fixed admission capacity; measures admitted-query p99 and shed rate
+per concurrency level.
+
+The property being demonstrated (the governor's reason to exist): past the
+capacity knee, *admitted* latency stays bounded while the excess demand is
+shed with 503s — instead of every client's latency growing without bound. A
+small per-child scan delay is injected so the node has a realistic service
+time and the gate actually engages.
+
+    python benchmarks/overload.py            # standalone, one JSON line
+    python benchmarks/run_benchmarks.py --only overload
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+N_SERIES = 50
+N_SAMPLES = 40
+INTERVAL_MS = 15_000
+
+CAPACITY = 4
+LEVELS = [1, 2, 4, 8, 16, 32]     # concurrent clients (8x capacity at top)
+LEVEL_SECONDS = 1.0
+CHILD_DELAY_S = 0.01              # injected per scatter-gather child
+
+QUERY = "heap_usage"
+QS = START + 150
+QE = START + N_SAMPLES * (INTERVAL_MS // 1000)
+STEP = 60
+
+
+def _build():
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=400,
+                                              groups_per_shard=4))
+    stream = gauge_stream(machine_metrics_series(N_SERIES), N_SAMPLES,
+                          start_ms=START * 1000, interval_ms=INTERVAL_MS,
+                          batch=1000, seed=5)
+    ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+def _p99(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(0.99 * len(xs)))] if xs else float("nan")
+
+
+def _run_level(svc, clients: int):
+    from filodb_tpu.utils.governor import QueryRejected
+
+    stop = time.monotonic() + LEVEL_SECONDS
+    lock = threading.Lock()
+    admitted, shed = [], [0]
+
+    def worker():
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            try:
+                svc.query_range(QUERY, QS, STEP, QE)
+                dt = time.perf_counter() - t0
+                with lock:
+                    admitted.append(dt)
+            except QueryRejected:
+                with lock:
+                    shed[0] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    total = len(admitted) + shed[0]
+    return {"clients": clients,
+            "admitted_qps": round(len(admitted) / LEVEL_SECONDS, 1),
+            "admitted_p99_ms": round(_p99(admitted) * 1e3, 2),
+            "shed_rate": round(shed[0] / total, 3) if total else 0.0}
+
+
+def bench_overload():
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.utils import governor as gov
+    from filodb_tpu.utils.resilience import FaultInjector
+
+    ms = _build()
+    svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+    svc.result_cache = None  # measure the engine, not the extent cache
+    gov.reset()
+    gov.configure(admission_capacity=CAPACITY, max_queue_wait_s=0.2,
+                  retry_after_s=1.0)
+    FaultInjector.arm("gather.child", delay_s=CHILD_DELAY_S, times=None)
+    try:
+        svc.query_range(QUERY, QS, STEP, QE)  # warm compile caches
+        levels = [_run_level(svc, n) for n in LEVELS]
+    finally:
+        FaultInjector.reset()
+        gov.reset()
+    unloaded_p99 = levels[0]["admitted_p99_ms"]
+    loaded = [lv for lv in levels if lv["clients"] >= 4 * CAPACITY]
+    worst_p99 = max(lv["admitted_p99_ms"] for lv in loaded) if loaded \
+        else float("nan")
+    return {"metric": "overload", "capacity": CAPACITY,
+            "levels": levels,
+            "admitted_p99_blowup_x": round(worst_p99 / unloaded_p99, 2),
+            "unit": "ms / shed fraction"}
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_overload()))

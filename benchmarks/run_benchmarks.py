@@ -456,6 +456,12 @@ def _bench_dist_agg():
     return bench_dist_agg()
 
 
+def _bench_overload():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from overload import bench_overload
+    return bench_overload()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -470,6 +476,7 @@ ALL = {
     "dict_string": bench_dict_string,
     "mesh_churn": bench_mesh_churn,
     "dist_agg": _bench_dist_agg,
+    "overload": _bench_overload,
 }
 
 

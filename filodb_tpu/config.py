@@ -50,6 +50,24 @@ DEFAULTS = {
         "max_bytes": 256 * 1024 * 1024,
         "ooo_allowance_ms": 300_000,  # out-of-order arrival allowance
     },
+    # overload protection (filodb_tpu.utils.governor.GovernorConfig): query
+    # admission control, scan-time cost budgets (0 = unlimited), and the
+    # memory-pressure watchdog thresholds. Keys here override that
+    # dataclass's defaults at boot.
+    "governor": {
+        "admission_capacity": 32,     # concurrent queries when healthy
+        "admission_queue_limit": 128,
+        "max_queue_wait_s": 5.0,
+        "retry_after_s": 1.0,
+        "degraded_capacity_factor": 0.5,
+        "degraded_threshold": 0.75,
+        "critical_threshold": 0.92,
+        "watchdog_interval_s": 0.5,
+        "max_samples_scanned": 0,     # per-query budget; 0 = unlimited
+        "max_result_bytes": 0,
+        "max_group_cardinality": 0,
+        "budget_degrade": "partial",  # "partial" | "error"
+    },
     "datasets": {
         "timeseries": {
             "num_shards": 4,
@@ -99,6 +117,7 @@ class ServerConfig:
     engines: dict[str, str] = field(default_factory=dict)  # dataset → engine
     resilience: dict = field(default_factory=dict)  # ResilienceConfig overrides
     result_cache: dict = field(default_factory=dict)  # ResultCacheConfig block
+    governor: dict = field(default_factory=dict)  # GovernorConfig overrides
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -141,7 +160,8 @@ class ServerConfig:
             enable_failover=cfg.get("enable_failover", False),
             datasets=datasets, spreads=spreads, downsample=downsample,
             engines=engines, resilience=cfg.get("resilience", {}),
-            result_cache=cfg.get("result_cache", {}))
+            result_cache=cfg.get("result_cache", {}),
+            governor=cfg.get("governor", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

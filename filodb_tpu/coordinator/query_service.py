@@ -17,12 +17,43 @@ from filodb_tpu.promql.parser import TimeStepParams, parse_query
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.exec.plan import ExecContext
 from filodb_tpu.query.model import QueryContext, QueryResult
+from filodb_tpu.utils.governor import CHEAP, EXPENSIVE, default_budget, governor
 from filodb_tpu.utils.metrics import Histogram, get_counter
 from filodb_tpu.utils.resilience import Deadline
 from filodb_tpu.utils.resilience import config as resilience_config
 
 query_latency = Histogram("query_latency_seconds")
 partial_results = get_counter("filodb_partial_results")
+
+
+class _BudgetCtx:
+    """Minimal ctx for boundary budget checks on engines without an
+    ExecContext (the mesh path): carries budget + partial/warnings."""
+
+    def __init__(self, budget):
+        self.budget = budget
+        self.partial = False
+        self.warnings: list[str] = []
+
+
+def _admission_cost(plan) -> str:
+    """Admission cost class for a logical plan: instant queries (a single
+    evaluation step) are CHEAP — they stay admissible when the governor is
+    CRITICAL; range scans are EXPENSIVE and shed first."""
+    import dataclasses
+    stack, seen = [plan], 0
+    while stack and seen < 64:
+        p = stack.pop()
+        seen += 1
+        start, end = getattr(p, "start", None), getattr(p, "end", None)
+        if isinstance(start, int) and isinstance(end, int) and end > 0:
+            return CHEAP if start == end else EXPENSIVE
+        if dataclasses.is_dataclass(p):
+            for f in dataclasses.fields(p):
+                v = getattr(p, f.name, None)
+                if dataclasses.is_dataclass(v) and not isinstance(v, type):
+                    stack.append(v)
+    return EXPENSIVE
 
 
 @dataclass
@@ -142,11 +173,14 @@ class QueryService:
         mesh_results = {i: None for i in pending}
         if pending and self.mesh_engine is not None and self._mesh_eligible():
             # one device program per shared plan signature (micro-batched
-            # step grids); unsupported plans fall through to the exec path
+            # step grids); unsupported plans fall through to the exec path.
+            # The whole batch takes ONE admission slot: it runs as one
+            # device program, and per-item gating would stall the batcher.
             try:
-                mr = self.mesh_engine.execute_many(
-                    [plans[i] for i in pending], self.memstore, self.dataset,
-                    [stats_list[i] for i in pending])
+                with governor().admit(cost=EXPENSIVE):
+                    mr = self.mesh_engine.execute_many(
+                        [plans[i] for i in pending], self.memstore,
+                        self.dataset, [stats_list[i] for i in pending])
             except Exception as e:  # noqa: BLE001
                 if not return_errors:
                     raise
@@ -262,40 +296,68 @@ class QueryService:
         if isinstance(plan, (lp.LabelValues, lp.LabelNames,
                              lp.SeriesKeysByFilters)):
             return self._metadata(plan, qcontext)
-        if self.mesh_engine is not None and self._mesh_eligible() \
-                and self.mesh_engine.supports(plan):
-            from filodb_tpu.query.model import QueryStats
-            from filodb_tpu.utils.tracing import span
-            stats = QueryStats()
-            with query_latency.time(), span("mesh-execute"):
-                data = self.mesh_engine.execute(self.memstore, self.dataset,
-                                                plan, stats)
-            if data is not None:  # None = shape the kernels don't cover
-                # materialize first so deferred compaction applies, then the
-                # same resource guard as the exec path (on the real count)
-                data.materialize()
-                from filodb_tpu.query.exec.plan import ExecPlan
-                ExecPlan._enforce_limits(data, qcontext)
-                stats.wall_time_s = time.perf_counter() - t0
-                stats.result_series = data.num_series
-                return QueryResult(data, stats, qcontext.query_id)
-        from filodb_tpu.utils.tracing import span
-        with span("plan-materialize"):
-            exec_plan = self.planner.materialize(plan, qcontext)
+        # attach the node's default scan budget (governor config) unless the
+        # caller brought one; it rides the QueryContext to remote leaves
+        pp = qcontext.planner_params
+        if pp.budget is None:
+            pp.budget = default_budget()
         timeout_s = self.query_timeout_s if self.query_timeout_s is not None \
             else resilience_config().query_timeout_s
-        ctx = ExecContext(self.memstore, self.dataset, qcontext,
-                          deadline=Deadline.after(timeout_s))
-        with query_latency.time(), span("exec-dispatch"):
-            result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
-            if materialize:
-                # device → host once, at the boundary; query_range_many
-                # defers this and batch-fetches across in-flight queries
-                result.result.materialize()
-                # device-resident results skipped in-tree enforcement
-                # (compaction was deferred); enforce on the real count now
-                from filodb_tpu.query.exec.plan import ExecPlan
-                ExecPlan._enforce_limits(result.result, qcontext)
+        deadline = Deadline.after(timeout_s)
+        # admission gate: single choke point for the mesh and exec engines
+        # (and the cache's per-extent sub-queries); over-capacity queries
+        # wait bounded by the deadline, then shed with QueryRejected (503)
+        with governor().admit(deadline=deadline, cost=_admission_cost(plan)):
+            if self.mesh_engine is not None and self._mesh_eligible() \
+                    and self.mesh_engine.supports(plan):
+                from filodb_tpu.query.model import QueryStats
+                from filodb_tpu.utils.tracing import span
+                stats = QueryStats()
+                with query_latency.time(), span("mesh-execute"):
+                    data = self.mesh_engine.execute(self.memstore,
+                                                    self.dataset, plan, stats)
+                if data is not None:  # None = shape the kernels don't cover
+                    # materialize first so deferred compaction applies, then
+                    # the same resource guard as the exec path (real count)
+                    data.materialize()
+                    from filodb_tpu.query.exec.plan import (
+                        ExecPlan,
+                        apply_result_budget,
+                    )
+                    ExecPlan._enforce_limits(data, qcontext)
+                    # result-bytes budget on the materialized matrix (the
+                    # mesh has no incremental scan hooks, so the boundary
+                    # check is where it degrades gracefully)
+                    shim = _BudgetCtx(pp.budget)
+                    data = apply_result_budget(data, shim)
+                    stats.wall_time_s = time.perf_counter() - t0
+                    stats.result_series = data.num_series
+                    return QueryResult(data, stats, qcontext.query_id,
+                                       partial=shim.partial,
+                                       warnings=shim.warnings)
+            from filodb_tpu.utils.tracing import span
+            with span("plan-materialize"):
+                exec_plan = self.planner.materialize(plan, qcontext)
+            ctx = ExecContext(self.memstore, self.dataset, qcontext,
+                              deadline=deadline)
+            with query_latency.time(), span("exec-dispatch"):
+                result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
+                if materialize:
+                    # device → host once, at the boundary; query_range_many
+                    # defers this and batch-fetches across in-flight queries
+                    result.result.materialize()
+                    # device-resident results skipped in-tree enforcement
+                    # (compaction was deferred); enforce on the real count
+                    from filodb_tpu.query.exec.plan import (
+                        ExecPlan,
+                        apply_result_budget,
+                    )
+                    ExecPlan._enforce_limits(result.result, qcontext)
+                    # ...and the result-bytes budget likewise: in-tree
+                    # checks only see host-resident matrices
+                    result.result = apply_result_budget(result.result, ctx)
+                    result.partial = ctx.partial
+                    result.warnings = list(ctx.warnings)
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
         if result.partial:

@@ -213,11 +213,25 @@ class PlanExecutorServer:
         if kind == "execute":
             _, dataset, plan, qcontext = msg
             try:
-                ctx = ExecContext(self.memstore, dataset,
-                                  qcontext or QueryContext())
-                result = plan.execute(ctx)
-                result.result.materialize()  # wire-encode host, not device
-                return ("ok", result)
+                # same admission gate as local queries: scatter fan-in from
+                # many coordinators can't stampede this peer. A shed is a
+                # typed verdict, not an error — the dispatcher re-raises it
+                # as QueryRejected without counting a breaker failure.
+                from filodb_tpu.utils.governor import (
+                    EXPENSIVE,
+                    QueryRejected,
+                    governor,
+                )
+                try:
+                    with governor().admit(cost=EXPENSIVE):
+                        ctx = ExecContext(self.memstore, dataset,
+                                          qcontext or QueryContext())
+                        result = plan.execute(ctx)
+                        # wire-encode host, not device
+                        result.result.materialize()
+                        return ("ok", result)
+                except QueryRejected as e:
+                    return ("rejected", str(e), e.retry_after_s)
             except Exception as e:
                 log.exception("plan execution failed")
                 return ("err", repr(e))
@@ -411,6 +425,15 @@ class RemotePlanDispatcher(PlanDispatcher):
                 attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
         if resp[0] == "ok":
             return resp[1]
+        if resp[0] == "rejected":
+            # the peer's admission gate shed the query: a healthy-peer
+            # verdict (breaker already recorded success above). Re-raise
+            # typed so the root maps it to 503 + Retry-After; deliberately
+            # NOT gather-TOLERABLE — a shed peer is overload, not data loss.
+            from filodb_tpu.utils.governor import QueryRejected
+            retry_after = resp[2] if len(resp) > 2 else 1.0
+            raise QueryRejected(f"peer {self.peer} shed the query: {resp[1]}",
+                                retry_after_s=retry_after)
         raise RuntimeError(
             f"remote execution failed on {self.peer}: {resp[1]}")
 

@@ -39,6 +39,30 @@ class _Buffers:
     n: int = 0
 
 
+def _measure_unreferenced_buf_rc() -> int:
+    """Refcount an otherwise-unreferenced free-list buffer shows from
+    inside ``_reusable``, measured on a sentinel through the identical
+    call shape (list slot → obtain-local → method argument → getrefcount
+    argument). Measured rather than hard-coded: CPython 3.11 moved call
+    arguments into the callee frame while 3.10 copies them with an extra
+    incref per Python-level call, so the constant is version-dependent."""
+    import sys
+
+    free = [object()]
+
+    def probe(obj):
+        return sys.getrefcount(obj)
+
+    def obtain_shape():
+        buf = free[0]
+        return probe(buf)
+
+    return obtain_shape()
+
+
+_UNREFERENCED_BUF_RC = _measure_unreferenced_buf_rc()
+
+
 class WriteBufferPool:
     """Recycles appender sets across partitions of one schema — the analog
     of reference ``WriteBufferPool.scala:1-92`` (pre-allocated reusable
@@ -68,19 +92,25 @@ class WriteBufferPool:
         self.obtained = 0
         self.reused = 0
         self.blocked = 0  # probes skipped because a reader still held a ref
+        self.released = 0  # buffers handed back (parked or not)
 
     def _reusable(self, buf: _Buffers) -> bool:
         """True when no reader can still observe a mutation of ``buf``.
 
         Expected refcounts when unreferenced: the buffer object is held by
-        the free list, obtain()'s local, this parameter, and getrefcount's
-        argument (= 4); each in-place-mutated array only by its _Buffers
-        field plus getrefcount's argument (= 2, +1 for the loop variable).
+        the free list, obtain()'s local, this call's argument passing, and
+        getrefcount's argument — exactly ``_UNREFERENCED_BUF_RC``, measured
+        at import because the per-call-level cost differs across CPython
+        versions (3.11 moved arguments into the callee frame; 3.10 copies
+        them, adding one count per Python-level call). Each
+        in-place-mutated array is held only by its _Buffers field plus
+        getrefcount's argument (= 2, +1 for the loop variable) — those are
+        borrowed straight off the value stack, version-stable.
         Histogram/string columns are REPLACED (not mutated) at re-issue, so
         stale references to those can never observe new data and are not
         checked."""
         import sys
-        if sys.getrefcount(buf) > 4:
+        if sys.getrefcount(buf) > _UNREFERENCED_BUF_RC:
             return False
         if sys.getrefcount(buf.ts) > 2:
             return False
@@ -117,10 +147,19 @@ class WriteBufferPool:
             return buf
         return factory()
 
+    @property
+    def in_use(self) -> int:
+        """Buffers currently held by live partitions — the memory
+        watchdog's write-path pressure signal (``in_use / cap``)."""
+        return max(0, self.obtained - self.released)
+
     def release(self, buf: _Buffers | None) -> None:
         """Park a buffer for later reuse. Deliberately does NOT touch the
         buffer's contents — see obtain()."""
-        if buf is None or len(self._free) >= self.cap \
+        if buf is None:
+            return
+        self.released += 1
+        if len(self._free) >= self.cap \
                 or len(buf.ts) != self.max_chunk_size:
             return
         self._free.append(buf)

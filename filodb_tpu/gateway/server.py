@@ -19,7 +19,8 @@ from filodb_tpu.coordinator.ingestion import route_container
 from filodb_tpu.core.record import RecordContainer
 from filodb_tpu.gateway.influx import InfluxParseError, parse_influx_line
 from filodb_tpu.kafka.log import ReplayLog
-from filodb_tpu.utils.metrics import Counter, Histogram
+from filodb_tpu.utils import governor as governor_mod
+from filodb_tpu.utils.metrics import Counter, GaugeFn, Histogram
 
 log = logging.getLogger(__name__)
 
@@ -27,6 +28,9 @@ lines_parsed = Counter("gateway_lines_parsed")
 lines_failed = Counter("gateway_lines_failed")
 backpressure_waits = Counter("gateway_backpressure_waits")
 backpressure_seconds = Histogram("gateway_backpressure_seconds")
+# ingest shedding under governor CRITICAL state: records dropped instead of
+# blocking a full queue (observable BEFORE it becomes an outage)
+records_shed = Counter("gateway_records_shed")
 
 
 class ContainerSink:
@@ -51,8 +55,16 @@ class ContainerSink:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._flushing = False
+        # live queue depth at scrape time; weakref so a torn-down sink
+        # drops its series instead of pinning the object
+        import weakref
+        ref = weakref.ref(self)
+        GaugeFn("gateway_queue_depth",
+                lambda: (len(s._pending) if (s := ref()) is not None
+                         else None))
 
     def add(self, records) -> None:
+        records = list(records)
         t0 = None
         while True:
             batch = None
@@ -74,8 +86,19 @@ class ContainerSink:
                     self._pending = RecordContainer()
                     self._flushing = True
                 else:
-                    # full AND a drain is in flight: BLOCK (TCP pushes the
-                    # pressure back to the client)
+                    # full AND a drain is in flight. Under governor
+                    # CRITICAL (memory pressure) blocking would hold the
+                    # buffered records alive while memory is the scarce
+                    # resource — shed this batch instead and let the
+                    # client retry once pressure clears.
+                    if governor_mod.governor().state == governor_mod.CRITICAL:
+                        records_shed.inc(len(records))
+                        if t0 is not None:
+                            backpressure_seconds.observe(
+                                time.perf_counter() - t0)
+                        return
+                    # otherwise BLOCK (TCP pushes the pressure back to
+                    # the client)
                     if t0 is None:
                         t0 = time.perf_counter()
                         backpressure_waits.inc()

@@ -32,10 +32,13 @@ from filodb_tpu.http.server import (
     HttpDispatcher,
     ResponseCache,
     response_cache_key,
+    retry_after_headers,
     service_version,
 )
 from filodb_tpu.promql.parser import ParseError
 from filodb_tpu.query.model import QueryLimitExceeded
+from filodb_tpu.utils.governor import QueryRejected
+from filodb_tpu.utils.resilience import DeadlineExceeded
 
 log = logging.getLogger(__name__)
 
@@ -43,8 +46,9 @@ _MAX_BUF = 1 << 20          # drop connections with >1MB of pending request
 _MAX_BODY = 10 << 20
 _STATUS = {200: b"200 OK", 400: b"400 Bad Request", 404: b"404 Not Found",
            413: b"413 Content Too Large", 422: b"422 Unprocessable Entity",
-           431: b"431 Headers Too Large", 500: b"500 Internal Server Error",
-           501: b"501 Not Implemented"}
+           429: b"429 Too Many Requests", 431: b"431 Headers Too Large",
+           500: b"500 Internal Server Error", 501: b"501 Not Implemented",
+           503: b"503 Service Unavailable"}
 
 
 def _response_bytes(code: int, headers: dict, body: bytes,
@@ -363,14 +367,15 @@ class FastHttpServer:
                 results = None
             for i, req in enumerate(reqs):
                 if results is not None:
-                    code, body = 200, self._render(req, results[i])
+                    code, headers, body = (200, {"Content-Type": JSON_CT},
+                                           self._render(req, results[i]))
                 else:
-                    code, body = self._run_single(req)
+                    code, headers, body = self._run_single(req)
                 if code == 200 and req.ckey is not None \
                         and self.response_cache is not None:
                     self.response_cache.put(req.ckey, req.version, body)
                 req.conn.fill(req.slot, _response_bytes(
-                    code, {"Content-Type": JSON_CT}, body,
+                    code, headers, body,
                     req.conn.close_after and req.conn.is_last(req.slot)))
 
     @staticmethod
@@ -379,17 +384,27 @@ class FastHttpServer:
             return promjson.matrix_json_str(result).encode()
         return promjson.vector_json_str(result).encode()
 
-    def _run_single(self, req: _HotReq) -> tuple[int, bytes]:
+    def _run_single(self, req: _HotReq) -> tuple[int, dict, bytes]:
+        ct = {"Content-Type": JSON_CT}
         try:
-            return 200, self._render(req, req.svc.query_range(*req.params))
+            return (200, ct,
+                    self._render(req, req.svc.query_range(*req.params)))
         except (ParseError, ValueError) as e:
-            return 400, json.dumps(promjson.error_json(str(e))).encode()
+            return 400, ct, json.dumps(promjson.error_json(str(e))).encode()
         except QueryLimitExceeded as e:
-            return 422, json.dumps(
+            return 422, ct, json.dumps(
                 promjson.error_json(str(e), "query_limit")).encode()
+        except QueryRejected as e:
+            # shed by the admission gate: distinct errorType + Retry-After
+            # so clients back off instead of hammering an overloaded node
+            return 503, {**ct, **retry_after_headers(e.retry_after_s)}, \
+                json.dumps(promjson.error_json(str(e), "unavailable")).encode()
+        except DeadlineExceeded as e:
+            return 503, {**ct, **retry_after_headers()}, json.dumps(
+                promjson.error_json(str(e), "timeout")).encode()
         except Exception as e:  # noqa: BLE001
             log.exception("hot query failed")
-            return 500, json.dumps(
+            return 500, ct, json.dumps(
                 promjson.error_json(str(e), "internal")).encode()
 
     # -- writes --

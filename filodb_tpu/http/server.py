@@ -33,12 +33,22 @@ from filodb_tpu.coordinator.query_service import QueryService
 from filodb_tpu.http import promjson
 from filodb_tpu.promql.parser import ParseError, TimeStepParams, parse_query
 from filodb_tpu.query.model import QueryLimitExceeded
+from filodb_tpu.utils.governor import QueryRejected
 from filodb_tpu.utils.metrics import render_prometheus
 from filodb_tpu.utils.resilience import DeadlineExceeded
 
 log = logging.getLogger(__name__)
 
 JSON_CT = "application/json"
+
+
+def retry_after_headers(after_s: float | None = None) -> dict:
+    """``Retry-After`` for 503/429 sheds, shared by both server fronts.
+    The header carries whole seconds (RFC 9110), never less than 1."""
+    if after_s is None:
+        from filodb_tpu.utils.governor import config as governor_config
+        after_s = governor_config().retry_after_s
+    return {"Retry-After": str(max(1, int(round(float(after_s)))))}
 
 
 class ResponseCache:
@@ -153,8 +163,16 @@ class HttpDispatcher:
             return self._json(400, promjson.error_json(str(e)))
         except QueryLimitExceeded as e:
             return self._json(422, promjson.error_json(str(e), "query_limit"))
+        except QueryRejected as e:
+            # shed by the admission gate (local or a remote peer's): 503 +
+            # Retry-After with a DISTINCT errorType from a timeout, so
+            # clients back off instead of hammering an overloaded node
+            return self._json(503,
+                              promjson.error_json(str(e), "unavailable"),
+                              headers=retry_after_headers(e.retry_after_s))
         except DeadlineExceeded as e:
-            return self._json(503, promjson.error_json(str(e), "timeout"))
+            return self._json(503, promjson.error_json(str(e), "timeout"),
+                              headers=retry_after_headers())
         except Exception as e:  # pragma: no cover
             log.exception("request failed")
             return self._json(500, promjson.error_json(str(e), "internal"))
@@ -162,10 +180,14 @@ class HttpDispatcher:
     # -- helpers --
 
     @staticmethod
-    def _json(code: int, payload) -> tuple[int, dict, bytes]:
+    def _json(code: int, payload,
+              headers: dict | None = None) -> tuple[int, dict, bytes]:
         body = payload.encode() if isinstance(payload, str) \
             else json.dumps(payload).encode()
-        return code, {"Content-Type": JSON_CT}, body
+        h = {"Content-Type": JSON_CT}
+        if headers:
+            h.update(headers)
+        return code, h, body
 
     # -- routing --
 

@@ -76,6 +76,36 @@ class ExecContext:
     # partial scatter-gather state, accumulated by NonLeafExecPlan.gather
     partial: bool = False
     warnings: list[str] = field(default_factory=list)
+    # per-query scan-time cost budget (utils/governor.QueryBudget); checked
+    # incrementally in leaf scans and transformers, not just on the final
+    # matrix. Defaults from the QueryContext so remote executors pick the
+    # root's budget off the wire.
+    budget: object = None
+
+    def __post_init__(self):
+        if self.budget is None:
+            self.budget = getattr(self.qcontext.planner_params,
+                                  "budget", None)
+
+
+def apply_result_budget(data: StepMatrix, ctx: "ExecContext") -> StepMatrix:
+    """Enforce the result-bytes budget on a materialized matrix. In
+    ``degrade="partial"`` mode the matrix is truncated to the series rows
+    that fit the byte budget (the breach is already recorded on ``ctx`` as
+    partial + warning); ``degrade="error"`` raises from the check itself."""
+    budget = getattr(ctx, "budget", None)
+    if budget is None or not isinstance(data.values, np.ndarray) \
+            or data.num_series == 0:
+        return data
+    nbytes = int(data.values.nbytes)
+    if not budget.check_result_bytes(ctx, nbytes):
+        return data
+    per_row = max(1, nbytes // data.num_series)
+    keep = max(1, int(budget.max_result_bytes) // per_row)
+    if keep >= data.num_series:
+        return data
+    return StepMatrix(list(data.keys[:keep]), data.values[:keep],
+                      data.steps_ms, data.les)
 
 
 @dataclass
@@ -104,6 +134,7 @@ class ExecPlan:
         if isinstance(data.values, np.ndarray) \
                 and not getattr(data, "_pending_compact", False):
             self._enforce_limits(data, ctx.qcontext)
+            data = apply_result_budget(data, ctx)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id,
                            partial=ctx.partial, warnings=list(ctx.warnings))
 
@@ -178,6 +209,7 @@ class SelectRawPartitionsExec(ExecPlan):
             by_schema.setdefault(p.schema.name, []).append(p)
         outs = []
         version = shard.data_version
+        leaf_scanned = 0  # budget is per leaf: identical local or remote
         for schema_name, sparts in by_schema.items():
             schema = sparts[0].schema
             col = self._value_col_index(schema)
@@ -213,8 +245,19 @@ class SelectRawPartitionsExec(ExecPlan):
                     shard.batch_cache.pop(next(iter(shard.batch_cache)))
                 shard.batch_cache[cache_key] = (version, batch, keys,
                                                 is_counter)
-            ctx.stats.samples_scanned += int(batch.counts.sum())
+            scanned = int(batch.counts.sum())
+            ctx.stats.samples_scanned += scanned
+            leaf_scanned += scanned
             outs.append((batch, keys, is_counter))
+            # incremental scan-time budget: stop scanning further schema
+            # groups once the samples budget is breached — partial mode
+            # keeps what was already scanned, error mode raises here. The
+            # count is LEAF-local, not query-cumulative, so a distributed
+            # query degrades identically whether its leaves run in-process
+            # (shared stats) or on remote peers (per-peer stats).
+            if ctx.budget is not None and ctx.budget.check_samples(
+                    ctx, leaf_scanned):
+                break
         # the first transformer must be the windowing mapper — it consumes the
         # batch directly; the rest apply to the concatenated step matrix
         from filodb_tpu.query.exec.transformers import PeriodicSamplesMapper
@@ -241,6 +284,7 @@ class SelectRawPartitionsExec(ExecPlan):
         if isinstance(data.values, np.ndarray) \
                 and not getattr(data, "_pending_compact", False):
             self._enforce_limits(data, ctx.qcontext)
+            data = apply_result_budget(data, ctx)
         return QueryResult(data, ctx.stats, ctx.qcontext.query_id,
                            partial=ctx.partial, warnings=list(ctx.warnings))
 
@@ -355,6 +399,13 @@ class NonLeafExecPlan(ExecPlan):
                     ctx.partial = True
                     ctx.warnings.extend(w for w in result.warnings
                                         if w not in ctx.warnings)
+                # remote children carry their own stats object; fold its
+                # scan counters upward (in-process children share THIS
+                # ctx.stats — merging would double-count)
+                stats = getattr(result, "stats", None)
+                if stats is not None and stats is not ctx.stats:
+                    ctx.stats.series_scanned += stats.series_scanned
+                    ctx.stats.samples_scanned += stats.samples_scanned
                 fold(result.result)
                 return
             err = payload
@@ -476,8 +527,9 @@ class ReduceAggregateExec(NonLeafExecPlan):
             self.gather_each(ctx, folder.fold)
             return folder.finalize()
         data = StepMatrix.concat(self.gather(ctx))
-        return AggregateMapReduce(self.op, self.params, self.by,
-                                  self.without).apply(data)
+        amr = AggregateMapReduce(self.op, self.params, self.by, self.without)
+        amr.bind(ctx)  # group-cardinality budget sees the query's ctx
+        return amr.apply(data)
 
     def __repr__(self):
         pd = ", pushdown" if self.pushdown else ""

@@ -212,6 +212,11 @@ class AggregateMapReduce(RangeVectorTransformer):
     by: tuple[str, ...] = ()
     without: tuple[str, ...] = ()
 
+    def bind(self, ctx) -> None:
+        # exec-context hook (ExecPlan.execute / leaf chains call bind before
+        # apply): gives the aggregation access to the query's cost budget
+        self._ctx = ctx
+
     def group_keys(self, keys: list[RangeVectorKey]) -> list[RangeVectorKey]:
         if self.by:
             return [k.only(self.by) for k in keys]
@@ -243,6 +248,20 @@ class AggregateMapReduce(RangeVectorTransformer):
             return data
         gids, out_keys = self._group_ids(data.keys)
         G = len(out_keys)
+        # scan-time group-cardinality budget: checked BEFORE the aggregation
+        # kernel runs, so a runaway group-by fails (or truncates) without
+        # paying for the full reduction
+        ctx = getattr(self, "_ctx", None)
+        budget = getattr(ctx, "budget", None) if ctx is not None else None
+        if budget is not None and budget.check_cardinality(ctx, G):
+            limit = int(budget.max_group_cardinality)
+            idx = np.nonzero(gids < limit)[0]
+            data = StepMatrix([data.keys[i] for i in idx],
+                              np.asarray(data.values)[idx],
+                              data.steps_ms, data.les)
+            gids = gids[idx]
+            out_keys = out_keys[:limit]
+            G = limit
         v = jnp.asarray(data.values)
         g = jnp.asarray(gids)
 

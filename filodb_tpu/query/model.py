@@ -226,6 +226,10 @@ class PlannerParams:
     # to the process-wide resilience config defaults.
     allow_partial: bool | None = None
     max_partial_fraction: float | None = None
+    # per-query scan-time cost budget (utils/governor.QueryBudget); rides
+    # the wire with the QueryContext so a distributed query shares one
+    # budget across its remote leaves. None = no budget.
+    budget: "object | None" = None
 
 
 @dataclass

@@ -36,6 +36,10 @@ class FiloServer:
         if config.resilience:
             from filodb_tpu.utils import resilience
             resilience.configure(**config.resilience)
+        if config.governor:
+            from filodb_tpu.utils import governor
+            governor.configure(**config.governor)
+        self.watchdog = None
         os.makedirs(config.data_dir, exist_ok=True)
         self.store_server = None
         if config.store_remote:
@@ -319,6 +323,49 @@ class FiloServer:
                  for s in range(first.num_shards)},
                 first.num_shards, cfg.spreads.get(first.dataset, 1))
             self.gateway = GatewayServer(sink, port=cfg.gateway_port).start()
+        # memory-pressure watchdog: write-buffer-pool occupancy and result-
+        # cache bytes drive the governor's ok → degraded → critical states;
+        # degraded evicts the result caches and tightens admission,
+        # critical sheds gateway ingest and new expensive queries
+        import weakref
+        from filodb_tpu.utils.governor import MemoryWatchdog
+        self.watchdog = MemoryWatchdog()
+        memstore = self.memstore
+        datasets = list(cfg.datasets)
+
+        def buffer_pool_utilization():
+            worst = None
+            for name in datasets:
+                for shard in memstore.shards_for(name):
+                    for pool in getattr(shard, "buffer_pools", {}).values():
+                        frac = pool.in_use / max(1, pool.cap)
+                        worst = frac if worst is None else max(worst, frac)
+            return worst
+
+        self.watchdog.add_source("write_buffer_pools",
+                                 buffer_pool_utilization)
+        for name, svc in services.items():
+            rc = getattr(svc, "result_cache", None)
+            if rc is None:
+                continue
+            rc_ref = weakref.ref(rc)
+
+            def cache_fraction(rc_ref=rc_ref):
+                rc = rc_ref()
+                if rc is None:
+                    return None
+                return rc.nbytes / max(1, rc.config.max_bytes)
+
+            self.watchdog.add_source(f"result_cache.{name}", cache_fraction)
+
+        def evict_caches(_state):
+            for svc in services.values():
+                rc = getattr(svc, "result_cache", None)
+                if rc is not None:
+                    rc.clear()
+
+        self.watchdog.on_degraded.append(evict_caches)
+        self.watchdog.start()
         if os.environ.get("FILODB_PROFILER"):
             # built-in sampling profiler (reference SimpleProfiler started
             # from FiloServer.start)
@@ -510,6 +557,8 @@ class FiloServer:
         self.is_coordinator = True
 
     def shutdown(self):
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop()  # also resets the governor state to OK
         if getattr(self, "_failover_stop", None) is not None:
             self._failover_stop.set()
         if getattr(self, "_sub_stop", None) is not None:

@@ -1,0 +1,428 @@
+"""Node-level resource governor: admission control, query cost budgets, and
+memory-pressure load shedding.
+
+Counterpart of the reference's multi-tenant protection layer: `sample-limit`
+and queried-data-size checks bound what one query may scan
+(``QueryContext.scala`` / ``PlannerParams``), cardinality quotas bound what
+one tenant may ingest, and the coordinator sheds load instead of letting a
+hot node fall over. Here those properties live in one node-local governor:
+
+- :class:`ResourceGovernor` — a bounded-concurrency admission gate with a
+  deadline-aware wait queue in front of every query entry point (HTTP,
+  remote exec, batcher). Over-capacity requests queue until their deadline
+  budget says they cannot finish, then are shed with
+  :class:`QueryRejected` (HTTP 503 + ``Retry-After``).
+- :class:`QueryBudget` — per-query scan-time limits (samples scanned,
+  result bytes, group-by cardinality) checked *incrementally* inside leaf
+  scans and transformers, not only on the final matrix. ``degrade="partial"``
+  returns what was scanned so far flagged ``partial=True`` (PR 1 plumbing);
+  ``degrade="error"`` raises :class:`QueryBudgetExceeded` (HTTP 422).
+  Budgets ride ``PlannerParams`` over the wire so a distributed query
+  shares one budget across its remote leaves.
+- :class:`MemoryWatchdog` — samples utilization sources (write-buffer-pool
+  occupancy, result-cache bytes) and drives the node through
+  ``ok -> degraded -> critical``: degraded evicts caches and tightens
+  admission capacity; critical sheds gateway ingest and rejects new
+  expensive queries while cheap/instant queries stay alive.
+
+Every transition and rejection is a ``filodb_governor_*`` metric.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from filodb_tpu.query.model import QueryLimitExceeded
+from filodb_tpu.utils.metrics import Counter, Gauge, Histogram
+
+# ---------------------------------------------------------------------------
+# states
+
+OK, DEGRADED, CRITICAL = "ok", "degraded", "critical"
+_STATE_VALUE = {OK: 0, DEGRADED: 1, CRITICAL: 2}
+
+# admission cost classes: "cheap" (instant/metadata — stays admissible under
+# CRITICAL) vs "expensive" (range scans — shed first under pressure)
+CHEAP, EXPENSIVE = "cheap", "expensive"
+
+
+# ---------------------------------------------------------------------------
+# errors
+
+
+class QueryRejected(RuntimeError):
+    """The admission gate shed this query (HTTP 503 + ``Retry-After``).
+
+    Deliberately NOT a ``ConnectionError``/``TimeoutError``: a peer that
+    sheds is *healthy* — scatter-gather must not treat it as a lost child
+    and circuit breakers must not count it as a transport failure.
+    """
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0,
+                 reason: str = "capacity"):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+class QueryBudgetExceeded(QueryLimitExceeded):
+    """A scan-time cost budget was breached in ``degrade="error"`` mode
+    (maps to HTTP 422 through the existing ``QueryLimitExceeded`` arm)."""
+
+
+# ---------------------------------------------------------------------------
+# metrics — pre-created at import so the scrape families render even before
+# any traffic moves them
+
+_state_gauge = Gauge("filodb_governor_state")
+_inflight_gauge = Gauge("filodb_governor_inflight")
+_queue_depth_gauge = Gauge("filodb_governor_queue_depth")
+_memory_util_gauge = Gauge("filodb_governor_memory_utilization")
+_admitted = Counter("filodb_governor_admitted")
+_rejected = {r: Counter("filodb_governor_rejected", {"reason": r})
+             for r in ("capacity", "deadline", "queue_full", "critical")}
+_transitions = {s: Counter("filodb_governor_transitions", {"to": s})
+                for s in (OK, DEGRADED, CRITICAL)}
+_budget_exceeded = Counter("filodb_governor_budget_exceeded")
+_queue_wait = Histogram("filodb_governor_queue_wait_seconds")
+
+
+# ---------------------------------------------------------------------------
+# config (process-wide singleton; overridable via config.py "governor" block)
+
+
+@dataclass
+class GovernorConfig:
+    admission_capacity: int = 32       # concurrent queries when OK
+    admission_queue_limit: int = 128   # waiters beyond that -> queue_full
+    max_queue_wait_s: float = 5.0      # hard cap on time spent queued
+    queue_headroom_s: float = 0.05     # deadline slack a queued query keeps
+    retry_after_s: float = 1.0         # advisory Retry-After on sheds
+    degraded_capacity_factor: float = 0.5
+    degraded_threshold: float = 0.75   # max source utilization -> degraded
+    critical_threshold: float = 0.92   # max source utilization -> critical
+    watchdog_interval_s: float = 0.5
+    # budget limits; 0 = unlimited (no budget attached to queries)
+    max_samples_scanned: int = 0
+    max_result_bytes: int = 0
+    max_group_cardinality: int = 0
+    budget_degrade: str = "partial"    # "partial" | "error"
+
+
+_config = GovernorConfig()
+
+
+def config() -> GovernorConfig:
+    return _config
+
+
+def configure(**kw) -> GovernorConfig:
+    """Apply server-config overrides (``config.py`` ``governor`` block)."""
+    for k, v in kw.items():
+        if hasattr(_config, k):
+            setattr(_config, k, v)
+    return _config
+
+
+# ---------------------------------------------------------------------------
+# query budget
+
+
+@dataclass
+class QueryBudget:
+    """Per-query scan-time cost limits; 0 means unlimited for that axis.
+
+    Wire-serializable (registered in ``coordinator/wire.py``) and carried on
+    ``PlannerParams.budget`` so remote leaves enforce the same budget.
+    """
+
+    max_samples_scanned: int = 0
+    max_result_bytes: int = 0
+    max_group_cardinality: int = 0
+    degrade: str = "partial"
+
+    def breach(self, ctx, what: str, limit: int, actual: int) -> bool:
+        """Record a budget breach. ``degrade="error"`` raises; partial mode
+        flags ``ctx`` partial with a warning and returns True so the caller
+        stops scanning and returns what it has."""
+        _budget_exceeded.inc()
+        msg = (f"query budget exceeded: {what} {actual} > {limit}; "
+               f"returning partial data")
+        if self.degrade == "error":
+            raise QueryBudgetExceeded(
+                f"query budget exceeded: {what} {actual} > limit {limit}")
+        if ctx is not None:
+            ctx.partial = True
+            if msg not in ctx.warnings:
+                ctx.warnings.append(msg)
+        return True
+
+    def check_samples(self, ctx, samples_scanned: int) -> bool:
+        """True when the samples budget is breached (and recorded)."""
+        lim = self.max_samples_scanned
+        if lim and samples_scanned > lim:
+            return self.breach(ctx, "samples scanned", lim, samples_scanned)
+        return False
+
+    def check_result_bytes(self, ctx, nbytes: int) -> bool:
+        lim = self.max_result_bytes
+        if lim and nbytes > lim:
+            return self.breach(ctx, "result bytes", lim, nbytes)
+        return False
+
+    def check_cardinality(self, ctx, groups: int) -> bool:
+        lim = self.max_group_cardinality
+        if lim and groups > lim:
+            return self.breach(ctx, "group cardinality", lim, groups)
+        return False
+
+
+def default_budget() -> QueryBudget | None:
+    """Budget from the governor config, or None when every axis is
+    unlimited (the common case: budgets are opt-in, existing queries see
+    no behavior change)."""
+    c = _config
+    if not (c.max_samples_scanned or c.max_result_bytes
+            or c.max_group_cardinality):
+        return None
+    return QueryBudget(max_samples_scanned=c.max_samples_scanned,
+                       max_result_bytes=c.max_result_bytes,
+                       max_group_cardinality=c.max_group_cardinality,
+                       degrade=c.budget_degrade)
+
+
+# ---------------------------------------------------------------------------
+# admission gate
+
+
+class ResourceGovernor:
+    """Bounded-concurrency admission gate with a deadline-aware wait queue.
+
+    Capacity shrinks by ``degraded_capacity_factor`` when the watchdog moves
+    the node out of OK; under CRITICAL, new ``EXPENSIVE`` work is shed
+    outright while ``CHEAP`` (instant/metadata) queries keep flowing.
+    Admission never deadlocks: every wait is bounded by the caller's
+    deadline and ``max_queue_wait_s``, and slots are always released via
+    the :meth:`admit` context manager.
+    """
+
+    def __init__(self, cfg: GovernorConfig | None = None):
+        self.cfg = cfg or _config
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._waiters = 0
+        self._state = OK
+        _state_gauge.set(_STATE_VALUE[OK])
+        _inflight_gauge.set(0)
+        _queue_depth_gauge.set(0)
+
+    # -- state ------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def set_state(self, new: str) -> bool:
+        """Move to ``new`` state; returns True when this was a transition."""
+        if new not in _STATE_VALUE:
+            raise ValueError(f"unknown governor state {new!r}")
+        with self._cond:
+            if new == self._state:
+                return False
+            self._state = new
+            _state_gauge.set(_STATE_VALUE[new])
+            _transitions[new].inc()
+            self._cond.notify_all()
+        return True
+
+    def capacity(self) -> int:
+        cap = max(1, int(self.cfg.admission_capacity))
+        if self._state != OK:
+            cap = max(1, int(cap * self.cfg.degraded_capacity_factor))
+        return cap
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    # -- admission --------------------------------------------------------
+
+    def _reject(self, reason: str, detail: str) -> None:
+        _rejected[reason].inc()
+        raise QueryRejected(f"query shed ({reason}): {detail}",
+                            retry_after_s=self.cfg.retry_after_s,
+                            reason=reason)
+
+    @contextmanager
+    def admit(self, deadline=None, cost: str = EXPENSIVE):
+        """Admit one query; blocks while at capacity until a slot frees or
+        the wait budget (deadline minus headroom, capped at
+        ``max_queue_wait_s``) runs out, then sheds with
+        :class:`QueryRejected`."""
+        self._acquire(deadline, cost)
+        try:
+            yield self
+        finally:
+            self._release()
+
+    def _acquire(self, deadline, cost: str) -> None:
+        cfg = self.cfg
+        t0 = time.monotonic()
+        with self._cond:
+            if self._state == CRITICAL and cost == EXPENSIVE:
+                self._reject("critical",
+                             "node under memory pressure; only cheap "
+                             "queries admitted")
+            if self._inflight < self.capacity() and self._waiters == 0:
+                self._admit_locked(t0)
+                return
+            if self._waiters >= cfg.admission_queue_limit:
+                self._reject("queue_full",
+                             f"admission queue full "
+                             f"({self._waiters} waiting)")
+            self._waiters += 1
+            _queue_depth_gauge.set(self._waiters)
+            try:
+                while True:
+                    if self._state == CRITICAL and cost == EXPENSIVE:
+                        self._reject("critical",
+                                     "node went critical while queued")
+                    if self._inflight < self.capacity():
+                        self._admit_locked(t0)
+                        return
+                    budget = cfg.max_queue_wait_s - (time.monotonic() - t0)
+                    if deadline is not None:
+                        budget = min(budget, deadline.remaining()
+                                     - cfg.queue_headroom_s)
+                    if budget <= 0:
+                        reason = "deadline" if deadline is not None \
+                            else "capacity"
+                        self._reject(reason,
+                                     f"no capacity within wait budget "
+                                     f"(inflight={self._inflight}, "
+                                     f"capacity={self.capacity()})")
+                    self._cond.wait(timeout=min(budget, 0.25))
+            finally:
+                self._waiters -= 1
+                _queue_depth_gauge.set(self._waiters)
+
+    def _admit_locked(self, t0: float) -> None:
+        self._inflight += 1
+        _inflight_gauge.set(self._inflight)
+        _admitted.inc()
+        _queue_wait.observe(time.monotonic() - t0)
+
+    def _release(self) -> None:
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            _inflight_gauge.set(self._inflight)
+            self._cond.notify()
+
+
+# ---------------------------------------------------------------------------
+# memory watchdog
+
+
+class MemoryWatchdog:
+    """Periodically samples utilization sources (0..1 each) and drives the
+    governor's state machine; the max over sources decides the state.
+
+    Sources are callables returning a fraction or None (subject torn down).
+    ``on_degraded`` callbacks fire on every upward transition out of OK —
+    standalone wires result-cache eviction there.
+    """
+
+    def __init__(self, gov: ResourceGovernor | None = None,
+                 interval_s: float | None = None, clock=time.monotonic):
+        self.gov = gov or governor()
+        self.interval_s = interval_s if interval_s is not None \
+            else self.gov.cfg.watchdog_interval_s
+        self.clock = clock
+        self.sources: list[tuple[str, "callable"]] = []
+        self.on_degraded: list["callable"] = []
+        self._stop = threading.Event()
+        self._thread = None
+
+    def add_source(self, name: str, fn) -> "MemoryWatchdog":
+        self.sources.append((name, fn))
+        return self
+
+    def utilization(self) -> float:
+        worst = 0.0
+        for _name, fn in self.sources:
+            try:
+                v = fn()
+            except Exception:
+                continue
+            if v is not None:
+                worst = max(worst, float(v))
+        return worst
+
+    def sample(self) -> str:
+        """One observation: read sources, map to a state, apply it."""
+        util = self.utilization()
+        _memory_util_gauge.set(util)
+        cfg = self.gov.cfg
+        if util >= cfg.critical_threshold:
+            new = CRITICAL
+        elif util >= cfg.degraded_threshold:
+            new = DEGRADED
+        else:
+            new = OK
+        prev = self.gov.state
+        if self.gov.set_state(new) and _STATE_VALUE[new] > _STATE_VALUE[prev]:
+            for cb in self.on_degraded:
+                try:
+                    cb(new)
+                except Exception:
+                    pass
+        return new
+
+    def start(self) -> "MemoryWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.sample()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="governor-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+        # a stopped watchdog leaves no stale pressure behind (tests share
+        # the process-global governor)
+        self.gov.set_state(OK)
+
+
+# ---------------------------------------------------------------------------
+# process-global governor singleton
+
+_governor: ResourceGovernor | None = None
+_governor_lock = threading.Lock()
+
+
+def governor() -> ResourceGovernor:
+    global _governor
+    with _governor_lock:
+        if _governor is None:
+            _governor = ResourceGovernor(_config)
+        return _governor
+
+
+def reset() -> None:
+    """Fresh governor + default config (tests)."""
+    global _governor
+    with _governor_lock:
+        _config.__dict__.update(GovernorConfig().__dict__)
+        _governor = ResourceGovernor(_config)

@@ -1,0 +1,523 @@
+"""Adaptive overload protection: the admission gate (bounded concurrency +
+deadline-aware wait queue), scan-time query budgets (partial vs error
+degrade, identical local and remote), the memory-pressure watchdog state
+machine, HTTP 503/Retry-After encoding on both fronts, gateway ingest
+shedding under CRITICAL, and the routed cardinality-quota path."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.remote import (
+    PlanExecutorServer,
+    RemotePlanDispatcher,
+    reset_pool,
+)
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.query.model import QueryContext, QueryLimitExceeded
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils import governor as gov
+from filodb_tpu.utils.resilience import Deadline, reset_breakers
+
+NUM_SHARDS = 4
+START = 1_600_000_000
+QS = START + 100
+QE = START + 2000
+STEP = 60
+
+
+@pytest.fixture(autouse=True)
+def fresh_governor():
+    """Tests share the process-global governor: isolate every test."""
+    gov.reset()
+    yield
+    gov.reset()
+
+
+def build_store():
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    ingest_routed(ms, "timeseries",
+                  gauge_stream(machine_metrics_series(10, ns="App-2"), 240,
+                               start_ms=START * 1000, interval_ms=10_000,
+                               seed=11),
+                  NUM_SHARDS, spread=1)
+    return ms
+
+
+@pytest.fixture(scope="module")
+def store():
+    return build_store()
+
+
+@pytest.fixture
+def svc(store):
+    s = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+    s.result_cache = None  # budgets asserted against the engine directly
+    return s
+
+
+def assert_equivalent(a, b, rtol=2e-5):
+    m0, m1 = a.result, b.result
+    i0 = {k: i for i, k in enumerate(m0.keys)}
+    i1 = {k: i for i, k in enumerate(m1.keys)}
+    assert set(i0) == set(i1), set(i0) ^ set(i1)
+    for k, i in i0.items():
+        x = np.asarray(m0.values[i])
+        y = np.asarray(m1.values[i1[k]])
+        assert np.array_equal(np.isnan(x), np.isnan(y)), k
+        assert np.allclose(x, y, rtol=rtol, atol=1e-9, equal_nan=True), k
+
+
+def _hold_slot(g):
+    """Occupy one admission slot from another thread; returns (release,
+    thread) once the slot is definitely held."""
+    held, release = threading.Event(), threading.Event()
+
+    def occupant():
+        with g.admit():
+            held.set()
+            release.wait(timeout=30)
+
+    t = threading.Thread(target=occupant, daemon=True)
+    t.start()
+    assert held.wait(timeout=5)
+    return release, t
+
+
+# ---------------------------------------------------------------------------
+# admission gate
+
+
+class TestAdmissionGate:
+    def test_admit_and_release(self):
+        g = gov.governor()
+        before = gov._admitted.value
+        with g.admit():
+            assert g.inflight == 1
+        assert g.inflight == 0
+        assert gov._admitted.value == before + 1
+
+    def test_waiter_admitted_when_slot_frees(self):
+        gov.configure(admission_capacity=1)
+        g = gov.governor()
+        release, t = _hold_slot(g)
+        got = threading.Event()
+
+        def waiter():
+            with g.admit():
+                got.set()
+
+        w = threading.Thread(target=waiter, daemon=True)
+        w.start()
+        time.sleep(0.1)
+        assert not got.is_set()  # queued behind the occupant
+        release.set()
+        assert got.wait(timeout=5)
+        t.join(timeout=5)
+        w.join(timeout=5)
+        assert g.inflight == 0
+
+    def test_shed_when_deadline_cannot_be_met(self):
+        gov.configure(admission_capacity=1, retry_after_s=2.0)
+        g = gov.governor()
+        release, t = _hold_slot(g)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(gov.QueryRejected) as ei:
+                with g.admit(deadline=Deadline.after(0.3)):
+                    pass
+            assert time.monotonic() - t0 < 2.0  # shed promptly, no hang
+            assert ei.value.reason == "deadline"
+            assert ei.value.retry_after_s == 2.0
+            assert gov._rejected["deadline"].value >= 1
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    def test_shed_on_max_queue_wait(self):
+        gov.configure(admission_capacity=1, max_queue_wait_s=0.2)
+        g = gov.governor()
+        release, t = _hold_slot(g)
+        try:
+            with pytest.raises(gov.QueryRejected) as ei:
+                with g.admit():  # no deadline: bounded by max_queue_wait_s
+                    pass
+            assert ei.value.reason == "capacity"
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    def test_queue_full_sheds_immediately(self):
+        gov.configure(admission_capacity=1, admission_queue_limit=0)
+        g = gov.governor()
+        release, t = _hold_slot(g)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(gov.QueryRejected) as ei:
+                with g.admit():
+                    pass
+            assert time.monotonic() - t0 < 0.5  # no queue slot -> no wait
+            assert ei.value.reason == "queue_full"
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    def test_critical_sheds_expensive_admits_cheap(self):
+        g = gov.governor()
+        g.set_state(gov.CRITICAL)
+        with pytest.raises(gov.QueryRejected) as ei:
+            with g.admit(cost=gov.EXPENSIVE):
+                pass
+        assert ei.value.reason == "critical"
+        assert gov._rejected["critical"].value >= 1
+        with g.admit(cost=gov.CHEAP):  # instant/metadata stays alive
+            assert g.inflight == 1
+
+    def test_degraded_capacity_shrinks(self):
+        gov.configure(admission_capacity=8, degraded_capacity_factor=0.5)
+        g = gov.governor()
+        assert g.capacity() == 8
+        before = gov._transitions[gov.DEGRADED].value
+        assert g.set_state(gov.DEGRADED)
+        assert g.capacity() == 4
+        assert not g.set_state(gov.DEGRADED)  # idempotent, not a transition
+        assert gov._transitions[gov.DEGRADED].value == before + 1
+        g.set_state(gov.OK)
+        assert g.capacity() == 8
+
+
+# ---------------------------------------------------------------------------
+# memory watchdog
+
+
+class TestMemoryWatchdog:
+    def test_threshold_state_machine(self):
+        g = gov.governor()
+        level = {"v": 0.1}
+        fired = []
+        w = gov.MemoryWatchdog(gov=g, interval_s=999.0)
+        w.add_source("fake", lambda: level["v"])
+        w.on_degraded.append(lambda s: fired.append(s))
+
+        assert w.sample() == gov.OK
+        level["v"] = 0.80
+        assert w.sample() == gov.DEGRADED
+        level["v"] = 0.95
+        assert w.sample() == gov.CRITICAL
+        assert fired == [gov.DEGRADED, gov.CRITICAL]  # upward edges only
+        level["v"] = 0.10
+        assert w.sample() == gov.OK
+        assert fired == [gov.DEGRADED, gov.CRITICAL]  # recovery is silent
+
+    def test_broken_and_torn_down_sources_are_skipped(self):
+        w = gov.MemoryWatchdog(gov=gov.governor(), interval_s=999.0)
+        w.add_source("gone", lambda: None)
+        w.add_source("broken", lambda: 1 / 0)
+        w.add_source("live", lambda: 0.4)
+        assert w.utilization() == pytest.approx(0.4)
+
+    def test_background_thread_drives_state_and_stop_resets(self):
+        g = gov.governor()
+        level = {"v": 0.99}
+        w = gov.MemoryWatchdog(gov=g, interval_s=0.02)
+        w.add_source("fake", lambda: level["v"])
+        w.start()
+        try:
+            deadline = time.monotonic() + 5
+            while g.state != gov.CRITICAL and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert g.state == gov.CRITICAL
+        finally:
+            w.stop()
+        assert g.state == gov.OK  # stop never strands pressure
+
+
+# ---------------------------------------------------------------------------
+# admission wired through QueryService
+
+
+class TestServiceAdmission:
+    def test_query_shed_then_recovers(self, svc):
+        gov.configure(admission_capacity=1, max_queue_wait_s=0.2,
+                      retry_after_s=3.0)
+        g = gov.governor()
+        release, t = _hold_slot(g)
+        try:
+            with pytest.raises(gov.QueryRejected) as ei:
+                svc.query_range("heap_usage", QS, STEP, QE)
+            assert ei.value.retry_after_s == 3.0
+        finally:
+            release.set()
+            t.join(timeout=5)
+        # slot freed: the very same query is admitted and completes
+        r = svc.query_range("heap_usage", QS, STEP, QE)
+        assert r.result.num_series == 10
+        assert not r.partial
+
+    def test_instant_query_survives_critical(self, svc):
+        gov.governor().set_state(gov.CRITICAL)
+        with pytest.raises(gov.QueryRejected):
+            svc.query_range("heap_usage", QS, STEP, QE)  # range: expensive
+        r = svc.query_range("heap_usage", QE, 0, QE)  # instant: cheap
+        assert r.result.num_series >= 1
+
+
+# ---------------------------------------------------------------------------
+# scan-time query budgets
+
+
+class TestQueryBudget:
+    def _qc(self, **limits):
+        qc = QueryContext()
+        qc.planner_params.budget = gov.QueryBudget(**limits)
+        return qc
+
+    def test_samples_budget_partial(self, svc):
+        r = svc.query_range("heap_usage", QS, STEP, QE,
+                            self._qc(max_samples_scanned=50))
+        assert r.partial
+        assert any("budget" in w for w in r.warnings)
+        full = svc.query_range("heap_usage", QS, STEP, QE)
+        assert not full.partial and not full.warnings
+
+    def test_samples_budget_error_mode(self, svc):
+        with pytest.raises(QueryLimitExceeded):
+            svc.query_range("heap_usage", QS, STEP, QE,
+                            self._qc(max_samples_scanned=50,
+                                     degrade="error"))
+
+    def test_default_budget_from_config(self, svc):
+        """Config-level limits attach a budget without the caller opting
+        in; unlimited config (the default) attaches none."""
+        before = gov._budget_exceeded.value
+        gov.configure(max_samples_scanned=50)
+        r = svc.query_range("heap_usage", QS, STEP, QE)
+        assert r.partial
+        assert gov._budget_exceeded.value > before
+        assert gov.default_budget().max_samples_scanned == 50
+        gov.configure(max_samples_scanned=0)
+        assert gov.default_budget() is None
+
+    def test_result_bytes_budget_truncates(self, svc):
+        full = svc.query_range("heap_usage", QS, STEP, QE)
+        assert full.result.num_series == 10
+        limit = int(full.result.values.nbytes * 0.4)
+        r = svc.query_range("heap_usage", QS, STEP, QE,
+                            self._qc(max_result_bytes=limit))
+        assert r.partial
+        assert 0 < r.result.num_series < 10
+        # what survives is real data: a subset of the full answer
+        assert set(r.result.keys) <= set(full.result.keys)
+
+    def test_group_cardinality_budget(self, svc):
+        svc.planner.agg_pushdown = "off"  # root-side map/reduce path
+        try:
+            full = svc.query_range("sum(heap_usage) by (host)",
+                                   QS, STEP, QE)
+            assert full.result.num_series > 3
+            r = svc.query_range("sum(heap_usage) by (host)", QS, STEP, QE,
+                                self._qc(max_group_cardinality=3))
+            assert r.partial
+            assert 0 < r.result.num_series <= 3
+            assert set(r.result.keys) <= set(full.result.keys)
+        finally:
+            svc.planner.agg_pushdown = "auto"
+
+
+# ---------------------------------------------------------------------------
+# budgets over the wire: remote leaves degrade exactly like local ones
+
+
+class TestRemoteBudgetEquivalence:
+    def test_budget_partial_same_local_and_remote(self, store):
+        reset_breakers()
+        reset_pool()
+        srv = PlanExecutorServer(store).start()
+        try:
+            disp = RemotePlanDispatcher("127.0.0.1", srv.port)
+            local = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+            remote = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+            local.result_cache = remote.result_cache = None
+            remote.planner.dispatcher_for_shard = lambda s: disp
+
+            def qc():
+                c = QueryContext()
+                c.planner_params.budget = gov.QueryBudget(
+                    max_samples_scanned=40)
+                return c
+
+            a = local.query_range("heap_usage", QS, STEP, QE, qc())
+            b = remote.query_range("heap_usage", QS, STEP, QE, qc())
+            assert a.partial and b.partial
+            assert any("budget" in w for w in a.warnings)
+            # the budget rode PlannerParams over the wire: remote leaves
+            # breach at the same leaf-local counts, so the flagged result
+            # is indistinguishable from the in-process one
+            assert set(a.warnings) == set(b.warnings)
+            assert_equivalent(a, b)
+        finally:
+            srv.stop()
+            reset_pool()
+
+
+# ---------------------------------------------------------------------------
+# HTTP encoding: 503 + Retry-After with distinct errorTypes, both fronts
+
+
+class _RaisingSvc:
+    def __init__(self, exc):
+        self.exc = exc
+
+    def query_range(self, *a, **k):
+        raise self.exc
+
+
+class _FakeApp:
+    def __init__(self, svc):
+        self.services = {"timeseries": svc}
+        self.response_cache = None
+        self.shard_maps = {}
+        self.cluster = None
+
+    def batched(self, svc):
+        return svc
+
+
+RANGE_URL = ("/promql/timeseries/api/v1/query_range?"
+             "query=up&start=0&end=100&step=10")
+
+
+class TestHttpOverloadEncoding:
+    def _handle(self, exc):
+        from filodb_tpu.http.server import HttpDispatcher
+        return HttpDispatcher(_FakeApp(_RaisingSvc(exc))).handle(
+            "GET", RANGE_URL)
+
+    def test_rejected_is_503_unavailable_with_retry_after(self):
+        code, headers, body = self._handle(
+            gov.QueryRejected("shed", retry_after_s=2.4))
+        assert code == 503
+        assert headers["Retry-After"] == "2"
+        assert json.loads(body)["errorType"] == "unavailable"
+
+    def test_deadline_is_503_timeout(self):
+        from filodb_tpu.utils.resilience import DeadlineExceeded
+        code, headers, body = self._handle(DeadlineExceeded("too slow"))
+        assert code == 503
+        assert "Retry-After" in headers
+        assert json.loads(body)["errorType"] == "timeout"
+
+    def test_retry_after_rounding_and_default(self):
+        from filodb_tpu.http.server import retry_after_headers
+        assert retry_after_headers(0.2) == {"Retry-After": "1"}  # floor 1s
+        assert retry_after_headers(7.6) == {"Retry-After": "8"}
+        gov.configure(retry_after_s=3.0)
+        assert retry_after_headers() == {"Retry-After": "3"}
+
+    def _fast_single(self, exc):
+        from filodb_tpu.http.fastserver import FastHttpServer, _HotReq
+        fs = FastHttpServer.__new__(FastHttpServer)  # encoder only, no IO
+        req = _HotReq(None, 0, _RaisingSvc(exc), "range", ("up", 0, 10, 100))
+        return fs._run_single(req)
+
+    def test_fastserver_rejected_is_503_unavailable(self):
+        code, headers, body = self._fast_single(
+            gov.QueryRejected("shed", retry_after_s=5.0))
+        assert code == 503
+        assert headers["Retry-After"] == "5"
+        assert json.loads(body)["errorType"] == "unavailable"
+
+    def test_fastserver_deadline_is_503_timeout(self):
+        from filodb_tpu.utils.resilience import DeadlineExceeded
+        code, headers, body = self._fast_single(DeadlineExceeded("too slow"))
+        assert code == 503
+        assert "Retry-After" in headers
+        assert json.loads(body)["errorType"] == "timeout"
+
+    def test_fastserver_knows_shed_status_lines(self):
+        from filodb_tpu.http.fastserver import _STATUS
+        assert 429 in _STATUS and 503 in _STATUS
+
+
+# ---------------------------------------------------------------------------
+# gateway ingest shedding under CRITICAL
+
+
+class TestGatewayShedding:
+    def _records(self, n, tag="h"):
+        from filodb_tpu.gateway.influx import parse_influx_line
+        recs = []
+        for i in range(n):
+            recs.extend(parse_influx_line(
+                f"heap_usage,host={tag}{i} value=1.0",
+                {"_ws_": "demo", "_ns_": "App-0"}, now_ms=START * 1000))
+        return recs
+
+    def test_critical_sheds_instead_of_blocking(self):
+        from filodb_tpu.gateway import server as gw
+        sink = gw.ContainerSink({}, num_shards=1, spread=0,
+                                flush_every=4, max_pending=4)
+        for r in self._records(4):  # buffer at the brim...
+            sink._pending.add(r)
+        sink._flushing = True       # ...with a drain pinned in flight
+        gov.governor().set_state(gov.CRITICAL)
+        before = gw.records_shed.value
+        t0 = time.perf_counter()
+        sink.add(self._records(2, tag="x"))
+        assert time.perf_counter() - t0 < 1.0  # shed, not the 5s block
+        assert gw.records_shed.value == before + 2
+
+    def test_queue_depth_gauge_renders(self):
+        from filodb_tpu.gateway import server as gw
+        from filodb_tpu.utils.metrics import render_prometheus
+        sink = gw.ContainerSink({}, num_shards=1, spread=0)
+        for r in self._records(3):
+            sink._pending.add(r)
+        text = render_prometheus()
+        assert "gateway_queue_depth 3" in text
+
+
+# ---------------------------------------------------------------------------
+# cardinality quota end-to-end: routed ingest past the quota error
+
+
+class TestCardinalityQuotaEndToEnd:
+    def test_routed_ingest_past_quota(self):
+        n_shards = 2
+        ms = TimeSeriesMemStore()
+        for s in range(n_shards):
+            sh = ms.setup("quota_ds", s, StoreConfig(max_chunk_size=50))
+            sh.cardinality.set_quota(["demo", "App-0"], 2)
+        hot = machine_metrics_series(8, metric="hot_metric")  # ns App-0
+        ok = machine_metrics_series(4, metric="ok_metric", ns="App-1")
+        ingest_routed(ms, "quota_ds",
+                      gauge_stream(hot + ok, 30, start_ms=START * 1000,
+                                   interval_ms=10_000, seed=3),
+                      n_shards, spread=0)
+
+        shards = ms.shards_for("quota_ds")
+        app0 = sum(sh.cardinality.cardinality(["demo", "App-0"]).active_ts
+                   for sh in shards)
+        app1 = sum(sh.cardinality.cardinality(["demo", "App-1"]).active_ts
+                   for sh in shards)
+        dropped = sum(sh.stats.quota_dropped.value for sh in shards)
+        assert app0 <= 2 * n_shards < 8  # offending namespace is capped
+        assert app1 == 4                 # neighbours are untouched
+        assert dropped > 0               # every rejection is counted
+
+        # ingestion continued past the quota errors: admitted series are
+        # fully queryable end to end
+        svc = QueryService(ms, "quota_ds", n_shards, spread=0)
+        r = svc.query_range("ok_metric", START + 100, 60, START + 280)
+        assert r.result.num_series == 4
+        hot_r = svc.query_range("hot_metric", START + 100, 60, START + 280)
+        assert 0 < hot_r.result.num_series <= 2 * n_shards

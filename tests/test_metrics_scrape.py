@@ -98,6 +98,24 @@ DIST_AGG_NAMES = [
     "filodb_wire_compress_bytes_out_total",
 ]
 
+# overload protection (utils/governor.py, gateway/server.py) — gauges and
+# counters pre-registered at import so families render before any shed
+GOVERNOR_NAMES = [
+    "filodb_governor_state",
+    "filodb_governor_inflight",
+    "filodb_governor_queue_depth",
+    "filodb_governor_memory_utilization",
+    "filodb_governor_admitted_total",
+    "filodb_governor_rejected_total",
+    "filodb_governor_transitions_total",
+    "filodb_governor_budget_exceeded_total",
+    "filodb_governor_queue_wait_seconds_bucket",
+    "filodb_governor_queue_wait_seconds_count",
+    "filodb_governor_queue_wait_seconds_sum",
+    "gateway_queue_depth",
+    "gateway_records_shed_total",
+]
+
 
 def _free_port():
     with socket.socket() as s:
@@ -182,6 +200,11 @@ class TestMetricsScrape:
         missing_da = [n for n in DIST_AGG_NAMES if n not in names_present]
         assert not missing_da, f"missing dist-agg metrics: {missing_da}"
 
+        # governor + gateway overload families are exposed, and the range
+        # query above passed the admission gate so admissions moved
+        missing_gov = [n for n in GOVERNOR_NAMES if n not in names_present]
+        assert not missing_gov, f"missing governor metrics: {missing_gov}"
+
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])
                        for line in text.splitlines()
@@ -190,6 +213,8 @@ class TestMetricsScrape:
 
         assert total("filodb_result_cache_hits_total") \
             + total("filodb_result_cache_misses_total") >= 1
+
+        assert total("filodb_governor_admitted_total") >= 1
 
         # per-shard tagging: both shards of THIS dataset expose the
         # counter (the registry is process-wide; other tests' datasets may
