@@ -117,7 +117,15 @@ BIG_QUERY = 'sum(rate(big_counter[10m]))'
 BIG_RANGE_SEC = 3 * 3600  # ~9.3M samples scanned per query
 
 
-def build_service(engine: str = "adaptive"):
+def config_default_engine() -> str:
+    """The engine a default-config server actually ships with — the bench
+    must measure the shape users get, not a hand-picked one."""
+    from filodb_tpu.config import DEFAULTS
+    return DEFAULTS["datasets"]["timeseries"].get("engine", "mesh")
+
+
+def build_service(engine: str | None = None):
+    engine = engine or config_default_engine()
     from filodb_tpu.coordinator.ingestion import ingest_routed
     from filodb_tpu.coordinator.query_service import QueryService
     from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
@@ -243,7 +251,8 @@ def measure_big_scan():
     start_sec = START_SEC + 3600
     end_sec = start_sec + BIG_RANGE_SEC
     eng = svc.mesh_engine
-    out = {"series": BIG_SERIES,
+    out = {"engine": "adaptive",  # explicit: this IS the lane comparison
+           "series": BIG_SERIES,
            "samples_per_query_approx":
                BIG_SERIES * (BIG_RANGE_SEC + 600) // 10}
     plan = svc._parse_cached(BIG_QUERY, TimeStepParams(
@@ -438,7 +447,9 @@ def main():
     micro = kernel_microbench(platform)
     sys.stderr.write(f"kernel microbench: {json.dumps(micro)}\n")
 
-    svc, _ = build_service("adaptive")
+    engine = config_default_engine()
+    sys.stderr.write(f"bench engine (config default): {engine}\n")
+    svc, _ = build_service(engine)
     start_sec = START_SEC + 1800
     end_sec = START_SEC + 1800 + 30 * 60  # 30-min range, 31 steps
 
@@ -480,6 +491,7 @@ def main():
         "metric": "promql_sum_rate_range_query_throughput",
         "value": round(qps, 2),
         "unit": "queries/sec",
+        "engine": engine,
         # headline comparison first: measured qps against the reasoned
         # JVM-engine estimate band for this exact workload
         "vs_reference_estimate": [round(qps / ref_hi, 2),

@@ -1,0 +1,86 @@
+"""Object-store durable tier benchmark: write-behind flush throughput and
+full vs split scan over the segment layout (fake S3, in-memory).
+
+Measures the costs the Cassandra tier's JMH suite would — segment encode +
+upload on the write side, ranged-GET read-back and key-prefix split scans
+(the token-range analog used by downsample/repair fan-out) on the read side.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+START = 1_600_000_000
+
+
+def bench_objectstore(n_series: int = 200, chunks_per_series: int = 5,
+                      rows_per_chunk: int = 400, n_splits: int = 4):
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.store.api import PartKeyRecord
+    from filodb_tpu.core.store.objectstore import ObjectStoreColumnStore
+    from filodb_tpu.memory.chunk import Chunk
+    from filodb_tpu.testing.fake_s3 import FakeS3
+
+    s3 = FakeS3()
+    cs = ObjectStoreColumnStore(s3, segment_target_bytes=256 * 1024)
+    pks = [PartKey.create("gauge", {"_metric_": "bench_os", "_ws_": "demo",
+                                    "_ns_": f"app-{i}"})
+           for i in range(n_series)]
+    rows_ms = rows_per_chunk * 1000
+
+    def mk_chunk(cid, t0):
+        ts = np.arange(t0, t0 + rows_ms, 1000, dtype=np.int64)
+        vals = np.sin(ts / 7e4)
+        return Chunk(cid, rows_per_chunk, int(ts[0]), int(ts[-1]),
+                     [ts.tobytes(), vals.tobytes()])
+
+    total_rows = n_series * chunks_per_series * rows_per_chunk
+    t0 = time.perf_counter()
+    for i, pk in enumerate(pks):
+        cs.write_chunks("bench", 0, pk,
+                        [mk_chunk(c + 1, START * 1000 + c * rows_ms)
+                         for c in range(chunks_per_series)],
+                        ingestion_time=i)
+    cs.write_part_keys("bench", 0,
+                       [PartKeyRecord(pk, START * 1000, 2**62) for pk in pks])
+    cs.flush()   # barrier: segments + manifest durable on the fake S3
+    write_dt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    read_rows = 0
+    for pk in pks:
+        for ch in cs.read_chunks("bench", 0, pk, 0, 2**62):
+            read_rows += ch.num_rows
+    read_dt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    full = sum(1 for _ in cs.scan_chunks_by_ingestion_time(
+        "bench", 0, 0, 2**62))
+    full_dt = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    split_total = 0
+    for s in range(n_splits):
+        split_total += sum(1 for _ in cs.scan_chunks_by_ingestion_time_split(
+            "bench", 0, 0, 2**62, s, n_splits))
+    split_dt = time.perf_counter() - t0
+    assert split_total == full == n_series
+    cs.close()
+
+    return {"metric": "objectstore_flush_throughput",
+            "value": round(total_rows / write_dt),
+            "unit": "rows/sec",
+            "read_rows_per_sec": round(read_rows / read_dt),
+            "scan_full_ms": round(full_dt * 1000, 2),
+            "scan_split_ms": round(split_dt * 1000, 2),
+            "n_splits": n_splits,
+            "segments": sum(1 for k in s3.list_objects("")
+                            if k.endswith(".seg")),
+            "s3_bytes": s3.total_bytes()}
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(bench_objectstore()))

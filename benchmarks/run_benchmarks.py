@@ -456,6 +456,12 @@ def _bench_dist_agg():
     return bench_dist_agg()
 
 
+def _bench_objectstore():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from objectstore import bench_objectstore
+    return bench_objectstore()
+
+
 def _bench_overload():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from overload import bench_overload
@@ -477,6 +483,7 @@ ALL = {
     "mesh_churn": bench_mesh_churn,
     "dist_agg": _bench_dist_agg,
     "overload": _bench_overload,
+    "objectstore": _bench_objectstore,
 }
 
 

@@ -18,28 +18,42 @@ import sys
 import numpy as np
 
 
-def _open_stores(data_dir: str):
+def _open_stores(args):
+    """Open the configured ColumnStore backend (embedded mode).
+
+    ``--store local`` (default) opens the sqlite tier under
+    ``data_dir/columnstore``; ``--store object`` opens the S3-compatible
+    segment tier (``--endpoint`` http(s)://… for a real service, else a
+    directory-backed fake under ``data_dir/objectstore``)."""
     import os
 
     from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
-    from filodb_tpu.core.store.localstore import (
-        LocalDiskColumnStore,
-        LocalDiskMetaStore,
-    )
-    root = os.path.join(data_dir, "columnstore")
-    cs = LocalDiskColumnStore(root)
-    meta = LocalDiskMetaStore(root)
+    data_dir = args if isinstance(args, str) else args.data_dir
+    backend = "local" if isinstance(args, str) else args.store
+    if backend == "object":
+        from filodb_tpu.core.store.objectstore import open_object_store
+        cs, meta = open_object_store(
+            {"endpoint": getattr(args, "endpoint", None),
+             "bucket": getattr(args, "bucket", "filodb")}, data_dir)
+    else:
+        from filodb_tpu.core.store.localstore import (
+            LocalDiskColumnStore,
+            LocalDiskMetaStore,
+        )
+        root = os.path.join(data_dir, "columnstore")
+        cs = LocalDiskColumnStore(root)
+        meta = LocalDiskMetaStore(root)
     return cs, meta, TimeSeriesMemStore(cs, meta)
 
 
 def cmd_init(args):
-    cs, _, _ = _open_stores(args.data_dir)
+    cs, _, _ = _open_stores(args)
     cs.initialize(args.dataset, args.num_shards)
     print(f"initialized dataset {args.dataset} with {args.num_shards} shards")
 
 
 def cmd_list(args):
-    cs, _, _ = _open_stores(args.data_dir)
+    cs, _, _ = _open_stores(args)
     total = 0
     for shard in range(args.num_shards):
         recs = cs.scan_part_keys(args.dataset, shard)
@@ -58,7 +72,7 @@ def cmd_status(args):
 
 
 def cmd_indexnames(args):
-    cs, meta, ms = _open_stores(args.data_dir)
+    cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
     names = set()
     for shard in range(args.num_shards):
@@ -69,7 +83,7 @@ def cmd_indexnames(args):
 
 
 def cmd_labelvalues(args):
-    cs, meta, ms = _open_stores(args.data_dir)
+    cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
     vals = set()
     for shard in range(args.num_shards):
@@ -86,7 +100,7 @@ def cmd_importcsv(args):
     from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
     from filodb_tpu.core.store.config import StoreConfig
 
-    cs, meta, ms = _open_stores(args.data_dir)
+    cs, meta, ms = _open_stores(args)
     for shard in range(args.num_shards):
         s = ms.setup(args.dataset, shard, StoreConfig())
         s.recover_index()
@@ -114,6 +128,9 @@ def cmd_importcsv(args):
                       args.num_shards, args.spread)
     for s in ms.shards_for(args.dataset):
         s.flush_all()
+    # drain write-behind uploads (object store) before the process exits
+    cs.close()
+    meta.close()
     print(f"imported {n} samples")
 
 
@@ -133,7 +150,7 @@ def cmd_promql(args):
     from filodb_tpu.core.store.config import StoreConfig
     from filodb_tpu.http.promjson import matrix_json
 
-    cs, meta, ms = _open_stores(args.data_dir)
+    cs, meta, ms = _open_stores(args)
     for shard in range(args.num_shards):
         s = ms.setup(args.dataset, shard, StoreConfig())
         s.recover_index()
@@ -163,7 +180,7 @@ def cmd_topkcard(args):
     counts persisted part keys grouped by the next shard-key level."""
     from collections import Counter
 
-    cs, _, _ = _open_stores(args.data_dir)
+    cs, _, _ = _open_stores(args)
     prefix = [p for p in (args.prefix or "").split("/") if p]
     labels = ("_ws_", "_ns_", "_metric_")
     counts = Counter()
@@ -182,7 +199,7 @@ def cmd_topkcard(args):
 def cmd_decode_chunk(args):
     """Debug: decode and dump a partition's chunk info + samples (reference
     ``decodeChunkInfo`` / ``decodeVector`` commands)."""
-    cs, meta, ms = _open_stores(args.data_dir)
+    cs, meta, ms = _open_stores(args)
     from filodb_tpu.memory.codecs import HistogramColumn
     for shard in range(args.num_shards):
         for rec in cs.scan_part_keys(args.dataset, shard):
@@ -212,6 +229,80 @@ def cmd_decode_chunk(args):
                                   f"vals[:5]={np.asarray(vals)[:5]}")
 
 
+def cmd_promfilter_to_partkey(args):
+    """Forensics: turn a PromQL series selector into the part-key bytes the
+    ingestion path would produce (reference ``CliMain.scala:100-108``
+    ``promFilterToPartKeyBR``), plus its hashes and owning shard.  With
+    ``--lookup``, scans the opened ColumnStore (any backend, including the
+    object store) for persisted part keys matching the filter."""
+    from filodb_tpu.core.partkey import METRIC_LABEL, PartKey, ingestion_shard
+    from filodb_tpu.promql.parser import TimeStepParams, parse_query
+
+    plan = parse_query(args.promfilter, TimeStepParams(0, 60, 0))
+    raw = plan
+    while not hasattr(raw, "filters"):
+        raw = raw.raw
+    labels = {}
+    for f in raw.filters:
+        cond = f.filter
+        if type(cond).__name__ != "Equals":
+            print(f"error: only equality filters map to a part key "
+                  f"(got {type(cond).__name__} on {f.column})",
+                  file=sys.stderr)
+            return 1
+        labels[f.column] = cond.value
+    if METRIC_LABEL not in labels:
+        print("error: selector needs a metric name", file=sys.stderr)
+        return 1
+    pk = PartKey.create(args.schema, labels)
+    skh = pk.shard_key_hash(("_ws_", "_ns_", METRIC_LABEL))
+    shard = ingestion_shard(skh, pk.part_hash, args.num_shards, args.spread)
+    print(f"partKey      {pk}")
+    print(f"schema       {pk.schema}")
+    print(f"bytes (hex)  {pk.serialized.hex()}")
+    print(f"partHash     {pk.part_hash:#010x}")
+    print(f"shardKeyHash {skh:#010x}")
+    print(f"shard        {shard}  (numShards={args.num_shards} "
+          f"spread={args.spread})")
+    if args.lookup:
+        cs, _, _ = _open_stores(args)
+        want = set(labels.items())
+        hits = 0
+        for sh in range(args.num_shards):
+            for rec in cs.scan_part_keys(args.dataset, sh):
+                if want <= set(rec.part_key.labels):
+                    hits += 1
+                    print(f"  persisted shard={sh} {rec.part_key} "
+                          f"[{rec.start_time}, {rec.end_time}]")
+        print(f"  {hits} persisted partition(s) match")
+    return 0
+
+
+def cmd_partkey_as_string(args):
+    """Forensics: decode serialized part-key bytes (hex) back to a readable
+    key (reference ``CliMain.scala:110-115`` ``partKeyBrAsString``)."""
+    from filodb_tpu.core.partkey import METRIC_LABEL, ingestion_shard
+    from filodb_tpu.core.store.localstore import _pk_from_blob
+
+    try:
+        blob = bytes.fromhex(args.hexkey.strip().removeprefix("0x"))
+        pk = _pk_from_blob(blob)
+    except ValueError as e:
+        print(f"error: not a valid part-key blob: {e}", file=sys.stderr)
+        return 1
+    skh = pk.shard_key_hash(("_ws_", "_ns_", METRIC_LABEL))
+    print(f"partKey      {pk}")
+    print(f"schema       {pk.schema}")
+    for k, v in pk.labels:
+        print(f"  {k} = {v}")
+    print(f"partHash     {pk.part_hash:#010x}")
+    print(f"shardKeyHash {skh:#010x}")
+    print(f"shard        "
+          f"{ingestion_shard(skh, pk.part_hash, args.num_shards, args.spread)}"
+          f"  (numShards={args.num_shards} spread={args.spread})")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="filo-cli")
     ap.add_argument("--data-dir", default="./filodb-data")
@@ -220,6 +311,12 @@ def main(argv=None):
     ap.add_argument("--spread", type=int, default=1)
     ap.add_argument("--host", default=None,
                     help="host:port of a running server (remote mode)")
+    ap.add_argument("--store", choices=("local", "object"), default="local",
+                    help="ColumnStore backend to open in embedded mode")
+    ap.add_argument("--endpoint", default=None,
+                    help="object-store endpoint (http(s)://… for S3, "
+                         "else a local directory)")
+    ap.add_argument("--bucket", default="filodb")
     sub = ap.add_subparsers(dest="command", required=True)
 
     sub.add_parser("init")
@@ -245,14 +342,23 @@ def main(argv=None):
     p.add_argument("--prefix", default="", help="ws or ws/ns")
     p.add_argument("-k", type=int, default=10)
     sub.add_parser("validate")
+    p = sub.add_parser("promfilter-to-partkey")
+    p.add_argument("promfilter", help='e.g. \'heap_usage{_ws_="demo"}\'')
+    p.add_argument("--schema", default="gauge")
+    p.add_argument("--lookup", action="store_true",
+                   help="scan the store for matching persisted part keys")
+    p = sub.add_parser("partkey-as-string")
+    p.add_argument("hexkey", help="serialized part-key bytes, hex")
 
     args = ap.parse_args(argv)
-    {"init": cmd_init, "list": cmd_list, "status": cmd_status,
-     "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
-     "importcsv": cmd_importcsv, "promql": cmd_promql,
-     "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,
-     "validate": cmd_validate,
-     }[args.command](args)
+    return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
+            "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
+            "importcsv": cmd_importcsv, "promql": cmd_promql,
+            "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,
+            "validate": cmd_validate,
+            "promfilter-to-partkey": cmd_promfilter_to_partkey,
+            "partkey-as-string": cmd_partkey_as_string,
+            }[args.command](args)
 
 
 if __name__ == "__main__":

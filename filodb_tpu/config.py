@@ -68,6 +68,25 @@ DEFAULTS = {
         "max_group_cardinality": 0,
         "budget_degrade": "partial",  # "partial" | "error"
     },
+    # durable-store backend selection. "local" = sqlite-per-shard on
+    # data_dir (default); "object" = S3-compatible object-store tier
+    # (core/store/objectstore.py): write-behind segment upload, CRC32C
+    # tripwires, key-prefix split scans. With backend="object" and no
+    # endpoint, a directory-backed in-process fake under data_dir is used
+    # (hermetic dev/test); "http(s)://host:port" targets a real
+    # S3-compatible service (minio etc.).
+    "store": {
+        "backend": "local",
+        "endpoint": None,
+        "bucket": "filodb",
+        "prefix": "",
+        "access_key": None,
+        "secret_key": None,
+        "region": "us-east-1",
+        "upload_queue_depth": 64,        # bounded write-behind queue
+        "segment_target_bytes": 1 << 20,  # seal open segments at this size
+        "bucket_count": 8,               # key-prefix split-scan fan-out
+    },
     "datasets": {
         "timeseries": {
             "num_shards": 4,
@@ -118,6 +137,7 @@ class ServerConfig:
     resilience: dict = field(default_factory=dict)  # ResilienceConfig overrides
     result_cache: dict = field(default_factory=dict)  # ResultCacheConfig block
     governor: dict = field(default_factory=dict)  # GovernorConfig overrides
+    store: dict = field(default_factory=dict)  # durable-store backend block
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -161,7 +181,8 @@ class ServerConfig:
             datasets=datasets, spreads=spreads, downsample=downsample,
             engines=engines, resilience=cfg.get("resilience", {}),
             result_cache=cfg.get("result_cache", {}),
-            governor=cfg.get("governor", {}))
+            governor=cfg.get("governor", {}),
+            store=cfg.get("store", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -66,6 +66,23 @@ class ColumnStore:
         — the downsampler's scan (reference ``IngestionTimeIndexTable``)."""
         raise NotImplementedError
 
+    def scan_chunks_by_ingestion_time_split(self, dataset: str, shard: int,
+                                            start: int, end: int, split: int,
+                                            n_splits: int):
+        """One token-range split of the ingestion-time scan — the fan-out
+        unit for downsample/repair jobs.  Default: hash-filter over the
+        full scan; the object store restricts to key-prefix buckets."""
+        if n_splits <= 1:
+            yield from self.scan_chunks_by_ingestion_time(dataset, shard,
+                                                          start, end)
+            return
+        from filodb_tpu.core.store.remotestore import split_of
+        from filodb_tpu.core.store.localstore import _pk_blob
+        for pk, chunks in self.scan_chunks_by_ingestion_time(
+                dataset, shard, start, end):
+            if split_of(_pk_blob(pk), n_splits) == split:
+                yield pk, chunks
+
     def truncate(self, dataset: str) -> None:
         raise NotImplementedError
 

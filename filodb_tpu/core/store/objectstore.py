@@ -1,0 +1,1164 @@
+"""Object-store (S3-compatible) durable tier: segment objects + manifest.
+
+The reference's durability pillar is a real distributed store
+(``CassandraColumnStore.scala:52``) with token-range split scans; this
+module is that tier on S3-compatible object storage.  Everything the
+4-table API stores is batched into immutable, append-only **segment
+objects**:
+
+    {prefix}/{dataset}/shard-{N}/b{BB}/seg-{SEQ:08d}.seg   data segments
+    {prefix}/{dataset}/shard-{N}/manifest.json             live-segment list
+    {prefix}/{dataset}/shard-{N}/checkpoints.json          meta checkpoints
+    {prefix}/{dataset}/shard-{N}/index.snap                index snapshot
+
+``BB`` is the part key's **bucket** — ``crc32(pk_blob) % bucket_count``,
+the same hash family as ``split_of`` (remotestore.py), so bucket ``b``
+serves token-range split ``b % n_splits`` whenever ``n_splits`` divides
+``bucket_count``: split scans become key-prefix scans, the object-store
+analog of Cassandra token ranges, and offline jobs (downsampler, repair)
+can open a split-restricted view that never even GETs the other buckets.
+
+Durability model — **write-behind with checkpoint ordering**:
+``write_chunks``/``write_part_keys`` append to an in-memory open segment
+per bucket (read-your-writes via the in-memory index); segments seal at
+``segment_target_bytes`` or at a checkpoint barrier and are enqueued on
+ONE bounded FIFO shared with the meta store.  ``write_checkpoint`` seals
+the shard's open segments and enqueues the checkpoint object *behind*
+them, so a checkpoint can never become visible remotely before the data
+it covers: a crash mid-upload leaves the checkpoint missing and WAL
+replay re-covers the gap — an acked flush is never lost.  The uploader
+retries transient faults with ``RetryPolicy`` backoff forever (puts are
+idempotent: segment keys are unique per seq) and uses multipart for
+large segments.
+
+Integrity tripwires: every segment carries a CRC32C (Castagnoli) footer
+verified on full reads (recovery, compaction), and every chunk entry
+carries its own CRC32C verified on ranged reads — a flipped byte raises
+:class:`CorruptSegmentError` and bumps ``filodb_objectstore_corrupt_total``
+instead of returning silent garbage.
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import json
+import queue
+import struct
+import threading
+
+from filodb_tpu.core.partkey import PartKey
+from filodb_tpu.core.store.api import (ColumnStore, MetaStore, PartKeyRecord)
+from filodb_tpu.core.store.localstore import _pk_blob, _pk_from_blob
+from filodb_tpu.core.store.remotestore import split_of
+from filodb_tpu.memory.chunk import Chunk
+from filodb_tpu.utils.metrics import Counter, Gauge
+from filodb_tpu.utils.resilience import FaultInjector, RetryPolicy
+from filodb_tpu.utils.tracing import span
+
+# --------------------------------------------------------------------------
+# CRC32C (Castagnoli, poly 0x1EDC6F41 reflected = 0x82F63B78).  Not in the
+# Python stdlib (zlib.crc32 is CRC32/IEEE); slice-by-8 table implementation.
+
+_CRC32C_POLY = 0x82F63B78
+
+
+def _make_tables():
+    t0 = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _CRC32C_POLY if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for t in range(1, 8):
+        prev = tables[t - 1]
+        tables.append([(prev[i] >> 8) ^ t0[prev[i] & 0xFF]
+                       for i in range(256)])
+    return tables
+
+
+_T = _make_tables()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    t0, t1, t2, t3, t4, t5, t6, t7 = _T
+    crc ^= 0xFFFFFFFF
+    view = memoryview(data)
+    n = len(view) - len(view) % 8
+    i = 0
+    while i < n:
+        crc ^= view[i] | view[i + 1] << 8 | view[i + 2] << 16 \
+            | view[i + 3] << 24
+        crc = (t7[crc & 0xFF] ^ t6[(crc >> 8) & 0xFF]
+               ^ t5[(crc >> 16) & 0xFF] ^ t4[crc >> 24]
+               ^ t3[view[i + 4]] ^ t2[view[i + 5]]
+               ^ t1[view[i + 6]] ^ t0[view[i + 7]])
+        i += 8
+    for b in view[n:]:
+        crc = t0[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# --------------------------------------------------------------------------
+# errors + metrics
+
+class CorruptSegmentError(Exception):
+    """A segment (or chunk entry) failed its CRC32C check — the store
+    refuses to return the bytes rather than serve silent garbage."""
+
+
+class ObjectStoreError(Exception):
+    """Non-transient object-store failure surfaced to the caller."""
+
+
+PUTS = Counter("filodb_objectstore_puts")
+GETS = Counter("filodb_objectstore_gets")
+BYTES_UP = Counter("filodb_objectstore_bytes_up")
+BYTES_DOWN = Counter("filodb_objectstore_bytes_down")
+RETRIES = Counter("filodb_objectstore_retries")
+COMPACTIONS = Counter("filodb_objectstore_compactions")
+CORRUPT = Counter("filodb_objectstore_corrupt")
+QUEUE_DEPTH = Gauge("filodb_objectstore_queue_depth")
+
+# --------------------------------------------------------------------------
+# segment binary format
+
+_MAGIC = b"FSG1"
+_FOOTER = struct.Struct("<BII")       # 0xFE, entry_count, crc32c(body)
+_FOOTER_MARK = 0xFE
+_E_CHUNK, _E_PARTKEY, _E_DELETE = 1, 2, 3
+_CHUNK_HDR = struct.Struct("<qqqqqI")  # id, start, end, itime, upd, dlen
+_PK_HDR = struct.Struct("<qqq")        # start, end, upd
+
+
+class _ChunkRef:
+    """In-memory index entry for one stored chunk payload."""
+    __slots__ = ("chunk_id", "start_time", "end_time", "ingestion_time",
+                 "upd", "seq", "offset", "length", "crc")
+
+    def __init__(self, chunk_id, start_time, end_time, ingestion_time,
+                 upd, seq, offset, length, crc):
+        self.chunk_id = chunk_id
+        self.start_time = start_time
+        self.end_time = end_time
+        self.ingestion_time = ingestion_time
+        self.upd = upd
+        self.seq = seq          # segment sequence number
+        self.offset = offset    # byte offset of the chunk payload
+        self.length = length    # payload length
+        self.crc = crc          # crc32c of the payload
+
+
+class _OpenSegment:
+    """Append-only in-memory segment being built for one bucket."""
+
+    def __init__(self, seq: int, bucket: int):
+        self.seq = seq
+        self.bucket = bucket
+        self.buf = io.BytesIO()
+        self.buf.write(_MAGIC)
+        self.entries = 0
+        self.max_upd = 0
+
+    def size(self) -> int:
+        return self.buf.tell()
+
+    def add_chunk(self, pk_blob: bytes, ch: Chunk, ingestion_time: int,
+                  upd: int) -> tuple[int, int, int]:
+        """Append a chunk entry; returns (payload_offset, length, crc)."""
+        data = ch.serialize()
+        crc = crc32c(data)
+        b = self.buf
+        b.write(struct.pack("<BI", _E_CHUNK, len(pk_blob)))
+        b.write(pk_blob)
+        b.write(_CHUNK_HDR.pack(ch.id, ch.start_time, ch.end_time,
+                                ingestion_time, upd, len(data)))
+        off = b.tell()
+        b.write(data)
+        b.write(struct.pack("<I", crc))
+        self.entries += 1
+        self.max_upd = max(self.max_upd, upd)
+        return off, len(data), crc
+
+    def add_part_key(self, pk_blob: bytes, start: int, end: int,
+                     upd: int) -> None:
+        b = self.buf
+        b.write(struct.pack("<BI", _E_PARTKEY, len(pk_blob)))
+        b.write(pk_blob)
+        b.write(_PK_HDR.pack(start, end, upd))
+        self.entries += 1
+        self.max_upd = max(self.max_upd, upd)
+
+    def add_delete(self, pk_blob: bytes) -> None:
+        b = self.buf
+        b.write(struct.pack("<BI", _E_DELETE, len(pk_blob)))
+        b.write(pk_blob)
+        self.entries += 1
+
+    def finish(self) -> bytes:
+        body = self.buf.getvalue()
+        return body + _FOOTER.pack(_FOOTER_MARK, self.entries, crc32c(body))
+
+
+def parse_segment(data: bytes, key: str = "?"):
+    """Verify the footer CRC and yield entries:
+    ``("chunk", pk_blob, id, start, end, itime, upd, payload_off, length,
+    crc, payload)`` / ``("partkey", pk_blob, start, end, upd)`` /
+    ``("delete", pk_blob)``.  Raises :class:`CorruptSegmentError` on any
+    mismatch."""
+    if len(data) < len(_MAGIC) + _FOOTER.size or data[:4] != _MAGIC:
+        CORRUPT.inc()
+        raise CorruptSegmentError(f"{key}: bad magic/size")
+    mark, count, crc = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
+    body = data[:len(data) - _FOOTER.size]
+    if mark != _FOOTER_MARK or crc32c(body) != crc:
+        CORRUPT.inc()
+        raise CorruptSegmentError(f"{key}: footer CRC32C mismatch")
+    pos, seen = 4, 0
+    out = []
+    try:
+        while pos < len(body):
+            etype, pk_len = struct.unpack_from("<BI", body, pos)
+            pos += 5
+            pk_blob = bytes(body[pos:pos + pk_len])
+            pos += pk_len
+            if etype == _E_CHUNK:
+                cid, st, et, itime, upd, dlen = _CHUNK_HDR.unpack_from(
+                    body, pos)
+                pos += _CHUNK_HDR.size
+                payload = bytes(body[pos:pos + dlen])
+                off = pos
+                pos += dlen
+                (ecrc,) = struct.unpack_from("<I", body, pos)
+                pos += 4
+                out.append(("chunk", pk_blob, cid, st, et, itime, upd,
+                            off, dlen, ecrc, payload))
+            elif etype == _E_PARTKEY:
+                st, et, upd = _PK_HDR.unpack_from(body, pos)
+                pos += _PK_HDR.size
+                out.append(("partkey", pk_blob, st, et, upd))
+            elif etype == _E_DELETE:
+                out.append(("delete", pk_blob))
+            else:
+                raise CorruptSegmentError(f"{key}: unknown entry {etype}")
+            seen += 1
+    except (struct.error, CorruptSegmentError) as e:
+        CORRUPT.inc()
+        raise CorruptSegmentError(f"{key}: truncated entry stream: {e}") \
+            from None
+    if seen != count:
+        CORRUPT.inc()
+        raise CorruptSegmentError(f"{key}: entry count {seen} != {count}")
+    return out
+
+
+class _SegmentInfo:
+    __slots__ = ("seq", "bucket", "key", "size", "crc", "entries", "max_upd",
+                 "uploaded")
+
+    def __init__(self, seq, bucket, key, size, crc, entries, max_upd,
+                 uploaded):
+        self.seq = seq
+        self.bucket = bucket
+        self.key = key
+        self.size = size
+        self.crc = crc
+        self.entries = entries
+        self.max_upd = max_upd
+        self.uploaded = uploaded
+
+
+class _ShardState:
+    def __init__(self):
+        self.parts: dict[PartKey, list] = {}      # pk -> [start, end, upd, bkt]
+        self.chunks: dict[PartKey, dict[int, _ChunkRef]] = {}
+        self.upd = 0
+        self.next_seq = 1
+        self.segments: dict[int, _SegmentInfo] = {}
+        self.pending: dict[int, bytes] = {}       # seq -> sealed bytes
+        self.open: dict[int, _OpenSegment] = {}   # bucket -> open segment
+        self.checkpoints: dict[int, int] = {}
+
+
+_STOP = object()
+
+
+class ObjectStoreColumnStore(ColumnStore):
+    """S3-compatible ColumnStore over immutable segment objects.
+
+    ``client`` is anything with the :class:`~filodb_tpu.testing.fake_s3.
+    FakeS3` surface (put_object/get_object/list_objects/delete_object +
+    multipart).  ``split_filter=(split, n_splits)`` opens a
+    split-restricted view: only buckets serving that split are loaded
+    from the manifest (the key-prefix analog of a token-range scan)."""
+
+    def __init__(self, client, bucket: str = "filodb", prefix: str = "",
+                 segment_target_bytes: int = 1 << 20,
+                 bucket_count: int = 8,
+                 upload_queue_depth: int = 64,
+                 compact_min_segments: int = 6,
+                 multipart_threshold: int = 8 << 20,
+                 auto_compact: bool = True,
+                 retry_policy: RetryPolicy | None = None,
+                 read_retry_policy: RetryPolicy | None = None):
+        self.client = client
+        self.bucket = bucket
+        self.prefix = (prefix.strip("/") + "/") if prefix.strip("/") else ""
+        self.segment_target_bytes = segment_target_bytes
+        self.bucket_count = bucket_count
+        self.compact_min_segments = compact_min_segments
+        self.multipart_threshold = multipart_threshold
+        self.auto_compact = auto_compact
+        self.split_filter: tuple[int, int] | None = None
+        # upload retries never give up on transient faults: an acked flush
+        # must eventually land.  RetryPolicy paces one backoff "round";
+        # the uploader loops rounds forever (see _uploader_put).
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=5, base_backoff_s=0.05, max_backoff_s=2.0)
+        self.read_retry_policy = read_retry_policy or RetryPolicy(
+            max_attempts=3, base_backoff_s=0.02, max_backoff_s=0.5)
+        self._lock = threading.RLock()
+        self._states: dict[tuple[str, int], _ShardState] = {}
+        self._queue: queue.Queue = queue.Queue(maxsize=upload_queue_depth)
+        # tasks staged under _lock (fixing their global order), moved onto
+        # the bounded queue OUTSIDE _lock — the uploader needs _lock to
+        # mark completions, so blocking on a full queue while holding it
+        # would deadlock
+        self._staged: collections.deque = collections.deque()
+        self._stage_lock = threading.Lock()
+        self._closed = False
+        self._upload_errors: list[str] = []
+        self._uploader = threading.Thread(target=self._upload_loop,
+                                          name="objstore-uploader",
+                                          daemon=True)
+        self._uploader.start()
+
+    # ------------------------------------------------------------- keys
+    def _shard_prefix(self, dataset: str, shard: int) -> str:
+        return f"{self.bucket}/{self.prefix}{dataset}/shard-{shard}/"
+
+    def _seg_key(self, dataset: str, shard: int, bucket: int,
+                 seq: int) -> str:
+        return (self._shard_prefix(dataset, shard)
+                + f"b{bucket:02d}/seg-{seq:08d}.seg")
+
+    def _bucket_of(self, pk_blob: bytes) -> int:
+        return split_of(pk_blob, self.bucket_count)
+
+    def _bucket_in_split(self, bkt: int) -> bool:
+        if self.split_filter is None:
+            return True
+        s, n = self.split_filter
+        return bkt % n == s if self.bucket_count % n == 0 \
+            else True  # incompatible split count: load everything
+
+    def restrict_to_split(self, split: int, n_splits: int
+                          ) -> "ObjectStoreColumnStore":
+        """Mark this (fresh) store as a split view BEFORE any state is
+        loaded; manifest segments outside the split's buckets are
+        skipped entirely — no GETs, no index memory."""
+        with self._lock:
+            if self._states:
+                raise ObjectStoreError(
+                    "restrict_to_split must run before first access")
+            self.split_filter = (split, n_splits)
+        return self
+
+    # ------------------------------------------------------------ client io
+    def _transient(self) -> tuple:
+        return (ConnectionError, TimeoutError, OSError)
+
+    def _put_raw(self, key: str, data: bytes) -> None:
+        FaultInjector.fire("objectstore.put", key=key)
+        if len(data) >= self.multipart_threshold and hasattr(
+                self.client, "create_multipart"):
+            upload_id = self.client.create_multipart(key)
+            try:
+                part, n = self.multipart_threshold, 1
+                for off in range(0, len(data), part):
+                    self.client.upload_part(key, upload_id, n,
+                                            data[off:off + part])
+                    n += 1
+                self.client.complete_multipart(key, upload_id)
+            except BaseException:
+                try:
+                    self.client.abort_multipart(key, upload_id)
+                except Exception:
+                    pass
+                raise
+        else:
+            self.client.put_object(key, data)
+        PUTS.inc()
+        BYTES_UP.inc(len(data))
+
+    def _get_raw(self, key: str, start=None, length=None) -> bytes:
+        data = self.client.get_object(key, start, length)
+        GETS.inc()
+        BYTES_DOWN.inc(len(data))
+        return data
+
+    def _get(self, key: str, start=None, length=None) -> bytes:
+        """GET with bounded retry on transient faults (read path)."""
+        return self.read_retry_policy.call(
+            lambda: self._get_raw(key, start, length),
+            retry_on=self._transient(),
+            on_retry=lambda *a, **k: RETRIES.inc(),
+            site="objectstore.get")
+
+    # ------------------------------------------------------------ uploader
+    def _submit(self, task) -> None:
+        """Stage a task in global order (caller MUST hold ``_lock``)."""
+        self._staged.append(task)
+
+    def _flush_staged(self) -> None:
+        """Move staged tasks onto the bounded queue in order (caller must
+        NOT hold ``_lock`` — the put blocks for backpressure)."""
+        with self._stage_lock:
+            while True:
+                try:
+                    task = self._staged.popleft()
+                except IndexError:
+                    return
+                self._queue.put(task)      # bounded: blocks = backpressure
+                QUEUE_DEPTH.set(self._queue.qsize())
+
+    def _upload_loop(self) -> None:
+        while True:
+            task = self._queue.get()
+            QUEUE_DEPTH.set(self._queue.qsize())
+            try:
+                if task is _STOP:
+                    return
+                kind = task[0]
+                if kind == "segment":
+                    _, dataset, shard, seq, key, data = task
+                    self._uploader_put(key, data)
+                    with self._lock:
+                        st = self._states.get((dataset, shard))
+                        if st is not None:
+                            seg = st.segments.get(seq)
+                            if seg is not None:
+                                seg.uploaded = True
+                            st.pending.pop(seq, None)
+                    self._put_manifest(dataset, shard)
+                    if self.auto_compact:
+                        self._maybe_compact(dataset, shard)
+                elif kind == "checkpoint":
+                    _, dataset, shard, snapshot = task
+                    key = self._shard_prefix(dataset, shard) \
+                        + "checkpoints.json"
+                    self._uploader_put(
+                        key, json.dumps(snapshot).encode())
+                elif kind == "compact":
+                    _, dataset, shard, bkt = task
+                    self._compact_bucket(dataset, shard, bkt)
+            except Exception as e:   # never kill the drain loop
+                self._upload_errors.append(f"{task[0]}: {e!r}")
+            finally:
+                self._queue.task_done()
+
+    def _uploader_put(self, key: str, data: bytes) -> None:
+        """Retry forever with backoff: write-behind durability means an
+        acked flush MUST eventually land (puts are idempotent — segment
+        keys are never reused)."""
+        while True:
+            try:
+                self.retry_policy.call(
+                    lambda: self._put_raw(key, data),
+                    retry_on=self._transient(),
+                    on_retry=lambda *a, **k: RETRIES.inc(),
+                    site="objectstore.put")
+                return
+            except self._transient():
+                if self._closed:
+                    raise
+                RETRIES.inc()
+                self.retry_policy.sleep(self.retry_policy.max_backoff_s)
+
+    def _put_manifest(self, dataset: str, shard: int) -> None:
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is None:
+                return
+            doc = {
+                "version": 1,
+                "next_seq": st.next_seq,
+                "upd": st.upd,
+                "segments": [
+                    {"seq": s.seq, "bucket": s.bucket, "key": s.key,
+                     "size": s.size, "crc": s.crc, "entries": s.entries,
+                     "max_upd": s.max_upd}
+                    for s in sorted(st.segments.values(),
+                                    key=lambda s: s.seq)
+                    if s.uploaded],
+            }
+        key = self._shard_prefix(dataset, shard) + "manifest.json"
+        self._uploader_put(key, json.dumps(doc).encode())
+
+    # ------------------------------------------------------------ state
+    def _state(self, dataset: str, shard: int) -> _ShardState:
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is None:
+                st = self._load_state(dataset, shard)
+                self._states[(dataset, shard)] = st
+            return st
+
+    def _load_state(self, dataset: str, shard: int) -> _ShardState:
+        """Cold-start recovery: manifest → full-GET each live segment
+        (CRC32C-verified) → rebuild the in-memory index in seq order."""
+        st = _ShardState()
+        base = self._shard_prefix(dataset, shard)
+        with span("objectstore", op="load", dataset=dataset, shard=shard):
+            try:
+                doc = json.loads(self._get(base + "manifest.json"))
+            except KeyError:
+                doc = None
+            except self._transient():
+                raise
+            if doc:
+                st.next_seq = int(doc.get("next_seq", 1))
+                st.upd = int(doc.get("upd", 0))
+                for s in doc.get("segments", ()):
+                    info = _SegmentInfo(
+                        int(s["seq"]), int(s["bucket"]), s["key"],
+                        int(s["size"]), int(s["crc"]), int(s["entries"]),
+                        int(s["max_upd"]), True)
+                    st.segments[info.seq] = info
+                for info in sorted(st.segments.values(),
+                                   key=lambda s: s.seq):
+                    if not self._bucket_in_split(info.bucket):
+                        continue
+                    data = self._get(info.key)
+                    if crc32c(data[:-_FOOTER.size]) != info.crc:
+                        CORRUPT.inc()
+                        raise CorruptSegmentError(
+                            f"{info.key}: manifest CRC mismatch")
+                    self._apply_entries(st, info.seq,
+                                        parse_segment(data, info.key))
+                if self.split_filter is not None:
+                    st.segments = {
+                        q: s for q, s in st.segments.items()
+                        if self._bucket_in_split(s.bucket)}
+            try:
+                st.checkpoints = {
+                    int(g): int(o) for g, o in json.loads(
+                        self._get(base + "checkpoints.json")).items()}
+            except KeyError:
+                pass
+        return st
+
+    def _apply_entries(self, st: _ShardState, seq: int, entries) -> None:
+        for e in entries:
+            if e[0] == "chunk":
+                _, pk_blob, cid, t0, t1, itime, upd, off, dlen, crc, _ = e
+                pk = _pk_from_blob(pk_blob)
+                st.chunks.setdefault(pk, {})[cid] = _ChunkRef(
+                    cid, t0, t1, itime, upd, seq, off, dlen, crc)
+            elif e[0] == "partkey":
+                _, pk_blob, t0, t1, upd = e
+                pk = _pk_from_blob(pk_blob)
+                prev = st.parts.get(pk)
+                if prev is not None:
+                    t0 = min(prev[0], t0)
+                st.parts[pk] = [t0, t1, upd, self._bucket_of(pk_blob)]
+            else:  # delete
+                pk = _pk_from_blob(e[1])
+                st.parts.pop(pk, None)
+                st.chunks.pop(pk, None)
+
+    # -------------------------------------------------------- segment build
+    def _open_for(self, st, dataset, shard, bkt) -> _OpenSegment:
+        seg = st.open.get(bkt)
+        if seg is None:
+            seg = _OpenSegment(st.next_seq, bkt)
+            st.next_seq += 1
+            st.open[bkt] = seg
+        return seg
+
+    def _seal(self, st, dataset, shard, bkt) -> None:
+        """Seal one open segment and hand it to the uploader (caller
+        holds the lock)."""
+        seg = st.open.pop(bkt, None)
+        if seg is None or seg.entries == 0:
+            return
+        data = seg.finish()
+        key = self._seg_key(dataset, shard, bkt, seg.seq)
+        st.segments[seg.seq] = _SegmentInfo(
+            seg.seq, bkt, key, len(data), crc32c(data[:-_FOOTER.size]),
+            seg.entries, seg.max_upd, False)
+        st.pending[seg.seq] = data
+        self._submit(("segment", dataset, shard, seg.seq, key, data))
+
+    def _seal_all(self, st, dataset, shard) -> None:
+        for bkt in list(st.open):
+            self._seal(st, dataset, shard, bkt)
+
+    # ------------------------------------------------------------- writes
+    def initialize(self, dataset: str, num_shards: int) -> None:
+        for s in range(num_shards):
+            self._state(dataset, s)
+
+    def write_chunks(self, dataset, shard, part_key, chunks,
+                     ingestion_time):
+        blob = _pk_blob(part_key)
+        bkt = self._bucket_of(blob)
+        with span("objectstore", op="write_chunks", shard=shard):
+            with self._lock:
+                st = self._state(dataset, shard)
+                st.upd += 1
+                upd = st.upd
+                refs = st.chunks.setdefault(part_key, {})
+                seg = self._open_for(st, dataset, shard, bkt)
+                for ch in chunks:
+                    if ch.id in refs:   # idempotent re-flush (dedup by id)
+                        continue
+                    off, dlen, crc = seg.add_chunk(blob, ch,
+                                                   ingestion_time, upd)
+                    refs[ch.id] = _ChunkRef(
+                        ch.id, ch.start_time, ch.end_time, ingestion_time,
+                        upd, seg.seq, off, dlen, crc)
+                if seg.size() >= self.segment_target_bytes:
+                    self._seal(st, dataset, shard, bkt)
+            self._flush_staged()
+
+    def write_part_keys(self, dataset, shard, records):
+        with span("objectstore", op="write_part_keys", shard=shard):
+            with self._lock:
+                st = self._state(dataset, shard)
+                st.upd += 1
+                upd = st.upd
+                for r in records:
+                    blob = _pk_blob(r.part_key)
+                    bkt = self._bucket_of(blob)
+                    start = r.start_time
+                    prev = st.parts.get(r.part_key)
+                    if prev is not None:
+                        start = min(prev[0], start)
+                    st.parts[r.part_key] = [start, r.end_time, upd, bkt]
+                    seg = self._open_for(st, dataset, shard, bkt)
+                    seg.add_part_key(blob, start, r.end_time, upd)
+                    if seg.size() >= self.segment_target_bytes:
+                        self._seal(st, dataset, shard, bkt)
+            self._flush_staged()
+
+    def delete_part_keys(self, dataset, shard, part_keys):
+        with self._lock:
+            st = self._state(dataset, shard)
+            for pk in part_keys:
+                blob = _pk_blob(pk)
+                st.parts.pop(pk, None)
+                st.chunks.pop(pk, None)
+                # durable tombstone so recovery replays the delete
+                seg = self._open_for(st, dataset, shard,
+                                     self._bucket_of(blob))
+                seg.add_delete(blob)
+        self._flush_staged()
+
+    def truncate(self, dataset):
+        self.flush()
+        with self._lock:
+            for key in [k for k in self._states if k[0] == dataset]:
+                del self._states[key]
+        for key in self.client.list_objects(
+                f"{self.bucket}/{self.prefix}{dataset}/"):
+            self.client.delete_object(key)
+
+    # -------------------------------------------------------------- reads
+    def _fetch_refs(self, dataset, shard, st, part_key,
+                    refs) -> dict[int, bytes]:
+        """Fetch payload bytes for one part key's refs → {chunk_id:
+        bytes}.  Pending/open segments are served from memory
+        (read-your-writes); uploaded segments via ranged GETs, coalescing
+        per-segment runs into one request when the covering range is not
+        too sparse.  Every payload is CRC32C-verified against its ref."""
+        out: dict[int, bytes] = {}
+        by_seq: dict[int, list[_ChunkRef]] = {}
+        with self._lock:
+            open_by_seq = {o.seq: o for o in st.open.values()}
+            for ref in refs:
+                data = st.pending.get(ref.seq)
+                if data is None:
+                    o = open_by_seq.get(ref.seq)
+                    if o is not None:
+                        data = o.buf.getvalue()
+                if data is not None:
+                    out[ref.chunk_id] = data[ref.offset:ref.offset
+                                             + ref.length]
+                else:
+                    by_seq.setdefault(ref.seq, []).append(ref)
+            keys = {seq: st.segments[seq].key for seq in by_seq}
+        for seq, seq_refs in by_seq.items():
+            try:
+                self._ranged_get(keys[seq], seq_refs, out)
+            except KeyError:
+                # segment swapped out by compaction between the index
+                # read and the GET: re-resolve via the fresh index once
+                with self._lock:
+                    live = st.chunks.get(part_key, {})
+                    cur = [(live.get(r.chunk_id) or r) for r in seq_refs]
+                    by_cur: dict[str, list[_ChunkRef]] = {}
+                    for r in cur:
+                        by_cur.setdefault(st.segments[r.seq].key,
+                                          []).append(r)
+                for k, rs in by_cur.items():
+                    self._ranged_get(k, rs, out)
+        for ref in refs:
+            data = out.get(ref.chunk_id)
+            if data is None or len(data) != ref.length \
+                    or crc32c(data) != ref.crc:
+                CORRUPT.inc()
+                raise CorruptSegmentError(
+                    f"chunk {ref.chunk_id} in seg {ref.seq} "
+                    f"({dataset}/shard-{shard}): payload CRC32C mismatch")
+        return out
+
+    def _ranged_get(self, key: str, seq_refs: list[_ChunkRef],
+                    out: dict[int, bytes]) -> None:
+        seq_refs = sorted(seq_refs, key=lambda r: r.offset)
+        lo = seq_refs[0].offset
+        hi = max(r.offset + r.length for r in seq_refs)
+        dense = sum(r.length for r in seq_refs)
+        if hi - lo <= dense + 4096 * len(seq_refs):
+            blob = self._get(key, lo, hi - lo)
+            for r in seq_refs:
+                out[r.chunk_id] = blob[r.offset - lo:
+                                       r.offset - lo + r.length]
+        else:
+            for r in seq_refs:
+                out[r.chunk_id] = self._get(key, r.offset, r.length)
+
+    def read_chunks(self, dataset, shard, part_key, start_time, end_time):
+        with span("objectstore", op="read_chunks", shard=shard):
+            with self._lock:
+                st = self._state(dataset, shard)
+                refs = sorted(
+                    (r for r in st.chunks.get(part_key, {}).values()
+                     if r.end_time >= start_time
+                     and r.start_time <= end_time),
+                    key=lambda r: r.chunk_id)
+            if not refs:
+                return []
+            payloads = self._fetch_refs(dataset, shard, st, part_key, refs)
+            return [Chunk.deserialize(payloads[r.chunk_id]) for r in refs]
+
+    def scan_part_keys(self, dataset, shard):
+        with self._lock:
+            st = self._state(dataset, shard)
+            return [PartKeyRecord(pk, v[0], v[1])
+                    for pk, v in st.parts.items()]
+
+    def scan_part_keys_split(self, dataset, shard, split, n_splits):
+        if n_splits <= 1:
+            return self.scan_part_keys(dataset, shard)
+        with self._lock:
+            st = self._state(dataset, shard)
+            if self.bucket_count % n_splits == 0:
+                # bucket ≡ crc32 (mod bucket_count) ⇒ bucket % n_splits
+                # == split_of(blob, n_splits): the key-prefix split
+                return [PartKeyRecord(pk, v[0], v[1])
+                        for pk, v in st.parts.items()
+                        if v[3] % n_splits == split]
+            return [PartKeyRecord(pk, v[0], v[1])
+                    for pk, v in st.parts.items()
+                    if split_of(_pk_blob(pk), n_splits) == split]
+
+    def scan_part_keys_since(self, dataset, shard, pk_token):
+        with self._lock:
+            st = self._state(dataset, shard)
+            return [PartKeyRecord(pk, v[0], v[1])
+                    for pk, v in st.parts.items() if v[2] > pk_token]
+
+    def scan_chunks_by_ingestion_time(self, dataset, shard, start, end):
+        yield from self.scan_chunks_by_ingestion_time_split(
+            dataset, shard, start, end, 0, 1)
+
+    def scan_chunks_by_ingestion_time_split(self, dataset, shard, start,
+                                            end, split, n_splits):
+        """Ingestion-time scan restricted to one token-range split — the
+        fan-out unit for downsample/repair jobs."""
+        with self._lock:
+            st = self._state(dataset, shard)
+            work = []
+            for pk, refs in st.chunks.items():
+                if n_splits > 1:
+                    part = st.parts.get(pk)
+                    bkt = part[3] if part is not None \
+                        else self._bucket_of(_pk_blob(pk))
+                    if self.bucket_count % n_splits == 0:
+                        if bkt % n_splits != split:
+                            continue
+                    elif split_of(_pk_blob(pk), n_splits) != split:
+                        continue
+                sel = sorted((r for r in refs.values()
+                              if start <= r.ingestion_time < end),
+                             key=lambda r: r.chunk_id)
+                if sel:
+                    work.append((pk, sel))
+        for pk, sel in work:
+            payloads = self._fetch_refs(dataset, shard, st, pk, sel)
+            yield pk, [Chunk.deserialize(payloads[r.chunk_id])
+                       for r in sel]
+
+    def max_persisted_ts(self, dataset, shard):
+        with self._lock:
+            st = self._state(dataset, shard)
+            return {pk: max(r.end_time for r in refs.values())
+                    for pk, refs in st.chunks.items() if refs}
+
+    def max_persisted_ts_since(self, dataset, shard, chunk_token):
+        with self._lock:
+            st = self._state(dataset, shard)
+            out = {}
+            for pk, refs in st.chunks.items():
+                sel = [r.end_time for r in refs.values()
+                       if r.upd > chunk_token]
+                if sel:
+                    out[pk] = max(sel)
+            return out
+
+    def update_tokens(self, dataset, shard):
+        with self._lock:
+            st = self._state(dataset, shard)
+            return (st.upd, st.upd)
+
+    # ----------------------------------------------------- index snapshots
+    def write_index_snapshot(self, dataset, shard, data):
+        key = self._shard_prefix(dataset, shard) + "index.snap"
+        with span("objectstore", op="write_snapshot", shard=shard):
+            # synchronous (not write-behind): the caller treats a returned
+            # snapshot write as replay-barrier state
+            self.retry_policy.call(
+                lambda: self._put_raw(key, data),
+                retry_on=self._transient(),
+                on_retry=lambda *a, **k: RETRIES.inc(),
+                site="objectstore.put")
+
+    def read_index_snapshot(self, dataset, shard):
+        key = self._shard_prefix(dataset, shard) + "index.snap"
+        try:
+            return self._get(key)
+        except KeyError:
+            return None
+
+    # ---------------------------------------------------------- compaction
+    def _maybe_compact(self, dataset: str, shard: int) -> None:
+        """Queue compaction for buckets with many small uploaded
+        segments (runs on the uploader thread → naturally serialized
+        with uploads)."""
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is None:
+                return
+            small: dict[int, int] = {}
+            for s in st.segments.values():
+                if s.uploaded and s.size < self.segment_target_bytes // 2:
+                    small[s.bucket] = small.get(s.bucket, 0) + 1
+            due = [b for b, n in small.items()
+                   if n >= self.compact_min_segments]
+        for b in due:
+            self._compact_bucket(dataset, shard, b)
+
+    def compact(self, dataset: str, shard: int) -> int:
+        """Compact every bucket of the shard now (test/operator hook).
+        Returns the number of segments removed."""
+        with self._lock:
+            st = self._state(dataset, shard)
+            buckets = {s.bucket for s in st.segments.values() if s.uploaded}
+            before = len(st.segments)
+        for b in sorted(buckets):
+            self._compact_bucket(dataset, shard, b)
+        with self._lock:
+            return before - len(self._state(dataset, shard).segments)
+
+    def _compact_bucket(self, dataset: str, shard: int, bkt: int) -> None:
+        """Merge all uploaded segments of one bucket into a single new
+        segment: read + verify olds, re-emit only live entries (latest
+        part-key state, chunks still in the index), swap the manifest,
+        delete the olds."""
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is None:
+                return
+            olds = sorted((s for s in st.segments.values()
+                           if s.bucket == bkt and s.uploaded),
+                          key=lambda s: s.seq)
+            if len(olds) < 2:
+                return
+        with span("objectstore", op="compact", shard=shard, bucket=bkt):
+            parsed = [(s, parse_segment(self._get(s.key), s.key))
+                      for s in olds]
+            with self._lock:
+                st = self._states.get((dataset, shard))
+                if st is None:
+                    return
+                # a segment may have been compacted away meanwhile
+                if any(s.seq not in st.segments for s, _ in parsed):
+                    return
+                new = _OpenSegment(st.next_seq, bkt)
+                st.next_seq += 1
+                moved: list[tuple[PartKey, _ChunkRef]] = []
+                emitted_pks: set[PartKey] = set()
+                for s, entries in parsed:
+                    for e in entries:
+                        if e[0] == "chunk":
+                            _, blob, cid, *_rest = e
+                            pk = _pk_from_blob(blob)
+                            ref = st.chunks.get(pk, {}).get(cid)
+                            if ref is None or ref.seq != s.seq:
+                                continue   # deleted or superseded
+                            ch = Chunk.deserialize(e[10])
+                            off, dlen, crc = new.add_chunk(
+                                blob, ch, ref.ingestion_time, ref.upd)
+                            moved.append((pk, _ChunkRef(
+                                cid, ref.start_time, ref.end_time,
+                                ref.ingestion_time, ref.upd, new.seq,
+                                off, dlen, crc)))
+                        elif e[0] == "partkey":
+                            pk = _pk_from_blob(e[1])
+                            cur = st.parts.get(pk)
+                            if cur is None or pk in emitted_pks:
+                                continue   # deleted or already emitted
+                            emitted_pks.add(pk)
+                            new.add_part_key(e[1], cur[0], cur[1], cur[2])
+                        # deletes need no re-emit: their effect is already
+                        # folded into the surviving entries
+                data = new.finish()
+                key = self._seg_key(dataset, shard, bkt, new.seq)
+                info = _SegmentInfo(
+                    new.seq, bkt, key, len(data),
+                    crc32c(data[:-_FOOTER.size]), new.entries,
+                    new.max_upd, False)
+            # upload the replacement BEFORE swapping the index/manifest
+            self._uploader_put(key, data)
+            info.uploaded = True
+            with self._lock:
+                st.segments[info.seq] = info
+                for pk, ref in moved:
+                    live = st.chunks.get(pk, {})
+                    if live.get(ref.chunk_id) is not None:
+                        live[ref.chunk_id] = ref
+                for s, _ in parsed:
+                    st.segments.pop(s.seq, None)
+            self._put_manifest(dataset, shard)
+            for s, _ in parsed:
+                try:
+                    self.client.delete_object(s.key)
+                except Exception:
+                    pass   # orphan object; harmless (not in manifest)
+            COMPACTIONS.inc()
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self) -> None:
+        """Seal all open segments and drain the upload queue (blocks
+        until everything staged so far is durably uploaded)."""
+        with self._lock:
+            for (dataset, shard), st in self._states.items():
+                self._seal_all(st, dataset, shard)
+        self._flush_staged()
+        self._queue.join()
+
+    def upload_errors(self) -> list[str]:
+        return list(self._upload_errors)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        try:
+            self.flush()
+        finally:
+            self._closed = True
+            self._queue.put(_STOP)
+            self._uploader.join(timeout=30)
+
+
+class HttpS3Client:
+    """Minimal path-style S3 REST client (stdlib-only) with optional
+    AWS SigV4 signing — enough for minio/S3-compatible endpoints:
+    PUT / GET (+Range) / DELETE / ListObjectsV2.  Multipart is not
+    offered (no ``create_multipart`` attr), so the uploader falls back
+    to single PUTs; S3 single-PUT tops out at 5 GiB, far above any
+    segment this tier produces."""
+
+    def __init__(self, endpoint: str, access_key: str | None = None,
+                 secret_key: str | None = None, region: str = "us-east-1",
+                 timeout_s: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.timeout_s = timeout_s
+
+    # -- SigV4 ------------------------------------------------------------
+    def _sign(self, method: str, path: str, query: str, headers: dict,
+              payload: bytes) -> dict:
+        import datetime
+        import hashlib
+        import hmac
+        import urllib.parse as up
+        if not self.access_key:
+            return headers
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        host = up.urlparse(self.endpoint).netloc
+        payload_hash = hashlib.sha256(payload).hexdigest()
+        headers = dict(headers)
+        headers["host"] = host
+        headers["x-amz-date"] = amzdate
+        headers["x-amz-content-sha256"] = payload_hash
+        signed = sorted(k.lower() for k in headers)
+        canonical_headers = "".join(
+            f"{k}:{str(headers[_orig(headers, k)]).strip()}\n"
+            for k in signed)
+        canonical = "\n".join([
+            method, up.quote(path), query, canonical_headers,
+            ";".join(signed), payload_hash])
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amzdate, scope,
+            hashlib.sha256(canonical.encode()).hexdigest()])
+
+        def _hmac(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(("AWS4" + self.secret_key).encode(), datestamp)
+        k = _hmac(k, self.region)
+        k = _hmac(k, "s3")
+        k = _hmac(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        headers["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+        return headers
+
+    def _request(self, method: str, key: str, query: str = "",
+                 data: bytes = b"", headers: dict | None = None) -> bytes:
+        import urllib.error
+        import urllib.request
+        path = "/" + key
+        headers = self._sign(method, path, query, headers or {}, data)
+        url = self.endpoint + path + ("?" + query if query else "")
+        req = urllib.request.Request(url, data=data or None, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise KeyError(key) from None
+            if e.code in (500, 502, 503, 504, 429):
+                raise ConnectionError(f"s3 {method} {key}: {e.code}") \
+                    from None
+            raise ObjectStoreError(
+                f"s3 {method} {key}: {e.code} {e.reason}") from None
+        except urllib.error.URLError as e:
+            raise ConnectionError(f"s3 {method} {key}: {e.reason}") \
+                from None
+
+    def put_object(self, key: str, data: bytes) -> None:
+        self._request("PUT", key, data=data)
+
+    def get_object(self, key: str, start: int | None = None,
+                   length: int | None = None) -> bytes:
+        headers = {}
+        if start is not None:
+            end = "" if length is None else start + length - 1
+            headers["Range"] = f"bytes={start}-{end}"
+        return self._request("GET", key, headers=headers)
+
+    def delete_object(self, key: str) -> None:
+        try:
+            self._request("DELETE", key)
+        except KeyError:
+            pass
+
+    def list_objects(self, prefix: str = "") -> list[str]:
+        import urllib.parse as up
+        import xml.etree.ElementTree as ET
+        bucket, _, rest = prefix.partition("/")
+        out: list[str] = []
+        token = None
+        while True:
+            q = f"list-type=2&prefix={up.quote(rest)}"
+            if token:
+                q += f"&continuation-token={up.quote(token)}"
+            xml = self._request("GET", bucket, query=q)
+            root = ET.fromstring(xml)
+            ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+            for c in root.iter(f"{ns}Key"):
+                out.append(f"{bucket}/{c.text}")
+            trunc = root.findtext(f"{ns}IsTruncated") == "true"
+            token = root.findtext(f"{ns}NextContinuationToken")
+            if not trunc or not token:
+                return out
+
+
+def _orig(headers: dict, lower: str) -> str:
+    for k in headers:
+        if k.lower() == lower:
+            return k
+    return lower
+
+
+def open_object_store(store_cfg: dict, data_dir: str
+                      ) -> tuple[ObjectStoreColumnStore,
+                                 "ObjectStoreMetaStore"]:
+    """Build the object-store tier from a ``config.store`` block.  No
+    endpoint (or a plain path) → directory-backed in-process fake under
+    ``data_dir`` (hermetic dev/test); ``http(s)://…`` → real
+    S3-compatible service."""
+    import os
+    endpoint = store_cfg.get("endpoint")
+    if endpoint and str(endpoint).startswith(("http://", "https://")):
+        client = HttpS3Client(
+            endpoint,
+            access_key=store_cfg.get("access_key"),
+            secret_key=store_cfg.get("secret_key"),
+            region=store_cfg.get("region", "us-east-1"))
+    else:
+        from filodb_tpu.testing.fake_s3 import FakeS3
+        root = endpoint or os.path.join(data_dir, "objectstore")
+        client = FakeS3(root=root)
+    cs = ObjectStoreColumnStore(
+        client,
+        bucket=store_cfg.get("bucket", "filodb"),
+        prefix=store_cfg.get("prefix", ""),
+        segment_target_bytes=int(
+            store_cfg.get("segment_target_bytes", 1 << 20)),
+        bucket_count=int(store_cfg.get("bucket_count", 8)),
+        upload_queue_depth=int(store_cfg.get("upload_queue_depth", 64)))
+    return cs, ObjectStoreMetaStore(cs)
+
+
+class ObjectStoreMetaStore(MetaStore):
+    """Checkpoints on the same bucket, ordered behind the data they cover.
+
+    Shares the column store's single FIFO uploader: ``write_checkpoint``
+    first seals the shard's open segments into the queue, then enqueues
+    the checkpoint object — so remotely the checkpoint only ever appears
+    *after* the flushed data it acknowledges."""
+
+    def __init__(self, column_store: ObjectStoreColumnStore):
+        self.cs = column_store
+
+    def write_checkpoint(self, dataset, shard, group, offset):
+        cs = self.cs
+        with span("objectstore", op="write_checkpoint", shard=shard):
+            with cs._lock:
+                st = cs._state(dataset, shard)
+                cs._seal_all(st, dataset, shard)
+                st.checkpoints[group] = offset
+                # staged AFTER the seals, under the same lock: FIFO order
+                # guarantees the checkpoint object lands last
+                cs._submit(("checkpoint", dataset, shard,
+                            dict(st.checkpoints)))
+            cs._flush_staged()
+
+    def read_checkpoints(self, dataset, shard):
+        with self.cs._lock:
+            return dict(self.cs._state(dataset, shard).checkpoints)
+
+    def close(self) -> None:
+        pass   # lifecycle owned by the column store

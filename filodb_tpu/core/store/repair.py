@@ -30,16 +30,35 @@ class ChunkCopier:
     target: ColumnStore
     dataset: str
     num_shards: int
+    n_splits: int = 1   # fan the scan out over token-range splits
 
     def run(self, ingestion_start: int, ingestion_end: int) -> dict:
         stats = {"partitions": 0, "chunks": 0}
         for shard in range(self.num_shards):
-            for part_key, chunks in self.source.scan_chunks_by_ingestion_time(
-                    self.dataset, shard, ingestion_start, ingestion_end):
-                self.target.write_chunks(self.dataset, shard, part_key,
-                                         chunks, ingestion_end)
-                stats["partitions"] += 1
-                stats["chunks"] += len(chunks)
+            for split in range(max(1, self.n_splits)):
+                self._copy_split(shard, split, ingestion_start,
+                                 ingestion_end, stats)
+        return stats
+
+    def run_split(self, split: int, ingestion_start: int,
+                  ingestion_end: int) -> dict:
+        """One split's worth of work — the unit a parallel worker owns
+        (reference: one Spark task per token-range split)."""
+        stats = {"partitions": 0, "chunks": 0}
+        for shard in range(self.num_shards):
+            self._copy_split(shard, split, ingestion_start, ingestion_end,
+                             stats)
+        return stats
+
+    def _copy_split(self, shard, split, t0, t1, stats):
+        for part_key, chunks in \
+                self.source.scan_chunks_by_ingestion_time_split(
+                    self.dataset, shard, t0, t1, split,
+                    max(1, self.n_splits)):
+            self.target.write_chunks(self.dataset, shard, part_key,
+                                     chunks, t1)
+            stats["partitions"] += 1
+            stats["chunks"] += len(chunks)
         return stats
 
 
@@ -49,11 +68,16 @@ class PartitionKeysCopier:
     target: ColumnStore
     dataset: str
     num_shards: int
+    n_splits: int = 1   # fan the scan out over token-range splits
 
     def run(self) -> int:
+        return sum(self.run_split(s) for s in range(max(1, self.n_splits)))
+
+    def run_split(self, split: int) -> int:
         n = 0
         for shard in range(self.num_shards):
-            recs = self.source.scan_part_keys(self.dataset, shard)
+            recs = self.source.scan_part_keys_split(
+                self.dataset, shard, split, max(1, self.n_splits))
             if recs:
                 self.target.write_part_keys(self.dataset, shard, recs)
                 n += len(recs)
@@ -104,11 +128,16 @@ class DSIndexJob:
     dataset: str
     ds_dataset: str
     num_shards: int
+    n_splits: int = 1   # fan the scan out over token-range splits
 
     def run(self) -> int:
+        return sum(self.run_split(s) for s in range(max(1, self.n_splits)))
+
+    def run_split(self, split: int) -> int:
         n = 0
         for shard in range(self.num_shards):
-            recs = self.store.scan_part_keys(self.dataset, shard)
+            recs = self.store.scan_part_keys_split(
+                self.dataset, shard, split, max(1, self.n_splits))
             ds_recs = [PartKeyRecord(
                 r.part_key.__class__(
                     _ds_schema_for(r.part_key.schema), r.part_key.labels),

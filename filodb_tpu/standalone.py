@@ -23,6 +23,9 @@ from filodb_tpu.core.store.localstore import (
     LocalDiskColumnStore,
     LocalDiskMetaStore,
 )
+# imported unconditionally so the filodb_objectstore_* metric families are
+# registered (and scrape-visible) regardless of the configured backend
+from filodb_tpu.core.store.objectstore import open_object_store
 from filodb_tpu.gateway.server import ContainerSink, GatewayServer
 from filodb_tpu.http.server import FiloHttpServer
 from filodb_tpu.kafka.log import SegmentedFileLog
@@ -52,10 +55,16 @@ class FiloServer:
             self.column_store = RemoteColumnStore(host, int(port))
             self.meta_store = RemoteMetaStore(host, int(port))
         else:
-            self.column_store = LocalDiskColumnStore(
-                os.path.join(config.data_dir, "columnstore"))
-            self.meta_store = LocalDiskMetaStore(
-                os.path.join(config.data_dir, "columnstore"))
+            if config.store.get("backend") == "object":
+                # S3-compatible durable tier: write-behind segment upload
+                # with CRC32C tripwires (core/store/objectstore.py)
+                self.column_store, self.meta_store = open_object_store(
+                    config.store, config.data_dir)
+            else:
+                self.column_store = LocalDiskColumnStore(
+                    os.path.join(config.data_dir, "columnstore"))
+                self.meta_store = LocalDiskMetaStore(
+                    os.path.join(config.data_dir, "columnstore"))
             if config.store_server_port:
                 from filodb_tpu.core.store.remotestore import (
                     ChunkStoreServer,
@@ -403,15 +412,17 @@ class FiloServer:
             raw_retention = ds_cfg.get("raw_retention_ms",
                                        ing.store.retention_ms)
             job = DownsamplerJob(self.column_store, dataset,
-                                 ing.num_shards, resolutions)
-            state = {"last_run": 0}
+                                 ing.num_shards, resolutions,
+                                 meta_store=self.meta_store)
 
-            def runner(job=job, schedule_s=schedule_s, state=state):
+            def runner(job=job, schedule_s=schedule_s):
                 while True:
                     now_ms = int(_time.time() * 1000)
                     try:
-                        job.run(state["last_run"], now_ms)
-                        state["last_run"] = now_ms
+                        # checkpointed: a restart resumes from the last
+                        # persisted watermark, re-covering any window lost
+                        # to a crash between raw flush and ds run
+                        job.catch_up(now_ms)
                     except Exception:
                         log.exception("downsampler job failed")
                     _time.sleep(schedule_s)

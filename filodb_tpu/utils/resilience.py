@@ -409,6 +409,7 @@ class FaultInjector:
     - ``promql.remote``     (ctx: endpoint)    — cross-cluster HTTP exec
     - ``store.call``        (ctx: host, port, op) — remote column store
     - ``node.dispatch``     (ctx: node)        — in-cluster node dispatch
+    - ``objectstore.put``   (ctx: key)         — object-store segment upload
     """
 
     _faults: dict[str, list[Fault]] = {}

@@ -123,6 +123,15 @@ def _mk_store(tmp_path, kind="local"):
                 root=str(tmp_path / "data")).start()
         cs = RemoteColumnStore("127.0.0.1", srv.port)
         meta = RemoteMetaStore("127.0.0.1", srv.port)
+    elif kind == "object":
+        from filodb_tpu.core.store.objectstore import (
+            ObjectStoreColumnStore, ObjectStoreMetaStore)
+        from filodb_tpu.testing.fake_s3 import FakeS3
+        # dir-backed fake: a new store instance over the same root models a
+        # process restart reading back from the object service
+        cs = ObjectStoreColumnStore(FakeS3(root=str(tmp_path / "s3")),
+                                    segment_target_bytes=64 * 1024)
+        meta = ObjectStoreMetaStore(cs)
     else:
         cs = LocalDiskColumnStore(str(tmp_path / "data"))
         meta = LocalDiskMetaStore(str(tmp_path / "data"))
@@ -132,7 +141,7 @@ def _mk_store(tmp_path, kind="local"):
     return ms
 
 
-@pytest.fixture(params=["local", "remote"])
+@pytest.fixture(params=["local", "remote", "object"])
 def store_kind(request):
     return request.param
 

@@ -117,6 +117,20 @@ GOVERNOR_NAMES = [
 ]
 
 
+# object-store durable tier (core/store/objectstore.py) — registered at
+# import; standalone imports the module regardless of the configured backend
+OBJECTSTORE_NAMES = [
+    "filodb_objectstore_puts_total",
+    "filodb_objectstore_gets_total",
+    "filodb_objectstore_bytes_up_total",
+    "filodb_objectstore_bytes_down_total",
+    "filodb_objectstore_retries_total",
+    "filodb_objectstore_compactions_total",
+    "filodb_objectstore_corrupt_total",
+    "filodb_objectstore_queue_depth",
+]
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -199,6 +213,11 @@ class TestMetricsScrape:
         # materialization, so movement here would be engine-dependent)
         missing_da = [n for n in DIST_AGG_NAMES if n not in names_present]
         assert not missing_da, f"missing dist-agg metrics: {missing_da}"
+
+        # object-store tier families render even on the local backend
+        # (pre-registered at import so dashboards see stable zeros)
+        missing_os = [n for n in OBJECTSTORE_NAMES if n not in names_present]
+        assert not missing_os, f"missing objectstore metrics: {missing_os}"
 
         # governor + gateway overload families are exposed, and the range
         # query above passed the admission gate so admissions moved
