@@ -29,7 +29,11 @@ it covers: a crash mid-upload leaves the checkpoint missing and WAL
 replay re-covers the gap — an acked flush is never lost.  The uploader
 retries transient faults with ``RetryPolicy`` backoff forever (puts are
 idempotent: segment keys are unique per seq) and uses multipart for
-large segments.
+large segments.  A *fatal* (non-transient) failure — an S3 403/400, say
+— poisons the shard instead: every task FIFO-queued behind it is parked
+so the checkpoint can never overtake the data it covers, and the next
+``flush()``/``close()`` raises :class:`ObjectStoreError` rather than
+acking lost data.
 
 Integrity tripwires: every segment carries a CRC32C (Castagnoli) footer
 verified on full reads (recovery, compaction), and every chunk entry
@@ -329,6 +333,10 @@ class ObjectStoreColumnStore(ColumnStore):
         self._stage_lock = threading.Lock()
         self._closed = False
         self._upload_errors: list[str] = []
+        # shards with a fatal (non-transient) upload failure: everything
+        # queued behind the failed task is parked so a checkpoint can
+        # never overtake the data it covers; flush() raises for them
+        self._failed: set[tuple[str, int]] = set()
         self._uploader = threading.Thread(target=self._upload_loop,
                                           name="objstore-uploader",
                                           daemon=True)
@@ -357,13 +365,23 @@ class ObjectStoreColumnStore(ColumnStore):
                           ) -> "ObjectStoreColumnStore":
         """Mark this (fresh) store as a split view BEFORE any state is
         loaded; manifest segments outside the split's buckets are
-        skipped entirely — no GETs, no index memory."""
+        skipped entirely — no GETs, no index memory.  The view is
+        strictly read-only (every write entry point raises): a write
+        would republish the manifest from the filtered segment set and
+        drop the foreign buckets' segments."""
         with self._lock:
             if self._states:
                 raise ObjectStoreError(
                     "restrict_to_split must run before first access")
             self.split_filter = (split, n_splits)
         return self
+
+    def _require_writable(self, op: str) -> None:
+        if self.split_filter is not None:
+            raise ObjectStoreError(
+                f"{op}: this store is a read-only split view — a write "
+                "would republish the shard manifest from the filtered "
+                "segment set and drop every foreign-bucket segment")
 
     # ------------------------------------------------------------ client io
     def _transient(self) -> tuple:
@@ -430,9 +448,27 @@ class ObjectStoreColumnStore(ColumnStore):
             try:
                 if task is _STOP:
                     return
-                kind = task[0]
+                kind, dataset, shard = task[0], task[1], task[2]
+                if kind == "compact":
+                    # compaction failure never loses durable data (the
+                    # old segments stay live in the manifest): log it
+                    # without poisoning the shard
+                    try:
+                        self._compact_bucket(dataset, shard, task[3])
+                    except Exception as e:
+                        self._upload_errors.append(f"compact: {e!r}")
+                    continue
+                if (dataset, shard) in self._failed:
+                    # a task for this shard failed fatally earlier: park
+                    # everything FIFO-ordered behind it, most critically
+                    # checkpoints — a checkpoint landing without the data
+                    # it covers would make WAL replay skip the lost flush
+                    self._upload_errors.append(
+                        f"{kind} parked behind failed upload "
+                        f"({dataset}/shard-{shard})")
+                    continue
                 if kind == "segment":
-                    _, dataset, shard, seq, key, data = task
+                    seq, key, data = task[3], task[4], task[5]
                     self._uploader_put(key, data)
                     with self._lock:
                         st = self._states.get((dataset, shard))
@@ -443,18 +479,20 @@ class ObjectStoreColumnStore(ColumnStore):
                             st.pending.pop(seq, None)
                     self._put_manifest(dataset, shard)
                     if self.auto_compact:
-                        self._maybe_compact(dataset, shard)
+                        try:
+                            self._maybe_compact(dataset, shard)
+                        except Exception as e:
+                            self._upload_errors.append(f"compact: {e!r}")
                 elif kind == "checkpoint":
-                    _, dataset, shard, snapshot = task
                     key = self._shard_prefix(dataset, shard) \
                         + "checkpoints.json"
                     self._uploader_put(
-                        key, json.dumps(snapshot).encode())
-                elif kind == "compact":
-                    _, dataset, shard, bkt = task
-                    self._compact_bucket(dataset, shard, bkt)
+                        key, json.dumps(task[3]).encode())
             except Exception as e:   # never kill the drain loop
+                # fatal (non-transient) failure: nothing landed remotely;
+                # poison the shard so later tasks cannot overtake this one
                 self._upload_errors.append(f"{task[0]}: {e!r}")
+                self._failed.add((task[1], task[2]))
             finally:
                 self._queue.task_done()
 
@@ -477,6 +515,7 @@ class ObjectStoreColumnStore(ColumnStore):
                 self.retry_policy.sleep(self.retry_policy.max_backoff_s)
 
     def _put_manifest(self, dataset: str, shard: int) -> None:
+        self._require_writable("_put_manifest")
         with self._lock:
             st = self._states.get((dataset, shard))
             if st is None:
@@ -602,6 +641,7 @@ class ObjectStoreColumnStore(ColumnStore):
 
     def write_chunks(self, dataset, shard, part_key, chunks,
                      ingestion_time):
+        self._require_writable("write_chunks")
         blob = _pk_blob(part_key)
         bkt = self._bucket_of(blob)
         with span("objectstore", op="write_chunks", shard=shard):
@@ -624,6 +664,7 @@ class ObjectStoreColumnStore(ColumnStore):
             self._flush_staged()
 
     def write_part_keys(self, dataset, shard, records):
+        self._require_writable("write_part_keys")
         with span("objectstore", op="write_part_keys", shard=shard):
             with self._lock:
                 st = self._state(dataset, shard)
@@ -644,6 +685,7 @@ class ObjectStoreColumnStore(ColumnStore):
             self._flush_staged()
 
     def delete_part_keys(self, dataset, shard, part_keys):
+        self._require_writable("delete_part_keys")
         with self._lock:
             st = self._state(dataset, shard)
             for pk in part_keys:
@@ -657,6 +699,7 @@ class ObjectStoreColumnStore(ColumnStore):
         self._flush_staged()
 
     def truncate(self, dataset):
+        self._require_writable("truncate")
         self.flush()
         with self._lock:
             for key in [k for k in self._states if k[0] == dataset]:
@@ -674,35 +717,16 @@ class ObjectStoreColumnStore(ColumnStore):
         per-segment runs into one request when the covering range is not
         too sparse.  Every payload is CRC32C-verified against its ref."""
         out: dict[int, bytes] = {}
-        by_seq: dict[int, list[_ChunkRef]] = {}
-        with self._lock:
-            open_by_seq = {o.seq: o for o in st.open.values()}
-            for ref in refs:
-                data = st.pending.get(ref.seq)
-                if data is None:
-                    o = open_by_seq.get(ref.seq)
-                    if o is not None:
-                        data = o.buf.getvalue()
-                if data is not None:
-                    out[ref.chunk_id] = data[ref.offset:ref.offset
-                                             + ref.length]
-                else:
-                    by_seq.setdefault(ref.seq, []).append(ref)
-            keys = {seq: st.segments[seq].key for seq in by_seq}
-        for seq, seq_refs in by_seq.items():
+        groups = self._resolve_refs(st, part_key, refs, out)
+        for key, key_refs in groups.items():
             try:
-                self._ranged_get(keys[seq], seq_refs, out)
+                self._ranged_get(key, key_refs, out)
             except KeyError:
-                # segment swapped out by compaction between the index
-                # read and the GET: re-resolve via the fresh index once
-                with self._lock:
-                    live = st.chunks.get(part_key, {})
-                    cur = [(live.get(r.chunk_id) or r) for r in seq_refs]
-                    by_cur: dict[str, list[_ChunkRef]] = {}
-                    for r in cur:
-                        by_cur.setdefault(st.segments[r.seq].key,
-                                          []).append(r)
-                for k, rs in by_cur.items():
+                # the object itself 404'd: compaction deleted it between
+                # the index snapshot and the GET — re-resolve via the
+                # fresh index once and retry
+                for k, rs in self._resolve_refs(st, part_key, key_refs,
+                                                out).items():
                     self._ranged_get(k, rs, out)
         for ref in refs:
             data = out.get(ref.chunk_id)
@@ -713,6 +737,38 @@ class ObjectStoreColumnStore(ColumnStore):
                     f"chunk {ref.chunk_id} in seg {ref.seq} "
                     f"({dataset}/shard-{shard}): payload CRC32C mismatch")
         return out
+
+    def _resolve_refs(self, st, part_key, refs, out) -> dict:
+        """Under the lock: serve refs living in pending/open segments
+        straight from memory into ``out``; group the rest by live object
+        key for ranged GETs.  A ref whose segment is no longer in the
+        index (compaction swapped it out after the caller snapshotted
+        the refs) is re-resolved against the fresh chunk index instead
+        of being indexed blindly."""
+        groups: dict[str, list[_ChunkRef]] = {}
+        with self._lock:
+            open_by_seq = {o.seq: o for o in st.open.values()}
+            live = st.chunks.get(part_key, {})
+            for ref in refs:
+                if ref.chunk_id in out:
+                    continue
+                if ref.seq not in st.segments \
+                        and ref.seq not in open_by_seq:
+                    ref = live.get(ref.chunk_id) or ref
+                data = st.pending.get(ref.seq)
+                if data is None:
+                    o = open_by_seq.get(ref.seq)
+                    if o is not None:
+                        data = o.buf.getvalue()
+                if data is not None:
+                    out[ref.chunk_id] = data[ref.offset:ref.offset
+                                             + ref.length]
+                elif ref.seq in st.segments:
+                    groups.setdefault(st.segments[ref.seq].key,
+                                      []).append(ref)
+                # else: the chunk vanished entirely (concurrent delete) —
+                # the CRC verification in _fetch_refs reports it
+        return groups
 
     def _ranged_get(self, key: str, seq_refs: list[_ChunkRef],
                     out: dict[int, bytes]) -> None:
@@ -825,6 +881,7 @@ class ObjectStoreColumnStore(ColumnStore):
 
     # ----------------------------------------------------- index snapshots
     def write_index_snapshot(self, dataset, shard, data):
+        self._require_writable("write_index_snapshot")
         key = self._shard_prefix(dataset, shard) + "index.snap"
         with span("objectstore", op="write_snapshot", shard=shard):
             # synchronous (not write-behind): the caller treats a returned
@@ -863,6 +920,7 @@ class ObjectStoreColumnStore(ColumnStore):
     def compact(self, dataset: str, shard: int) -> int:
         """Compact every bucket of the shard now (test/operator hook).
         Returns the number of segments removed."""
+        self._require_writable("compact")
         with self._lock:
             st = self._state(dataset, shard)
             buckets = {s.bucket for s in st.segments.values() if s.uploaded}
@@ -952,12 +1010,22 @@ class ObjectStoreColumnStore(ColumnStore):
     # ------------------------------------------------------------ lifecycle
     def flush(self) -> None:
         """Seal all open segments and drain the upload queue (blocks
-        until everything staged so far is durably uploaded)."""
+        until everything staged so far is durably uploaded).  Raises
+        :class:`ObjectStoreError` if any upload failed fatally — a
+        returned flush() is the durability ack, so it must never report
+        success over lost data."""
         with self._lock:
             for (dataset, shard), st in self._states.items():
                 self._seal_all(st, dataset, shard)
         self._flush_staged()
         self._queue.join()
+        if self._failed:
+            shards = ", ".join(f"{d}/shard-{s}"
+                               for d, s in sorted(self._failed))
+            raise ObjectStoreError(
+                f"write-behind upload failed fatally for {shards}; "
+                "flushed data is NOT durable: "
+                + "; ".join(self._upload_errors[-3:]))
 
     def upload_errors(self) -> list[str]:
         return list(self._upload_errors)
@@ -993,6 +1061,10 @@ class HttpS3Client:
     # -- SigV4 ------------------------------------------------------------
     def _sign(self, method: str, path: str, query: str, headers: dict,
               payload: bytes) -> dict:
+        """``query`` must already be in canonical form (see
+        :func:`_canon_query`) — the same string goes into the signed
+        canonical request and the request URL, so they cannot
+        disagree."""
         import datetime
         import hashlib
         import hmac
@@ -1033,11 +1105,12 @@ class HttpS3Client:
             f"SignedHeaders={';'.join(signed)}, Signature={sig}")
         return headers
 
-    def _request(self, method: str, key: str, query: str = "",
+    def _request(self, method: str, key: str, params: dict | None = None,
                  data: bytes = b"", headers: dict | None = None) -> bytes:
         import urllib.error
         import urllib.request
         path = "/" + key
+        query = _canon_query(params) if params else ""
         headers = self._sign(method, path, query, headers or {}, data)
         url = self.endpoint + path + ("?" + query if query else "")
         req = urllib.request.Request(url, data=data or None, method=method,
@@ -1075,16 +1148,15 @@ class HttpS3Client:
             pass
 
     def list_objects(self, prefix: str = "") -> list[str]:
-        import urllib.parse as up
         import xml.etree.ElementTree as ET
         bucket, _, rest = prefix.partition("/")
         out: list[str] = []
         token = None
         while True:
-            q = f"list-type=2&prefix={up.quote(rest)}"
+            params = {"list-type": "2", "prefix": rest}
             if token:
-                q += f"&continuation-token={up.quote(token)}"
-            xml = self._request("GET", bucket, query=q)
+                params["continuation-token"] = token
+            xml = self._request("GET", bucket, params=params)
             root = ET.fromstring(xml)
             ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
             for c in root.iter(f"{ns}Key"):
@@ -1093,6 +1165,18 @@ class HttpS3Client:
             token = root.findtext(f"{ns}NextContinuationToken")
             if not trunc or not token:
                 return out
+
+
+def _canon_query(params: dict | None) -> str:
+    """SigV4 canonical query string: keys and values percent-encoded
+    with the RFC 3986 unreserved set only (``/`` becomes ``%2F``),
+    pairs sorted by encoded key.  Valid as-is in the request URL."""
+    import urllib.parse as up
+    if not params:
+        return ""
+    pairs = sorted((up.quote(str(k), safe=""), up.quote(str(v), safe=""))
+                   for k, v in params.items())
+    return "&".join(f"{k}={v}" for k, v in pairs)
 
 
 def _orig(headers: dict, lower: str) -> str:
@@ -1145,6 +1229,7 @@ class ObjectStoreMetaStore(MetaStore):
 
     def write_checkpoint(self, dataset, shard, group, offset):
         cs = self.cs
+        cs._require_writable("write_checkpoint")
         with span("objectstore", op="write_checkpoint", shard=shard):
             with cs._lock:
                 st = cs._state(dataset, shard)

@@ -20,7 +20,9 @@ from filodb_tpu.core.store.localstore import _pk_blob
 from filodb_tpu.core.store.objectstore import (
     CorruptSegmentError,
     ObjectStoreColumnStore,
+    ObjectStoreError,
     ObjectStoreMetaStore,
+    _canon_query,
     crc32c,
     open_object_store,
     parse_segment,
@@ -207,6 +209,54 @@ class TestWriteBehind:
         assert len(cs2.read_chunks(DS, 0, pk, 0, 2**62)) == 1
         cs2.close()
 
+    def test_fatal_upload_failure_parks_checkpoint_and_flush_raises(
+            self, tmp_path):
+        """A non-transient segment upload failure (S3 403/400 analog)
+        must not let the checkpoint FIFO-queued behind it become visible
+        remotely, and flush() must surface the loss instead of acking
+        it — otherwise crash recovery trusts the checkpoint and the
+        acked flush is silently lost."""
+        s3 = FakeS3(root=str(tmp_path / "s3"))
+        s3.inject("put", times=1, exc=ObjectStoreError("403 AccessDenied"))
+        cs = _mk(s3)
+        meta = ObjectStoreMetaStore(cs)
+        pk = _pk(0)
+        cs.write_chunks(DS, 0, pk, [_chunk(1)], ingestion_time=1)
+        meta.write_checkpoint(DS, 0, 0, 99)
+        with pytest.raises(ObjectStoreError):
+            cs.flush()
+        assert cs.upload_errors()
+        # neither the segment nor the checkpoint behind it landed
+        keys = s3.list_objects("")
+        assert not any(k.endswith(".seg") for k in keys)
+        assert not any(k.endswith("checkpoints.json") for k in keys)
+        with pytest.raises(ObjectStoreError):
+            cs.close()
+        # recovery sees the pre-failure remote state: no checkpoint to
+        # trust, so WAL replay re-covers the whole gap
+        cs2 = _mk(FakeS3(root=str(tmp_path / "s3")))
+        assert ObjectStoreMetaStore(cs2).read_checkpoints(DS, 0) == {}
+        assert cs2.read_chunks(DS, 0, pk, 0, 2**62) == []
+        cs2.close()
+
+    def test_fatal_failure_in_one_shard_spares_others(self):
+        s3 = FakeS3()
+        cs = _mk(s3)
+        meta = ObjectStoreMetaStore(cs)
+        pk = _pk(0)
+        cs.write_chunks(DS, 0, pk, [_chunk(1)], ingestion_time=1)
+        s3.inject("put", times=1, exc=ObjectStoreError("403"))
+        meta.write_checkpoint(DS, 0, 0, 7)    # shard 0 segment put fails
+        cs.write_chunks(DS, 1, pk, [_chunk(1)], ingestion_time=1)
+        meta.write_checkpoint(DS, 1, 0, 8)    # shard 1 is unaffected
+        with pytest.raises(ObjectStoreError):
+            cs.flush()
+        keys = s3.list_objects("")
+        assert any("shard-1" in k and k.endswith("checkpoints.json")
+                   for k in keys)
+        assert not any("shard-0" in k and k.endswith("checkpoints.json")
+                       for k in keys)
+
     def test_read_your_writes_before_upload(self):
         """Pending/open segments serve reads from memory — no GETs."""
         s3 = FakeS3(latency_s=0)
@@ -292,6 +342,23 @@ class TestCompaction:
             list(range(1, 9))
         cs2.close()
 
+    def test_stale_refs_after_compaction_swap_re_resolve(self):
+        """Refs snapshotted before a compaction swaps the index must be
+        re-resolved against the fresh index, not KeyError on the
+        vanished segment seq (read/compaction race)."""
+        cs = _mk(bucket_count=1, auto_compact=False)
+        pk = _pk(0)
+        for i in range(4):
+            cs.write_chunks(DS, 0, pk, [_chunk(i + 1)], ingestion_time=i)
+            cs.flush()
+        st = cs._state(DS, 0)
+        with cs._lock:
+            stale = sorted(st.chunks[pk].values(), key=lambda r: r.chunk_id)
+        assert cs.compact(DS, 0) >= 1   # swaps the index, deletes olds
+        payloads = cs._fetch_refs(DS, 0, st, pk, stale)
+        assert sorted(payloads) == [1, 2, 3, 4]
+        cs.close()
+
     def test_compaction_drops_tombstoned_entries(self):
         s3 = FakeS3()
         cs = _mk(s3, bucket_count=1, auto_compact=False)
@@ -361,6 +428,38 @@ class TestSplitScans:
             assert info.bucket % 4 == 0
         reader.close()
 
+    def test_split_view_is_read_only(self, tmp_path):
+        """A split view's index holds a filtered segment set; any write
+        would republish the manifest from it and permanently drop the
+        foreign buckets' segments — so every write entry point raises."""
+        s3root = str(tmp_path / "s3")
+        cs = _mk(FakeS3(root=s3root), bucket_count=8)
+        self._fill(cs)
+        cs.close()
+        reader = _mk(FakeS3(root=s3root), bucket_count=8)
+        reader.restrict_to_split(0, 4)
+        pk = _pk(0)
+        with pytest.raises(ObjectStoreError):
+            reader.write_chunks(DS, 0, pk, [_chunk(9)], ingestion_time=9)
+        with pytest.raises(ObjectStoreError):
+            reader.write_part_keys(DS, 0, [PartKeyRecord(pk, 0, 1)])
+        with pytest.raises(ObjectStoreError):
+            reader.delete_part_keys(DS, 0, [pk])
+        with pytest.raises(ObjectStoreError):
+            reader.write_index_snapshot(DS, 0, b"x")
+        with pytest.raises(ObjectStoreError):
+            reader.truncate(DS)
+        with pytest.raises(ObjectStoreError):
+            reader.compact(DS, 0)
+        with pytest.raises(ObjectStoreError):
+            ObjectStoreMetaStore(reader).write_checkpoint(DS, 0, 0, 1)
+        # reads still work, and the full store is untouched
+        assert reader.scan_part_keys_split(DS, 0, 0, 4)
+        reader.close()
+        full = _mk(FakeS3(root=s3root), bucket_count=8)
+        assert len(full.scan_part_keys(DS, 0)) == 32
+        full.close()
+
     def test_repair_jobs_fan_out_over_splits(self):
         from filodb_tpu.core.store.repair import PartitionKeysCopier
         src, dst = _mk(bucket_count=8), _mk(bucket_count=8)
@@ -394,6 +493,53 @@ class TestConcurrency:
             assert [c.id for c in cs.read_chunks(DS, 0, pk, 0, 2**62)] == \
                 [1, 2, 3, 4, 5]
         cs.close()
+
+
+class TestSigV4:
+    def test_canonical_query_sorted_and_slash_encoded(self):
+        # AWS SigV4: params sorted by key, '/' in values %2F-encoded —
+        # an unsorted or verbatim query signs a different string than
+        # the service canonicalizes → SignatureDoesNotMatch
+        q = _canon_query({"prefix": "demo/timeseries/shard-0/",
+                          "list-type": "2",
+                          "continuation-token": "a+b/c"})
+        assert q == ("continuation-token=a%2Bb%2Fc&list-type=2"
+                     "&prefix=demo%2Ftimeseries%2Fshard-0%2F")
+        assert _canon_query({}) == ""
+        assert _canon_query(None) == ""
+
+    def test_signed_list_uses_canonical_query(self, monkeypatch):
+        from filodb_tpu.core.store.objectstore import HttpS3Client
+        client = HttpS3Client("http://s3.local", access_key="AK",
+                              secret_key="SK")
+        seen = []
+
+        class _Resp:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return (b"<ListBucketResult>"
+                        b"<IsTruncated>false</IsTruncated>"
+                        b"</ListBucketResult>")
+
+        def fake_urlopen(req, timeout=None):
+            seen.append(req)
+            return _Resp()
+
+        import urllib.request
+        monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+        client.list_objects("bucket/demo/timeseries/")
+        (req,) = seen
+        # the URL carries the same canonical (sorted, %2F-encoded) query
+        # that was signed
+        assert req.full_url.endswith(
+            "/bucket?list-type=2&prefix=demo%2Ftimeseries%2F")
+        assert req.get_header("Authorization", "").startswith(
+            "AWS4-HMAC-SHA256")
 
 
 class TestFactory:
