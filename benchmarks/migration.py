@@ -1,0 +1,132 @@
+"""Migration-under-load soak: query latency while a shard live-migrates.
+
+A two-node cluster serves a steady closed-loop query workload; midway
+through, one shard is migrated node-a → node-b through the full
+PLANNED → SYNCING → CATCHUP → FLIPPING → DONE state machine. The
+property being demonstrated: the HANDOFF queryability rule keeps the
+shard answering on the source until the atomic flip, so p99 during the
+migration stays within a small factor of baseline and NO query returns a
+wrong result (every result is checked against a pre-migration control).
+
+    python benchmarks/migration.py           # standalone, one JSON line
+    python benchmarks/run_benchmarks.py --only migration
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+N_SERIES = 24
+N_SAMPLES = 240
+
+QUERY = 'sum(heap_usage{_ns_="App-0"})'
+QS, STEP, QE = START + 600, 300, START + 1500
+
+BASELINE_SECONDS = 1.5
+SOAK_CLIENTS = 4
+
+
+def _p(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))] if xs else float("nan")
+
+
+def _build():
+    from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+    from filodb_tpu.coordinator.ingestion import route_container
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.api import (
+        InMemoryColumnStore,
+        InMemoryMetaStore,
+    )
+    from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+    from filodb_tpu.kafka.log import InMemoryLog
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
+    keys = machine_metrics_series(N_SERIES)
+    for sd in gauge_stream(keys, N_SAMPLES, start_ms=START * 1000):
+        for shard, cont in route_container(sd.container, NUM_SHARDS,
+                                           1).items():
+            logs[shard].append(cont)
+    cluster = FilodbCluster()
+    for n in ("node-a", "node-b"):
+        cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
+    cluster.setup_dataset(
+        IngestionConfig("timeseries", NUM_SHARDS, min_num_nodes=2,
+                        store=StoreConfig(max_chunk_size=120,
+                                          groups_per_shard=2)), logs)
+    assert cluster.wait_active("timeseries", 15)
+    return cluster
+
+
+def bench_migration():
+    import numpy as np
+
+    cluster = _build()
+    svc = cluster.query_service("timeseries", spread=1)
+    control = svc.query_range(QUERY, QS, STEP, QE).result.values
+    sm = cluster.shard_managers["timeseries"]
+    shard = next(s for s in range(NUM_SHARDS)
+                 if sm.mapper.node_for(s) == "node-a")
+
+    lock = threading.Lock()
+    lat, wrong = {"baseline": [], "migrating": []}, [0]
+    phase = ["baseline"]
+    running = [True]
+
+    def client():
+        while running[0]:
+            t0 = time.perf_counter()
+            vals = svc.query_range(QUERY, QS, STEP, QE).result.values
+            dt = time.perf_counter() - t0
+            ok = np.allclose(vals, control, rtol=1e-9)
+            with lock:
+                lat[phase[0]].append(dt)
+                if not ok:
+                    wrong[0] += 1
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(SOAK_CLIENTS)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(BASELINE_SECONDS)
+        with lock:
+            phase[0] = "migrating"
+        t0 = time.perf_counter()
+        mig = cluster.migrate_shard("timeseries", shard, "node-b")
+        mig_s = time.perf_counter() - t0
+        assert mig.phase == "done"
+    finally:
+        running[0] = False
+        for t in threads:
+            t.join(timeout=30)
+    cluster.stop()
+
+    base, soak = lat["baseline"], lat["migrating"]
+    base_p99, soak_p99 = _p(base, 0.99) * 1e3, _p(soak, 0.99) * 1e3
+    return {"metric": "migration_soak", "clients": SOAK_CLIENTS,
+            "migration_s": round(mig_s, 3),
+            "baseline_p50_ms": round(_p(base, 0.5) * 1e3, 2),
+            "baseline_p99_ms": round(base_p99, 2),
+            "migrating_p50_ms": round(_p(soak, 0.5) * 1e3, 2),
+            "migrating_p99_ms": round(soak_p99, 2),
+            "p99_blowup_x": round(soak_p99 / base_p99, 2)
+            if base_p99 else float("nan"),
+            "queries_during_migration": len(soak),
+            "wrong_results": wrong[0],
+            "unit": "ms"}
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_migration()))

@@ -468,6 +468,12 @@ def _bench_overload():
     return bench_overload()
 
 
+def _bench_migration():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from migration import bench_migration
+    return bench_migration()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -484,6 +490,7 @@ ALL = {
     "dist_agg": _bench_dist_agg,
     "overload": _bench_overload,
     "objectstore": _bench_objectstore,
+    "migration": _bench_migration,
 }
 
 

@@ -71,6 +71,32 @@ def cmd_status(args):
         print(json.dumps(json.load(r), indent=2))
 
 
+def cmd_shardmap(args):
+    """Shard map with migration phases + per-tenant quota usage: one table
+    answering "where is every shard, is anything moving, and which tenants
+    are near their limits" (``/api/v1/cluster/{dataset}/shardmap``)."""
+    import urllib.request
+    url = f"http://{args.host}/api/v1/cluster/{args.dataset}/shardmap"
+    with urllib.request.urlopen(url) as r:
+        doc = json.load(r)["data"]
+    print(f"{'SHARD':>5}  {'NODE':<16} {'STATUS':<10} MIGRATION")
+    for entry in doc.get("shards", []):
+        mig = entry.get("migration")
+        migs = (f"{mig['phase']} {mig['source']}->{mig['dest']} "
+                f"lag={mig['lag']}" if mig else "-")
+        print(f"{entry['shard']:>5}  {str(entry.get('node')):<16} "
+              f"{entry.get('status', '?'):<10} {migs}")
+    tenants = doc.get("tenants", [])
+    if tenants:
+        print(f"\n{'TENANT':<24} {'SERIES':>10} {'QUOTA':>10} "
+              f"{'MAX_INFLIGHT':>12}")
+        for t in tenants:
+            quota = t["max_series"] or "-"
+            infl = t["max_inflight"] or "-"
+            print(f"{t['tenant']:<24} {t['active_series']:>10} "
+                  f"{str(quota):>10} {str(infl):>12}")
+
+
 def cmd_indexnames(args):
     cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
@@ -323,6 +349,7 @@ def main(argv=None):
     p = sub.add_parser("list")
     p.add_argument("--limit", type=int, default=20)
     sub.add_parser("status")
+    sub.add_parser("shardmap")
     sub.add_parser("indexnames")
     p = sub.add_parser("labelvalues")
     p.add_argument("label")
@@ -352,6 +379,7 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
+            "shardmap": cmd_shardmap,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
             "importcsv": cmd_importcsv, "promql": cmd_promql,
             "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,

@@ -67,6 +67,20 @@ DEFAULTS = {
         "max_result_bytes": 0,
         "max_group_cardinality": 0,
         "budget_degrade": "partial",  # "partial" | "error"
+        # per-tenant admission classes + cardinality quotas keyed on the
+        # _ws_ or _ws_/_ns_ shard-key prefix, e.g.
+        #   "tenants": {"demo/App-0": {"max_inflight": 8,
+        #                              "max_series": 100000}}
+        # a flooding tenant sheds ONLY itself (reject reason "tenant" /
+        # quota-dropped ingest), never its neighbors
+        "tenants": {},
+    },
+    # live shard migration / rebalancing (coordinator/migration.py)
+    "migration": {
+        "auto_rebalance": False,      # migrate shards off joining-node
+                                      # imbalance and watchdog pressure
+        "lag_threshold": 0,           # max replay-offset lag at flip
+        "catchup_timeout_s": 30.0,    # abort CATCHUP after this long
     },
     # durable-store backend selection. "local" = sqlite-per-shard on
     # data_dir (default); "object" = S3-compatible object-store tier
@@ -138,6 +152,7 @@ class ServerConfig:
     result_cache: dict = field(default_factory=dict)  # ResultCacheConfig block
     governor: dict = field(default_factory=dict)  # GovernorConfig overrides
     store: dict = field(default_factory=dict)  # durable-store backend block
+    migration: dict = field(default_factory=dict)  # live-migration knobs
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -182,7 +197,8 @@ class ServerConfig:
             engines=engines, resilience=cfg.get("resilience", {}),
             result_cache=cfg.get("result_cache", {}),
             governor=cfg.get("governor", {}),
-            store=cfg.get("store", {}))
+            store=cfg.get("store", {}),
+            migration=cfg.get("migration", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

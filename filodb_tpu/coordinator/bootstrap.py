@@ -190,6 +190,17 @@ class RemoteNodeHandle:
     def shard_status(self, dataset: str) -> list[tuple[int, str]]:
         return self._client.call("shard_status", dataset)
 
+    def prepare_handoff(self, dataset: str, shard: int) -> int:
+        """Migration SYNC on a remote source: flush, drain durable
+        writes, snapshot the index; returns the shard's replay offset."""
+        return self._client.call("prepare_handoff", dataset, shard)
+
+    def shard_offset(self, dataset: str, shard: int) -> int:
+        try:
+            return self._client.call("shard_offset", dataset, shard)
+        except (ConnectionError, OSError, RuntimeError):
+            return -1
+
     def owned_shards(self, dataset: str) -> list[int]:
         try:
             return [s for s, _ in self.shard_status(dataset)]

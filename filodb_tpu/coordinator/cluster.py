@@ -27,6 +27,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from filodb_tpu.coordinator.migration import MigrationError, ShardMigration
 from filodb_tpu.coordinator.planner import SingleClusterPlanner
 from filodb_tpu.coordinator.query_service import QueryService
 from filodb_tpu.coordinator.shard_manager import ShardManager
@@ -81,6 +82,12 @@ class Node:
         key = (dataset, shard)
         if key in self._workers:
             return
+        # a migration destination may hold a stale cached view of this
+        # shard's durable state from before the source's upload — re-read
+        # the remote manifest before recovering (no-op on other backends)
+        refresh = getattr(self.memstore.column_store, "refresh_shard", None)
+        if callable(refresh):
+            refresh(dataset, shard)
         try:
             self.memstore.setup(dataset, shard, config.store)
         except ValueError:
@@ -160,6 +167,44 @@ class Node:
         if w:
             w.stop()
         self.memstore.teardown(dataset, shard)
+
+    # -- live migration (coordinator/migration.py source/destination API) --
+
+    def prepare_handoff(self, dataset: str, shard: int) -> int:
+        """Source side of a migration's SYNCING phase: flush every group
+        (sealed segments ride the column store's write-behind path), drain
+        the upload queue (the durability ack), and snapshot the index so
+        the destination cold-recovers warm. Returns the source's latest
+        ingested offset."""
+        s = self.memstore.get_shard(dataset, shard)
+        s.flush_all()
+        FaultInjector.fire("migration.sync.upload", node=self.name,
+                           dataset=dataset, shard=shard)
+        flush = getattr(self.memstore.column_store, "flush", None)
+        if callable(flush):
+            flush()  # write-behind drain: raises if an upload failed
+        FaultInjector.fire("migration.sync.checkpoint.before",
+                           node=self.name, dataset=dataset, shard=shard)
+        s.snapshot_index()
+        FaultInjector.fire("migration.sync.checkpoint.after",
+                           node=self.name, dataset=dataset, shard=shard)
+        return s.latest_offset
+
+    def shard_offset(self, dataset: str, shard: int) -> int:
+        """Latest log offset this shard COVERS (-1 when not resident) —
+        the migration's catch-up lag probe. A freshly-recovered shard that
+        has replayed nothing still covers everything below its recovered
+        group watermarks (every group is flushed through its checkpoint),
+        so the covered offset is max(ingested, min over group
+        watermarks) — without the watermark term, a destination with no
+        reachable ingest tail would report -1 forever despite holding all
+        of the source's flushed data."""
+        try:
+            s = self.memstore.get_shard(dataset, shard)
+        except KeyError:
+            return -1
+        recovered = min(s.group_watermarks) if s.group_watermarks else -1
+        return max(s.latest_offset, recovered)
 
     def kill(self) -> None:
         """Simulate process death (multi-jvm kill tests)."""
@@ -386,6 +431,12 @@ class FilodbCluster:
     # reference's phi-accrual detector likewise tolerates transient misses)
     failure_threshold: int = 3
     on_heartbeat: list = field(default_factory=list)  # callbacks per tick
+    # live migrations in flight, keyed (dataset, shard); auto_rebalance
+    # triggers them on node join (config "migration" block)
+    migrations: dict = field(default_factory=dict)
+    auto_rebalance: bool = False
+    migration_lag_threshold: int = 0
+    migration_catchup_timeout_s: float = 30.0
     _hb_misses: dict = field(default_factory=dict)
     _hb_thread: threading.Thread | None = None
     _stop_hb: threading.Event = field(default_factory=threading.Event)
@@ -402,6 +453,13 @@ class FilodbCluster:
         for dataset, sm in self.shard_managers.items():
             for ev in sm.add_member(node.name):
                 self._on_event(dataset, ev)
+        if self.auto_rebalance and self.shard_managers:
+            # level shard counts onto the joiner via live migrations, off
+            # the caller's thread (a handoff blocks through catch-up)
+            threading.Thread(
+                target=lambda: [self.maybe_rebalance(d)
+                                for d in list(self.shard_managers)],
+                daemon=True, name=f"rebalance-{node.name}").start()
 
     def leave(self, name: str) -> None:
         node = self.nodes.pop(name, None)
@@ -446,6 +504,78 @@ class FilodbCluster:
             node.start_shard(dataset, ev.shard, config,
                              self.logs[(dataset, ev.shard)], on_status)
 
+    # -- live migration / rebalancing --
+
+    def _migration_store(self):
+        """The shared column store migration manifests persist beside —
+        any in-process member's view of it (all members share one durable
+        tier)."""
+        for node in self.nodes.values():
+            ms = getattr(node, "memstore", None)
+            if ms is not None:
+                return ms.column_store
+        raise MigrationError("no in-process column store for the "
+                             "migration manifest; pass store= explicitly")
+
+    def migrate_shard(self, dataset: str, shard: int, dest: str,
+                      store=None, **kw) -> ShardMigration:
+        """Move one shard to ``dest`` through the crash-safe state machine
+        (blocks until DONE; run in a thread for live traffic). The source
+        is the current owner from the shard map."""
+        sm = self.shard_managers[dataset]
+        source = sm.mapper.node_for(shard)
+        if source is None:
+            raise MigrationError(f"shard {shard} has no owner to migrate "
+                                 "from")
+        kw.setdefault("lag_threshold", self.migration_lag_threshold)
+        kw.setdefault("catchup_timeout_s", self.migration_catchup_timeout_s)
+        mig = ShardMigration(self, store or self._migration_store(),
+                             dataset, shard, source, dest, **kw)
+        self.migrations[(dataset, shard)] = mig
+        try:
+            return mig.run()
+        finally:
+            if mig.phase in ("done", "aborted"):
+                self.migrations.pop((dataset, shard), None)
+
+    def resume_migration(self, dataset: str, shard: int, store=None,
+                         **kw) -> ShardMigration | None:
+        """Continue a migration whose driver crashed, from its durable
+        manifest."""
+        return ShardMigration.resume(self, store or self._migration_store(),
+                                     dataset, shard, **kw)
+
+    def maybe_rebalance(self, dataset: str, overloaded: str | None = None,
+                        min_imbalance: int = 2) -> list[ShardMigration]:
+        """Run the planned rebalance moves (node join levels shard counts;
+        ``overloaded`` sheds away from a pressured node). One migration at
+        a time per dataset — a handoff is heavyweight."""
+        sm = self.shard_managers.get(dataset)
+        if sm is None:
+            return []
+        done = []
+        for shard, src, dst in sm.plan_rebalance(overloaded, min_imbalance):
+            if (dataset, shard) in self.migrations:
+                continue
+            try:
+                done.append(self.migrate_shard(dataset, shard, dst))
+            except Exception:
+                get_counter("filodb_shard_migration_errors",
+                            {"dataset": dataset}).inc()
+                log.exception("rebalance migration of %s/%d %s -> %s "
+                              "failed", dataset, shard, src, dst)
+                break
+        return done
+
+    def shed_load(self, node_name: str) -> list[ShardMigration]:
+        """MemoryWatchdog overload trigger: move one shard off the
+        pressured node per dataset, even when counts are level."""
+        out = []
+        for dataset in list(self.shard_managers):
+            out += self.maybe_rebalance(dataset, overloaded=node_name,
+                                        min_imbalance=1)
+        return out
+
     # -- failure detection --
 
     def start_failure_detector(self) -> None:
@@ -468,6 +598,16 @@ class FilodbCluster:
                                 "(%d missed heartbeats)", name, misses)
                     self.leave(name)
                     self._hb_misses.pop(name, None)
+            # membership check: rate-limit-deferred shards whose interval
+            # elapsed get reassigned now, not on the next unrelated event
+            for dataset, sm in list(self.shard_managers.items()):
+                for ev in sm.check_deferred():
+                    try:
+                        self._on_event(dataset, ev)
+                    except Exception:
+                        get_counter("filodb_heartbeat_errors").inc()
+                        log.exception("deferred reassignment of %s/%d "
+                                      "failed", dataset, ev.shard)
             for cb in self.on_heartbeat:
                 try:
                     cb()
@@ -520,6 +660,11 @@ class FilodbCluster:
         svc.planner = SingleClusterPlanner(
             dataset, self.configs[dataset].num_shards, spread,
             dispatcher_for_shard=dispatcher_for_shard)
+        svc.shard_status_fn = lambda: [
+            (s, sm.mapper.statuses[s].name.lower())
+            for s in range(sm.num_shards)
+            if sm.mapper.statuses[s] in (ShardStatus.RECOVERY,
+                                         ShardStatus.HANDOFF)]
         return svc
 
     def shard_statuses(self, dataset: str) -> list[dict]:

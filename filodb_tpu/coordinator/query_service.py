@@ -17,7 +17,13 @@ from filodb_tpu.promql.parser import TimeStepParams, parse_query
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.exec.plan import ExecContext
 from filodb_tpu.query.model import QueryContext, QueryResult
-from filodb_tpu.utils.governor import CHEAP, EXPENSIVE, default_budget, governor
+from filodb_tpu.utils.governor import (
+    CHEAP,
+    EXPENSIVE,
+    default_budget,
+    governor,
+    tenant_of,
+)
 from filodb_tpu.utils.metrics import Histogram, get_counter
 from filodb_tpu.utils.resilience import Deadline
 from filodb_tpu.utils.resilience import config as resilience_config
@@ -56,6 +62,35 @@ def _admission_cost(plan) -> str:
     return EXPENSIVE
 
 
+def plan_tenant(plan) -> str:
+    """Tenant id (``ws/ns``) from the first selector's ``_ws_``/``_ns_``
+    equality filters — keys the governor's per-tenant inflight gate. Empty
+    string (untenanted/unmatchable plan shapes) means no tenant gating."""
+    import dataclasses
+
+    from filodb_tpu.core.filters import Equals
+    stack, seen = [plan], 0
+    while stack and seen < 64:
+        p = stack.pop()
+        seen += 1
+        filters = getattr(p, "filters", None)
+        if filters:
+            labels = {}
+            for cf in filters:
+                f = getattr(cf, "filter", None)
+                if getattr(cf, "column", None) in ("_ws_", "_ns_") \
+                        and isinstance(f, Equals):
+                    labels[cf.column] = str(f.value)
+            if labels:
+                return tenant_of(labels)
+        if dataclasses.is_dataclass(p):
+            for fld in dataclasses.fields(p):
+                v = getattr(p, fld.name, None)
+                if dataclasses.is_dataclass(v) and not isinstance(v, type):
+                    stack.append(v)
+    return ""
+
+
 @dataclass
 class QueryService:
     memstore: TimeSeriesMemStore
@@ -79,6 +114,11 @@ class QueryService:
     # ResultCacheConfig / ResultCache / True enables it; None or False
     # disables. Sits in front of exec, mesh, and adaptive engines alike.
     result_cache: object = None
+    # callable () -> [(shard, status_str)] for queryable-but-not-ACTIVE
+    # shards (recovery/handoff); results touching them carry a warning so
+    # callers know the answer may lag the live shard (never wrong, at most
+    # behind the in-flight tail). Wired by cluster/standalone.
+    shard_status_fn: object = None
     planner: SingleClusterPlanner = field(init=False)
 
     # monotonic construction serial: response-cache keys must survive a
@@ -307,7 +347,8 @@ class QueryService:
         # admission gate: single choke point for the mesh and exec engines
         # (and the cache's per-extent sub-queries); over-capacity queries
         # wait bounded by the deadline, then shed with QueryRejected (503)
-        with governor().admit(deadline=deadline, cost=_admission_cost(plan)):
+        with governor().admit(deadline=deadline, cost=_admission_cost(plan),
+                              tenant=plan_tenant(plan)):
             if self.mesh_engine is not None and self._mesh_eligible() \
                     and self.mesh_engine.supports(plan):
                 from filodb_tpu.query.model import QueryStats
@@ -332,9 +373,10 @@ class QueryService:
                     data = apply_result_budget(data, shim)
                     stats.wall_time_s = time.perf_counter() - t0
                     stats.result_series = data.num_series
-                    return QueryResult(data, stats, qcontext.query_id,
-                                       partial=shim.partial,
-                                       warnings=shim.warnings)
+                    return self._attach_recovery_warnings(
+                        QueryResult(data, stats, qcontext.query_id,
+                                    partial=shim.partial,
+                                    warnings=shim.warnings))
             from filodb_tpu.utils.tracing import span
             with span("plan-materialize"):
                 exec_plan = self.planner.materialize(plan, qcontext)
@@ -362,6 +404,25 @@ class QueryService:
         result.stats.result_series = result.result.num_series
         if result.partial:
             partial_results.inc()
+        return self._attach_recovery_warnings(result)
+
+    def _recovery_warnings(self) -> list[str]:
+        """One warning per queryable-but-catching-up shard (recovery replay
+        or live-migration handoff) — satellite rule: queries during
+        migration are correct or *flagged*, never silently stale."""
+        fn = self.shard_status_fn
+        if fn is None:
+            return []
+        try:
+            return [f"shard {shard} recovering ({status}): results may "
+                    f"lag live ingest" for shard, status in fn()]
+        except Exception:
+            return []
+
+    def _attach_recovery_warnings(self, result: QueryResult) -> QueryResult:
+        for w in self._recovery_warnings():
+            if w not in result.warnings:
+                result.warnings.append(w)
         return result
 
     def _mesh_eligible(self) -> bool:

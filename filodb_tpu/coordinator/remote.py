@@ -217,13 +217,17 @@ class PlanExecutorServer:
                 # many coordinators can't stampede this peer. A shed is a
                 # typed verdict, not an error — the dispatcher re-raises it
                 # as QueryRejected without counting a breaker failure.
+                from filodb_tpu.coordinator.query_service import plan_tenant
                 from filodb_tpu.utils.governor import (
                     EXPENSIVE,
                     QueryRejected,
                     governor,
                 )
                 try:
-                    with governor().admit(cost=EXPENSIVE):
+                    # tenant extracted from the exec plan's leaf filters so
+                    # per-tenant inflight caps hold on remote leaves too
+                    with governor().admit(cost=EXPENSIVE,
+                                          tenant=plan_tenant(plan)):
                         ctx = ExecContext(self.memstore, dataset,
                                           qcontext or QueryContext())
                         result = plan.execute(ctx)

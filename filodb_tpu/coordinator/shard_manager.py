@@ -70,6 +70,9 @@ class ShardManager:
     subscribers: list = field(default_factory=list)
     _nodes: list[str] = field(default_factory=list)
     _last_reassign: dict[int, float] = field(default_factory=dict)
+    # shards whose reassignment was rate-limited: retried by
+    # check_deferred() once reassignment_min_interval_s elapses
+    _deferred: set[int] = field(default_factory=set)
     # sequenced event log for remote subscribers (reference StatusActor
     # ack/resync, ``StatusActor.scala:41``): followers poll with their last
     # -seen sequence; a gap beyond the retained window forces a resync
@@ -94,11 +97,18 @@ class ShardManager:
         if node in self._nodes:
             return []
         self._nodes.append(node)
-        return self._assign()
+        # a join is a membership check: deferred (rate-limited) shards whose
+        # interval has elapsed rejoin the assignable pool first
+        events = self.check_deferred()
+        events += self._assign()
+        return events
 
     def remove_member(self, node: str) -> list[ShardEvent]:
         """Node lost: mark its shards down, then reassign (rate-limited)
-        (reference ``removeMember`` → ``MemberRemoved`` handling)."""
+        (reference ``removeMember`` → ``MemberRemoved`` handling). A shard
+        inside its rate-limit interval is NOT dropped on the floor: it is
+        recorded in ``_deferred`` and reassigned by :meth:`check_deferred`
+        on the next membership check once the interval elapses."""
         if node not in self._nodes:
             return []
         self._nodes.remove(node)
@@ -108,14 +118,33 @@ class ShardManager:
             events.append(self._publish(ShardEvent(shard, ShardStatus.DOWN,
                                                    None)))
         if len(self._nodes) >= self.min_num_nodes:
-            for shard, ev in [(e.shard, e) for e in events]:
+            for shard in [e.shard for e in events]:
                 last = self._last_reassign.get(shard, 0.0)
                 if now - last < self.reassignment_min_interval_s:
-                    log.warning("shard %d reassignment rate-limited", shard)
+                    log.warning("shard %d reassignment rate-limited; "
+                                "deferred for retry", shard)
+                    self._deferred.add(shard)
                     continue
                 self._last_reassign[shard] = now
             events += self._assign()
         return events
+
+    def check_deferred(self) -> list[ShardEvent]:
+        """Reassign rate-limited shards whose interval has elapsed. Called
+        from every membership change and heartbeat tick, so a deferred
+        shard no longer waits for an unrelated membership event."""
+        if not self._deferred:
+            return []
+        now = time.monotonic()
+        ready = [s for s in self._deferred
+                 if now - self._last_reassign.get(s, 0.0)
+                 >= self.reassignment_min_interval_s]
+        if not ready or len(self._nodes) < self.min_num_nodes:
+            return []
+        for s in ready:
+            self._deferred.discard(s)
+            self._last_reassign[s] = now
+        return self._assign()
 
     @property
     def nodes(self) -> list[str]:
@@ -134,12 +163,64 @@ class ShardManager:
         """Assign any unassigned shards to current members."""
         return self._assign()
 
+    def plan_rebalance(self, overloaded: str | None = None,
+                       min_imbalance: int = 2
+                       ) -> list[tuple[int, str, str]]:
+        """Propose live migrations ``(shard, from, to)`` that even out
+        ACTIVE shard counts. With ``overloaded`` given (MemoryWatchdog
+        pressure), moves only flow away from that node;
+        ``min_imbalance=1`` forces a shed even when counts are level."""
+        if len(self._nodes) < 2:
+            return []
+        active = {n: [s for s in self.mapper.shards_of(n)
+                      if self.mapper.statuses[s] == ShardStatus.ACTIVE]
+                  for n in self._nodes}
+        counts = {n: len(self.mapper.shards_of(n)) for n in self._nodes}
+        moves: list[tuple[int, str, str]] = []
+        while True:
+            src = overloaded if overloaded in counts else \
+                max(counts, key=lambda n: counts[n])
+            others = [n for n in counts if n != src]
+            if not others or not active[src]:
+                break
+            dst = min(others, key=lambda n: counts[n])
+            # an overloaded source sheds at one lower threshold, so a
+            # pressured node gives up a shard even when counts are level
+            threshold = min_imbalance - 1 if src == overloaded \
+                else min_imbalance
+            if counts[src] - counts[dst] < threshold:
+                break
+            shard = active[src].pop()
+            moves.append((shard, src, dst))
+            counts[src] -= 1
+            counts[dst] += 1
+        return moves
+
+    # -- live migration (coordinator/migration.py drives these) --
+
+    def begin_handoff(self, shard: int, source: str) -> ShardEvent:
+        """Mark a shard in HANDOFF: the source keeps serving queries while
+        the destination catches up (the HANDOFF queryability rule)."""
+        return self._publish(ShardEvent(shard, ShardStatus.HANDOFF, source))
+
+    def complete_handoff(self, shard: int, dest: str) -> ShardEvent:
+        """Atomic flip: ONE sequenced event moves owner+status to the
+        destination, so any mapper observer sees either the old or the new
+        owner — never a gap."""
+        return self._publish(ShardEvent(shard, ShardStatus.ACTIVE, dest))
+
+    def abort_handoff(self, shard: int, source: str) -> ShardEvent:
+        """Roll the shard back to ACTIVE on the source (migration abort)."""
+        return self._publish(ShardEvent(shard, ShardStatus.ACTIVE, source))
+
     # -- assignment --
 
     def _assign(self) -> list[ShardEvent]:
         out = []
         for shard, node in sorted(self.strategy.assignments(
                 self.mapper, self._nodes, self.min_num_nodes).items()):
+            if shard in self._deferred:
+                continue  # rate-limited: check_deferred() retries it
             out.append(self._publish(ShardEvent(shard, ShardStatus.ASSIGNED,
                                                 node)))
         return out

@@ -19,13 +19,18 @@ class ShardStatus(enum.Enum):
     ASSIGNED = "assigned"
     ACTIVE = "active"
     RECOVERY = "recovery"
+    # live migration in flight (coordinator/migration.py): the SOURCE node
+    # still owns and serves the shard while the destination catches up;
+    # the owner only changes at the atomic ACTIVE flip event
+    HANDOFF = "handoff"
     ERROR = "error"
     STOPPED = "stopped"
     DOWN = "down"
 
     @property
     def queryable(self) -> bool:
-        return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY)
+        return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY,
+                        ShardStatus.HANDOFF)
 
 
 @dataclass

@@ -50,6 +50,7 @@ def _build_registry() -> dict[str, type]:
         ScalarResult,
         StepMatrix,
     )
+    from filodb_tpu.coordinator.migration import MigrationManifest
     from filodb_tpu.utils.governor import QueryBudget
 
     reg: dict[str, type] = {}
@@ -63,7 +64,8 @@ def _build_registry() -> dict[str, type]:
                  _tr.RangeVectorTransformer):
         reg[base.__name__] = base
         walk(base)
-    for cls in (ColumnFilter, PartKey, Chunk, HistogramColumn, PlannerParams,
+    for cls in (ColumnFilter, PartKey, Chunk, HistogramColumn,
+                MigrationManifest, PlannerParams,
                 QueryBudget, QueryContext, QueryResult, QueryStats,
                 RangeVectorKey, ScalarResult, StepMatrix):
         reg[cls.__name__] = cls

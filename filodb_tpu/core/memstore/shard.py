@@ -169,9 +169,12 @@ class TimeSeriesShard:
         # scheduler; here a lock keeps flush callable from any thread)
         import threading as _threading
         self.write_lock = _threading.Lock()
-        # cardinality metering + quotas (reference ratelimit/)
+        # cardinality metering + quotas (reference ratelimit/); configured
+        # per-tenant quotas (governor `tenants` block) apply to every shard
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
+        from filodb_tpu.utils.governor import apply_tenant_quotas
         self.cardinality = CardinalityTracker(shard_num)
+        apply_tenant_quotas(self.cardinality)
         # optional streaming downsampler invoked at flush (reference
         # ShardDownsampler publishing to the downsample dataset)
         self.downsampler = None
@@ -490,6 +493,8 @@ class TimeSeriesShard:
                                                     rec.timestamp)
             except QuotaExceededError:
                 self.stats.quota_dropped.inc()
+                from filodb_tpu.utils.governor import record_tenant_drop
+                record_tenant_drop(rec.part_key.label_map)
                 continue
             except KeyError:
                 self.stats.unknown_schema_dropped.inc()
@@ -672,11 +677,13 @@ class TimeSeriesShard:
         restore (a partially-loaded tracker would double-count during the
         full-rebuild fallback)."""
         from filodb_tpu.core.memstore.cardinality import CardinalityTracker
+        from filodb_tpu.utils.governor import apply_tenant_quotas
         self.partitions = []
         self._by_key = {}
         self._host_pids = set()
         self.index = PartKeyIndex(self.schemas)
         self.cardinality = CardinalityTracker(self.shard_num)
+        apply_tenant_quotas(self.cardinality)
         if self._native_core is not None:
             from filodb_tpu.core.memstore.native_shard import NativeShardCore
             self._native_core = NativeShardCore(self.config.max_chunk_size,

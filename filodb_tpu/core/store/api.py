@@ -114,6 +114,28 @@ class ColumnStore:
         only entries written after them (idempotent overlaps)."""
         return (-1, -1)
 
+    # ---- migration manifests (coordinator/migration.py) -----------------
+    # The shard-migration state machine persists its manifest NEXT TO the
+    # shard's data so either side of a handoff can crash and resume from
+    # durable state. Durable backends (object store, local disk) override
+    # with real persistence; the in-process default keeps manifests in a
+    # dict, which is exactly as durable as the rest of an in-memory store.
+
+    def write_migration_manifest(self, dataset: str, shard: int,
+                                 data: bytes) -> None:
+        if not hasattr(self, "_migration_manifests"):
+            self._migration_manifests = {}
+        self._migration_manifests[(dataset, shard)] = data
+
+    def read_migration_manifest(self, dataset: str,
+                                shard: int) -> bytes | None:
+        return getattr(self, "_migration_manifests", {}).get(
+            (dataset, shard))
+
+    def delete_migration_manifest(self, dataset: str, shard: int) -> None:
+        getattr(self, "_migration_manifests", {}).pop((dataset, shard),
+                                                      None)
+
     def max_persisted_ts_since(self, dataset: str, shard: int,
                                chunk_token: int) -> dict[PartKey, int]:
         """Delta of max_persisted_ts for chunks written after the token."""

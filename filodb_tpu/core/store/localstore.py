@@ -248,6 +248,34 @@ class LocalDiskColumnStore(ColumnStore):
         except FileNotFoundError:
             return None
 
+    # migration manifests: atomic-replace files beside the shard db, so a
+    # crashed handoff resumes from durable phase state after restart
+    def write_migration_manifest(self, dataset, shard, data):
+        d = os.path.join(self.root, dataset)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"migration-shard-{shard}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_migration_manifest(self, dataset, shard):
+        path = os.path.join(self.root, dataset,
+                            f"migration-shard-{shard}.json")
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete_migration_manifest(self, dataset, shard):
+        path = os.path.join(self.root, dataset,
+                            f"migration-shard-{shard}.json")
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+
     def close(self):
         self._db.close()
 

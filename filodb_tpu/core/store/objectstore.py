@@ -536,6 +536,17 @@ class ObjectStoreColumnStore(ColumnStore):
         self._uploader_put(key, json.dumps(doc).encode())
 
     # ------------------------------------------------------------ state
+    def refresh_shard(self, dataset: str, shard: int) -> None:
+        """Drop the cached in-memory state for a shard so the next access
+        re-reads the remote manifest. A migration destination may have
+        touched the shard's (then-empty) state before the source uploaded;
+        without a refresh it would cold-recover from that stale cache.
+        Only safe — and only done — when nothing local is un-uploaded."""
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is not None and not st.pending and not st.open:
+                del self._states[(dataset, shard)]
+
     def _state(self, dataset: str, shard: int) -> _ShardState:
         with self._lock:
             st = self._states.get((dataset, shard))
@@ -898,6 +909,36 @@ class ObjectStoreColumnStore(ColumnStore):
             return self._get(key)
         except KeyError:
             return None
+
+    # ------------------------------------------------- migration manifests
+    # Synchronous (not write-behind): the migration state machine treats a
+    # returned write as the crash-resume barrier for its current phase, so
+    # it must be durable before the phase's work starts.
+
+    def write_migration_manifest(self, dataset, shard, data):
+        self._require_writable("write_migration_manifest")
+        key = self._shard_prefix(dataset, shard) + "migration.json"
+        with span("objectstore", op="write_migration", shard=shard):
+            self.retry_policy.call(
+                lambda: self._put_raw(key, data),
+                retry_on=self._transient(),
+                on_retry=lambda *a, **k: RETRIES.inc(),
+                site="objectstore.put")
+
+    def read_migration_manifest(self, dataset, shard):
+        key = self._shard_prefix(dataset, shard) + "migration.json"
+        try:
+            return self._get(key)
+        except KeyError:
+            return None
+
+    def delete_migration_manifest(self, dataset, shard):
+        self._require_writable("delete_migration_manifest")
+        key = self._shard_prefix(dataset, shard) + "migration.json"
+        try:
+            self.client.delete_object(key)
+        except KeyError:
+            pass
 
     # ---------------------------------------------------------- compaction
     def _maybe_compact(self, dataset: str, shard: int) -> None:

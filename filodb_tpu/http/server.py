@@ -396,7 +396,70 @@ class HttpDispatcher:
                         for s in svc.memstore.shards_for(dataset)] \
                     if svc else []
             return self._json(200, {"status": "success", "data": data})
+        if len(rest) == 2 and rest[1] == "shardmap":
+            return self._shardmap(dataset)
+        if len(rest) == 2 and rest[1] == "migrate" and cluster is not None:
+            try:
+                shard = int(qs.get("shard", [""])[0])
+            except ValueError:
+                return self._json(400,
+                                  promjson.error_json("shard must be an int"))
+            dest = qs.get("dest", [""])[0]
+            if not dest:
+                return self._json(400, promjson.error_json("dest required"))
+            import threading
+
+            def _run():
+                try:
+                    cluster.migrate_shard(dataset, shard, dest)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "migration of %s shard %d -> %s failed",
+                        dataset, shard, dest)
+
+            threading.Thread(target=_run, daemon=True,
+                             name=f"migrate-{dataset}-{shard}").start()
+            return self._json(200, {"status": "success",
+                                    "data": {"dataset": dataset,
+                                             "shard": shard, "dest": dest,
+                                             "state": "started"}})
         return self._json(404, promjson.error_json("unknown cluster endpoint"))
+
+    def _shardmap(self, dataset: str):
+        """Shard → node/status/migration-phase map plus per-tenant
+        cardinality-vs-quota usage (``filo-cli shardmap`` backend)."""
+        cluster = self.app.cluster
+        if cluster is not None:
+            shards = cluster.shard_statuses(dataset)
+            for entry in shards:
+                mig = cluster.migrations.get((dataset, entry["shard"]))
+                if mig is not None:
+                    entry["migration"] = mig.snapshot()
+        elif dataset in self.app.shard_maps:
+            shards = self.app.shard_maps[dataset]().snapshot()
+        else:
+            svc = self.app.services.get(dataset)
+            shards = [{"shard": s.shard_num, "status": "active",
+                       "node": None}
+                      for s in svc.memstore.shards_for(dataset)] \
+                if svc else []
+        from filodb_tpu.utils.governor import config as gov_config
+        svc = self.app.services.get(dataset)
+        trackers = [s.cardinality for s in
+                    svc.memstore.shards_for(dataset)] if svc else []
+        tenants = []
+        for tenant, tc in sorted(gov_config().tenants.items()):
+            prefix = tenant.split("/")
+            active = sum(t.cardinality(prefix).active_ts for t in trackers)
+            tenants.append({
+                "tenant": tenant,
+                "active_series": active,
+                "max_series": int(tc.get("max_series", 0) or 0),
+                "max_inflight": int(tc.get("max_inflight", 0) or 0)})
+        return self._json(200, {"status": "success",
+                                "data": {"shards": shards,
+                                         "tenants": tenants}})
 
 
 class _ReusePortHTTPServer(ThreadingHTTPServer):

@@ -124,6 +124,19 @@ class FiloServer:
         self.node.stop_shard(dataset, shard)
         return True
 
+    def _handle_prepare_handoff(self, dataset: str, shard: int):
+        """Migration source side: flush + drain the shard's durable state
+        and return its replay offset (coordinator/migration.py SYNC)."""
+        return self.node.prepare_handoff(dataset, shard)
+
+    def _handle_shard_offset(self, dataset: str, shard: int):
+        return self.node.shard_offset(dataset, shard)
+
+    def _handle_migration_status(self, dataset: str):
+        """Coordinator side: in-flight migrations for the CLI/shardmap."""
+        return [mig.snapshot() for (d, _s), mig in
+                self.cluster.migrations.items() if d == dataset]
+
     def _handle_shard_status(self, dataset: str):
         out = []
         for (d, s), w in self.node._workers.items():
@@ -194,6 +207,9 @@ class FiloServer:
                 "stop_shard": self._handle_stop_shard,
                 "shard_status": self._handle_shard_status,
                 "shard_events": self._handle_shard_events,
+                "prepare_handoff": self._handle_prepare_handoff,
+                "shard_offset": self._handle_shard_offset,
+                "migration_status": self._handle_migration_status,
                 "join": self._handle_join,
                 "role": self._handle_role,
             }).start()
@@ -297,6 +313,13 @@ class FiloServer:
                        name="shard-updates").start()
         else:
             # coordinator role: own the cluster singleton
+            mig_cfg = cfg.migration or {}
+            self.cluster.auto_rebalance = bool(
+                mig_cfg.get("auto_rebalance", False))
+            self.cluster.migration_lag_threshold = int(
+                mig_cfg.get("lag_threshold", 0))
+            self.cluster.migration_catchup_timeout_s = float(
+                mig_cfg.get("catchup_timeout_s", 30.0))
             self.cluster.join(self.node)
             from filodb_tpu.coordinator.bootstrap import poll_remote_statuses
             for name, ing_cfg in cfg.datasets.items():
@@ -374,6 +397,26 @@ class FiloServer:
                     rc.clear()
 
         self.watchdog.on_degraded.append(evict_caches)
+        if not cfg.seeds:
+            # PR 4 watchdog → PR 6 rebalance: a node going CRITICAL sheds
+            # whole shards to peers via live migration, not just caches.
+            # Runs off the watchdog thread — migrations block through
+            # catch-up and must not stall pressure sampling.
+            import threading as _th2
+            cluster, me = self.cluster, cfg.node_name
+
+            def shed_on_pressure(state):
+                if state != "critical" or len(cluster.nodes) < 2:
+                    return
+                _th2.Thread(target=lambda: cluster.shed_load(me),
+                            daemon=True, name="shed-load").start()
+
+            self.watchdog.on_degraded.append(shed_on_pressure)
+        # per-tenant active-series gauges summed over this node's shards
+        from filodb_tpu.utils.governor import register_tenant_series_gauges
+        register_tenant_series_gauges(
+            lambda: [sh for name in datasets
+                     for sh in memstore.shards_for(name)])
         self.watchdog.start()
         if os.environ.get("FILODB_PROFILER"):
             # built-in sampling profiler (reference SimpleProfiler started

@@ -33,10 +33,16 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from filodb_tpu.query.model import QueryLimitExceeded
-from filodb_tpu.utils.metrics import Counter, Gauge, Histogram
+from filodb_tpu.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    get_counter,
+    get_gauge,
+)
 
 # ---------------------------------------------------------------------------
 # states
@@ -83,11 +89,22 @@ _queue_depth_gauge = Gauge("filodb_governor_queue_depth")
 _memory_util_gauge = Gauge("filodb_governor_memory_utilization")
 _admitted = Counter("filodb_governor_admitted")
 _rejected = {r: Counter("filodb_governor_rejected", {"reason": r})
-             for r in ("capacity", "deadline", "queue_full", "critical")}
+             for r in ("capacity", "deadline", "queue_full", "critical",
+                       "tenant")}
 _transitions = {s: Counter("filodb_governor_transitions", {"to": s})
                 for s in (OK, DEGRADED, CRITICAL)}
 _budget_exceeded = Counter("filodb_governor_budget_exceeded")
 _queue_wait = Histogram("filodb_governor_queue_wait_seconds")
+
+# per-tenant families (tenant = "_ws_" or "_ws_/_ns_" shard-key prefix);
+# untagged series pre-created so the families render before any tenant
+# config exists — runtime series carry {"tenant": ...} tags
+_tenant_inflight = Gauge("filodb_tenant_inflight")
+_tenant_admitted = Counter("filodb_tenant_admitted")
+_tenant_rejected = Counter("filodb_tenant_rejected")
+_tenant_dropped = Counter("filodb_tenant_ingest_dropped")
+_tenant_series = Gauge("filodb_tenant_series")
+_tenant_quota = Gauge("filodb_tenant_quota")
 
 
 # ---------------------------------------------------------------------------
@@ -110,6 +127,14 @@ class GovernorConfig:
     max_result_bytes: int = 0
     max_group_cardinality: int = 0
     budget_degrade: str = "partial"    # "partial" | "error"
+    # per-tenant admission classes + cardinality quotas, keyed on the
+    # shard-key prefix: {"ws": {...}} or {"ws/ns": {...}} with
+    #   max_inflight:  concurrent queries for this tenant (0 = unlimited)
+    #   max_series:    active-series cardinality quota per shard (0 = off)
+    # one tenant's flood sheds ONLY that tenant: its queries reject with
+    # reason="tenant" without consuming the shared admission queue, and
+    # its over-quota series drop at ingest (QuotaExceededError)
+    tenants: dict = field(default_factory=dict)
 
 
 _config = GovernorConfig()
@@ -195,6 +220,81 @@ def default_budget() -> QueryBudget | None:
 
 
 # ---------------------------------------------------------------------------
+# per-tenant isolation (keyed on the _ws_/_ns_ shard-key prefix)
+
+
+def tenant_of(labels: dict) -> str:
+    """Tenant id from a shard-key label map: ``"ws/ns"`` when both are
+    present, ``"ws"`` with only a workspace, ``""`` for untenanted data."""
+    ws = labels.get("_ws_", "")
+    ns = labels.get("_ns_", "")
+    return f"{ws}/{ns}" if ws and ns else ws
+
+
+def tenant_limits(tenant: str) -> dict | None:
+    """The configured class for a tenant: exact ``ws/ns`` match first,
+    then the ``ws`` prefix; None when the tenant is unclassed."""
+    if not tenant or not _config.tenants:
+        return None
+    tc = _config.tenants.get(tenant)
+    if tc is None and "/" in tenant:
+        tc = _config.tenants.get(tenant.split("/", 1)[0])
+    return tc
+
+
+def tenant_account_key(tenant: str) -> str:
+    """Inflight-accounting key for a tenant: the configured class key when
+    one matches (so a ``ws``-scoped cap aggregates across all of that
+    workspace's namespaces), else the tenant itself."""
+    if not tenant or not _config.tenants or tenant in _config.tenants:
+        return tenant
+    if "/" in tenant:
+        ws = tenant.split("/", 1)[0]
+        if ws in _config.tenants:
+            return ws
+    return tenant
+
+
+def apply_tenant_quotas(tracker) -> None:
+    """Push configured per-tenant cardinality quotas into a shard's
+    :class:`CardinalityTracker` (called at shard construction, so every
+    shard enforces the same quotas at ingest)."""
+    for tenant, tc in _config.tenants.items():
+        quota = int(tc.get("max_series", 0) or 0)
+        if quota <= 0:
+            continue
+        tracker.set_quota(tenant.split("/"), quota)
+        get_gauge("filodb_tenant_quota", {"tenant": tenant}).set(quota)
+
+
+def record_tenant_drop(labels: dict) -> None:
+    """Count one quota-dropped ingest record against its tenant."""
+    tenant = tenant_of(labels)
+    _tenant_dropped.inc()
+    if tenant:
+        get_counter("filodb_tenant_ingest_dropped",
+                    {"tenant": tenant}).inc()
+
+
+def register_tenant_series_gauges(shards_fn) -> None:
+    """Per-tenant active-series gauges (``filodb_tenant_series{tenant=}``)
+    computed at scrape time by summing each configured tenant's
+    cardinality-tree counts over ``shards_fn()`` (the node's live shards) —
+    no update path, never stale."""
+    from filodb_tpu.utils.metrics import GaugeFn
+    for tenant in _config.tenants:
+        prefix = tenant.split("/")
+
+        def fn(prefix=prefix):
+            total = 0
+            for sh in shards_fn() or []:
+                total += sh.cardinality.cardinality(prefix).active_ts
+            return total
+
+        GaugeFn("filodb_tenant_series", fn, {"tenant": tenant})
+
+
+# ---------------------------------------------------------------------------
 # admission gate
 
 
@@ -214,6 +314,7 @@ class ResourceGovernor:
         self._cond = threading.Condition()
         self._inflight = 0
         self._waiters = 0
+        self._tenant_inflight: dict[str, int] = {}
         self._state = OK
         _state_gauge.set(_STATE_VALUE[OK])
         _inflight_gauge.set(0)
@@ -257,27 +358,47 @@ class ResourceGovernor:
                             reason=reason)
 
     @contextmanager
-    def admit(self, deadline=None, cost: str = EXPENSIVE):
+    def admit(self, deadline=None, cost: str = EXPENSIVE,
+              tenant: str = ""):
         """Admit one query; blocks while at capacity until a slot frees or
         the wait budget (deadline minus headroom, capped at
         ``max_queue_wait_s``) runs out, then sheds with
-        :class:`QueryRejected`."""
-        self._acquire(deadline, cost)
+        :class:`QueryRejected`. ``tenant`` (the ``_ws_/_ns_`` shard-key
+        prefix) gates against that tenant's configured ``max_inflight``
+        BEFORE the shared queue — a flooding tenant sheds itself without
+        occupying capacity others are waiting for."""
+        tenant = tenant_account_key(tenant)
+        self._acquire(deadline, cost, tenant)
         try:
             yield self
         finally:
-            self._release()
+            self._release(tenant)
 
-    def _acquire(self, deadline, cost: str) -> None:
+    def _tenant_gate(self, tenant: str) -> None:
+        """Per-tenant concurrency cap; caller holds ``_cond``. Rejects
+        immediately (no queueing) — the shed is the isolation mechanism."""
+        tc = tenant_limits(tenant)
+        if tc is None:
+            return
+        cap = int(tc.get("max_inflight", 0) or 0)
+        if cap and self._tenant_inflight.get(tenant, 0) >= cap:
+            get_counter("filodb_tenant_rejected",
+                        {"tenant": tenant}).inc()
+            _tenant_rejected.inc()
+            self._reject("tenant",
+                         f"tenant {tenant} at max_inflight={cap}")
+
+    def _acquire(self, deadline, cost: str, tenant: str = "") -> None:
         cfg = self.cfg
         t0 = time.monotonic()
         with self._cond:
+            self._tenant_gate(tenant)
             if self._state == CRITICAL and cost == EXPENSIVE:
                 self._reject("critical",
                              "node under memory pressure; only cheap "
                              "queries admitted")
             if self._inflight < self.capacity() and self._waiters == 0:
-                self._admit_locked(t0)
+                self._admit_locked(t0, tenant)
                 return
             if self._waiters >= cfg.admission_queue_limit:
                 self._reject("queue_full",
@@ -291,7 +412,7 @@ class ResourceGovernor:
                         self._reject("critical",
                                      "node went critical while queued")
                     if self._inflight < self.capacity():
-                        self._admit_locked(t0)
+                        self._admit_locked(t0, tenant)
                         return
                     budget = cfg.max_queue_wait_s - (time.monotonic() - t0)
                     if deadline is not None:
@@ -309,16 +430,27 @@ class ResourceGovernor:
                 self._waiters -= 1
                 _queue_depth_gauge.set(self._waiters)
 
-    def _admit_locked(self, t0: float) -> None:
+    def _admit_locked(self, t0: float, tenant: str = "") -> None:
         self._inflight += 1
         _inflight_gauge.set(self._inflight)
         _admitted.inc()
         _queue_wait.observe(time.monotonic() - t0)
+        if tenant:
+            n = self._tenant_inflight.get(tenant, 0) + 1
+            self._tenant_inflight[tenant] = n
+            get_gauge("filodb_tenant_inflight", {"tenant": tenant}).set(n)
+            get_counter("filodb_tenant_admitted", {"tenant": tenant}).inc()
+            _tenant_admitted.inc()
 
-    def _release(self) -> None:
+    def _release(self, tenant: str = "") -> None:
         with self._cond:
             self._inflight = max(0, self._inflight - 1)
             _inflight_gauge.set(self._inflight)
+            if tenant:
+                n = max(0, self._tenant_inflight.get(tenant, 0) - 1)
+                self._tenant_inflight[tenant] = n
+                get_gauge("filodb_tenant_inflight",
+                          {"tenant": tenant}).set(n)
             self._cond.notify()
 
 

@@ -119,6 +119,19 @@ def get_counter(name: str, tags: dict[str, str] | None = None) -> Counter:
     return Counter(name, tags)
 
 
+def get_gauge(name: str, tags: dict[str, str] | None = None) -> Gauge:
+    """Idempotent gauge lookup (per-(name, tags)) — the gauge analog of
+    :func:`get_counter`, for dynamically-tagged series (per-tenant,
+    per-migration) where re-registering would drop the live value."""
+    t = ",".join(f"{k}={v}" for k, v in sorted((tags or {}).items()))
+    key = f"{name}{{{t}}}"
+    with _lock:
+        m = _registry.get(key)
+    if isinstance(m, Gauge):
+        return m
+    return Gauge(name, tags)
+
+
 def render_prometheus() -> str:
     """Expose all metrics in Prometheus text format."""
     lines = []

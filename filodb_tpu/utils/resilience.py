@@ -410,6 +410,9 @@ class FaultInjector:
     - ``store.call``        (ctx: host, port, op) — remote column store
     - ``node.dispatch``     (ctx: node)        — in-cluster node dispatch
     - ``objectstore.put``   (ctx: key)         — object-store segment upload
+    - ``migration.*``       (ctx: dataset, shard, source, dest, phase) —
+      live-migration kill-points, one per state transition
+      (``coordinator/migration.py`` ``KILL_POINTS``)
     """
 
     _faults: dict[str, list[Fault]] = {}

@@ -117,6 +117,34 @@ GOVERNOR_NAMES = [
 ]
 
 
+# live shard migration (coordinator/migration.py) — registered at import so
+# dashboards see the families before any migration runs
+MIGRATION_NAMES = [
+    "filodb_shard_migrations_started_total",
+    "filodb_shard_migrations_completed_total",
+    "filodb_shard_migrations_aborted_total",
+    "filodb_shard_migrations_resumed_total",
+    "filodb_shard_migration_active",
+    "filodb_shard_migration_phase",
+    "filodb_shard_migration_lag",
+    "filodb_shard_migration_seconds_bucket",
+    "filodb_shard_migration_seconds_count",
+    "filodb_shard_migration_seconds_sum",
+]
+
+
+# per-tenant isolation (utils/governor.py) — untagged family anchors
+# pre-registered; runtime series carry {tenant=...} tags
+TENANT_NAMES = [
+    "filodb_tenant_inflight",
+    "filodb_tenant_admitted_total",
+    "filodb_tenant_rejected_total",
+    "filodb_tenant_ingest_dropped_total",
+    "filodb_tenant_series",
+    "filodb_tenant_quota",
+]
+
+
 # object-store durable tier (core/store/objectstore.py) — registered at
 # import; standalone imports the module regardless of the configured backend
 OBJECTSTORE_NAMES = [
@@ -223,6 +251,15 @@ class TestMetricsScrape:
         # query above passed the admission gate so admissions moved
         missing_gov = [n for n in GOVERNOR_NAMES if n not in names_present]
         assert not missing_gov, f"missing governor metrics: {missing_gov}"
+
+        # live-migration families render before any migration runs
+        # (standalone imports cluster → migration at boot)
+        missing_mig = [n for n in MIGRATION_NAMES if n not in names_present]
+        assert not missing_mig, f"missing migration metrics: {missing_mig}"
+
+        # per-tenant isolation families render before any tenant config
+        missing_t = [n for n in TENANT_NAMES if n not in names_present]
+        assert not missing_t, f"missing tenant metrics: {missing_t}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])

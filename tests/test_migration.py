@@ -1,0 +1,377 @@
+"""Live shard migration chaos tests + per-tenant isolation.
+
+Mirrors the reference's multi-jvm handoff/recovery specs
+(``ClusterRecoverySpec``, ``ShardManagerSpec`` reassignment arms) for the
+PR 6 migration subsystem (``coordinator/migration.py``):
+
+- a shard moves between nodes through the PLANNED → SYNCING → CATCHUP →
+  FLIPPING → DONE state machine with query equivalence before/after;
+- a parameterized chaos matrix kills the driver at EVERY named
+  ``FaultInjector`` kill-point, asserting queries stay correct against an
+  unmigrated control and that ``resume()`` completes from the durable
+  manifest — zero acked-data loss, zero wrong results;
+- abort rolls the shard back to the source cleanly;
+- queries touching RECOVERY/HANDOFF shards carry a "recovering" warning;
+- rate-limited reassignments are deferred and retried, never dropped;
+- one tenant's flood sheds ONLY that tenant (admission + cardinality).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+from filodb_tpu.coordinator.ingestion import route_container
+from filodb_tpu.coordinator.migration import (
+    ABORTED,
+    DONE,
+    KILL_POINTS,
+    MigrationManifest,
+    ShardMigration,
+)
+from filodb_tpu.coordinator.shard_manager import ShardManager
+from filodb_tpu.coordinator.shardmapper import ShardStatus
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+from filodb_tpu.kafka.log import InMemoryLog
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils.resilience import FaultInjector
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+QUERY = 'sum(heap_usage{_ns_="App-3"})'
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FaultInjector.reset()
+    yield
+    FaultInjector.reset()
+
+
+def _publish(logs, stream, num_shards, spread=1):
+    for sd in stream:
+        for shard, cont in route_container(sd.container, num_shards,
+                                           spread).items():
+            logs[shard].append(cont)
+
+
+@pytest.fixture
+def cluster_env():
+    cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+    logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
+    keys = machine_metrics_series(12, ns="App-3")
+    _publish(logs, gauge_stream(keys, 240, start_ms=START * 1000),
+             NUM_SHARDS)
+    cluster = FilodbCluster()
+    for n in ("node-a", "node-b"):
+        cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
+    config = IngestionConfig("timeseries", NUM_SHARDS, min_num_nodes=2,
+                             store=StoreConfig(max_chunk_size=60,
+                                               groups_per_shard=2))
+    cluster.setup_dataset(config, logs)
+    assert cluster.wait_active("timeseries", 10)
+    yield cluster, cs
+    cluster.stop()
+
+
+def _query(cluster):
+    svc = cluster.query_service("timeseries", spread=1)
+    return svc.query_range(QUERY, START + 600, 300, START + 1500)
+
+
+def _pick_shard(cluster, owner="node-a"):
+    sm = cluster.shard_managers["timeseries"]
+    shards = [s for s in range(NUM_SHARDS)
+              if sm.mapper.node_for(s) == owner]
+    assert shards, f"{owner} owns no shards"
+    return shards[0]
+
+
+class TestBasicMigration:
+    def test_migrate_and_query_equivalence(self, cluster_env):
+        cluster, cs = cluster_env
+        before = _query(cluster)
+        shard = _pick_shard(cluster, "node-a")
+        mig = cluster.migrate_shard("timeseries", shard, "node-b")
+        sm = cluster.shard_managers["timeseries"]
+        assert mig.phase == DONE
+        assert sm.mapper.node_for(shard) == "node-b"
+        assert sm.mapper.statuses[shard] == ShardStatus.ACTIVE
+        # the source tore the shard down; the destination serves it
+        assert ("timeseries", shard) not in \
+            cluster.nodes["node-a"]._workers
+        assert ("timeseries", shard) in cluster.nodes["node-b"]._workers
+        # manifest cleaned up
+        assert cs.read_migration_manifest("timeseries", shard) is None
+        after = _query(cluster)
+        np.testing.assert_allclose(after.result.values,
+                                   before.result.values, rtol=1e-9)
+
+    def test_same_node_rejected(self, cluster_env):
+        cluster, _ = cluster_env
+        shard = _pick_shard(cluster, "node-a")
+        with pytest.raises(ValueError):
+            cluster.migrate_shard("timeseries", shard, "node-a")
+
+    def test_manifest_roundtrip(self):
+        m = MigrationManifest("ds", 3, "a", "b", "catchup", 5, 10, 20)
+        assert MigrationManifest.from_bytes(m.to_bytes()) == m
+
+
+class TestKillPointChaos:
+    """Kill the driver at EVERY named transition; queries must stay
+    correct throughout, and resume must complete the move from the
+    durable manifest (zero acked-data loss, zero wrong results)."""
+
+    @pytest.mark.parametrize("site", KILL_POINTS)
+    def test_kill_and_resume(self, cluster_env, site):
+        cluster, cs = cluster_env
+        control = _query(cluster)  # unmigrated baseline
+        shard = _pick_shard(cluster, "node-a")
+        FaultInjector.arm(site, error=RuntimeError, times=1)
+        with pytest.raises(RuntimeError):
+            cluster.migrate_shard("timeseries", shard, "node-b")
+        # mid-migration (any phase): results stay correct — the shard is
+        # queryable on whichever side the map currently names
+        mid = _query(cluster)
+        np.testing.assert_allclose(mid.result.values,
+                                   control.result.values, rtol=1e-9)
+        # the manifest survived the crash; resume completes the move
+        assert cs.read_migration_manifest("timeseries", shard) is not None
+        mig = cluster.resume_migration("timeseries", shard)
+        assert mig is not None and mig.phase == DONE
+        sm = cluster.shard_managers["timeseries"]
+        assert sm.mapper.node_for(shard) == "node-b"
+        assert sm.mapper.statuses[shard] == ShardStatus.ACTIVE
+        assert cs.read_migration_manifest("timeseries", shard) is None
+        after = _query(cluster)
+        np.testing.assert_allclose(after.result.values,
+                                   control.result.values, rtol=1e-9)
+
+    def test_resume_without_manifest_is_noop(self, cluster_env):
+        cluster, _ = cluster_env
+        assert cluster.resume_migration("timeseries", 0) is None
+
+
+class TestAbort:
+    def test_abort_rolls_back_to_source(self, cluster_env):
+        cluster, cs = cluster_env
+        control = _query(cluster)
+        shard = _pick_shard(cluster, "node-a")
+        FaultInjector.arm("migration.catchup", error=RuntimeError, times=1)
+        with pytest.raises(RuntimeError):
+            cluster.migrate_shard("timeseries", shard, "node-b")
+        mig = cluster.migrations[("timeseries", shard)]
+        mig.abort()
+        assert mig.phase == ABORTED
+        sm = cluster.shard_managers["timeseries"]
+        assert sm.mapper.node_for(shard) == "node-a"
+        assert sm.mapper.statuses[shard] == ShardStatus.ACTIVE
+        # destination's partial recovery torn down, manifest cleared
+        assert ("timeseries", shard) not in \
+            cluster.nodes["node-b"]._workers
+        assert cs.read_migration_manifest("timeseries", shard) is None
+        after = _query(cluster)
+        np.testing.assert_allclose(after.result.values,
+                                   control.result.values, rtol=1e-9)
+
+
+class TestRecoveryWarnings:
+    def test_handoff_query_carries_warning(self, cluster_env):
+        cluster, _ = cluster_env
+        sm = cluster.shard_managers["timeseries"]
+        shard = _pick_shard(cluster, "node-a")
+        sm.begin_handoff(shard, "node-a")
+        try:
+            r = _query(cluster)
+            assert any("recovering" in w for w in r.warnings), r.warnings
+            assert any(f"shard {shard}" in w for w in r.warnings)
+        finally:
+            sm.abort_handoff(shard, "node-a")
+        # back to ACTIVE: no warning
+        r2 = _query(cluster)
+        assert not any("recovering" in w for w in r2.warnings)
+
+    def test_handoff_is_queryable(self):
+        assert ShardStatus.HANDOFF.queryable
+
+
+class TestDeferredReassignment:
+    """Satellite: a rate-limited reassignment is deferred and retried on
+    the next membership check — never silently left DOWN forever."""
+
+    def test_deferred_then_reassigned(self):
+        sm = ShardManager("ds", 4, min_num_nodes=2,
+                          reassignment_min_interval_s=0.3)
+        for n in ("n1", "n2", "n3", "n4"):
+            sm.add_member(n)
+        lost = sm.mapper.shards_of("n1")
+        assert lost
+        sm.remove_member("n1")  # first reassignment: stamps the shards
+        # shards landed somewhere; now kill a node that adopted one while
+        # still inside the rate-limit window
+        victim = sm.mapper.node_for(lost[0])
+        relost = sm.mapper.shards_of(victim)
+        sm.remove_member(victim)
+        # the freshly-stamped shards are DEFERRED (recorded for retry),
+        # not reassigned and not dropped
+        assert set(relost) <= sm._deferred
+        for s in relost:
+            assert sm.mapper.node_for(s) is None
+        # next membership check after the interval picks them back up
+        time.sleep(0.35)
+        sm.add_member("n1")
+        assert not sm._deferred
+        assert sm.mapper.unassigned_shards() == []
+
+    def test_check_deferred_respects_interval(self):
+        sm = ShardManager("ds", 4, min_num_nodes=2,
+                          reassignment_min_interval_s=30.0)
+        for n in ("n1", "n2", "n3", "n4"):
+            sm.add_member(n)
+        lost = sm.mapper.shards_of("n1")
+        sm.remove_member("n1")
+        victim = sm.mapper.node_for(lost[0])
+        relost = sm.mapper.shards_of(victim)
+        sm.remove_member(victim)
+        assert set(relost) <= sm._deferred
+        # interval has NOT elapsed: check_deferred must not reassign
+        assert sm.check_deferred() == []
+        assert set(relost) <= sm._deferred
+
+
+class TestRebalancePlanning:
+    def test_plan_moves_toward_balance(self):
+        sm = ShardManager("ds", 4, min_num_nodes=1)
+        sm.add_member("n1")  # takes all 4
+        sm.add_member("n2")  # idle: existing assignments are stable
+        for s in range(4):
+            sm.shard_active(s, "n1")
+        moves = sm.plan_rebalance()
+        assert moves  # n1=4, n2=0 → at least one move
+        for shard, src, dst in moves:
+            assert src == "n1" and dst == "n2"
+        # proposed end state is balanced within min_imbalance
+        assert len(moves) == 2
+
+    def test_overloaded_forces_shed(self):
+        sm = ShardManager("ds", 4, min_num_nodes=2)
+        sm.add_member("n1")
+        sm.add_member("n2")
+        for s in range(4):
+            sm.shard_active(s, sm.mapper.node_for(s))
+        # balanced (2/2): only an overload trigger moves anything
+        assert sm.plan_rebalance() == []
+        moves = sm.plan_rebalance(overloaded="n1", min_imbalance=1)
+        assert len(moves) == 1
+        assert moves[0][1] == "n1" and moves[0][2] == "n2"
+
+    def test_join_rebalance_via_migration(self, cluster_env):
+        cluster, _ = cluster_env
+        before = _query(cluster)
+        cluster.auto_rebalance = True
+        joiner = Node("node-c", TimeSeriesMemStore(
+            cluster.nodes["node-a"].memstore.column_store,
+            cluster.nodes["node-a"].memstore.meta_store))
+        cluster.join(joiner)
+        deadline = time.monotonic() + 15
+        sm = cluster.shard_managers["timeseries"]
+        while time.monotonic() < deadline:
+            if sm.mapper.shards_of("node-c") and not cluster.migrations:
+                break
+            time.sleep(0.05)
+        assert sm.mapper.shards_of("node-c"), "joiner received no shard"
+        after = _query(cluster)
+        np.testing.assert_allclose(after.result.values,
+                                   before.result.values, rtol=1e-9)
+
+
+class TestTenantIsolation:
+    """One tenant's flood sheds ONLY that tenant."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_governor(self):
+        from filodb_tpu.utils import governor
+        governor.reset()
+        yield
+        governor.reset()
+
+    def test_cardinality_quota_per_tenant(self):
+        from filodb_tpu.utils import governor
+        governor.configure(tenants={"demo/App-0": {"max_series": 4}})
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("timeseries", 0, StoreConfig(max_chunk_size=50))
+        noisy = machine_metrics_series(10, ns="App-0")   # quota 4
+        quiet = machine_metrics_series(10, ns="App-9")   # unclassed
+        for sd in gauge_stream(noisy + quiet, 10):
+            shard.ingest(sd)
+        card = shard.cardinality
+        assert card.cardinality(["demo", "App-0"]).active_ts == 4
+        assert card.cardinality(["demo", "App-9"]).active_ts == 10
+        assert shard.stats.quota_dropped.value > 0
+        from filodb_tpu.utils.metrics import get_counter
+        assert get_counter("filodb_tenant_ingest_dropped",
+                           {"tenant": "demo/App-0"}).value > 0
+
+    def test_admission_cap_per_tenant(self):
+        from filodb_tpu.utils import governor
+        governor.configure(tenants={"noisy": {"max_inflight": 1}})
+        g = governor.ResourceGovernor(governor.config())
+        with g.admit(tenant="noisy/App-0"):
+            # same tenant at cap: immediate shed, reason "tenant"
+            with pytest.raises(governor.QueryRejected) as ei:
+                with g.admit(tenant="noisy/App-1"):
+                    pass
+            assert ei.value.reason == "tenant"
+            # other tenants (and untenanted) unaffected
+            with g.admit(tenant="quiet/App-0"):
+                pass
+            with g.admit():
+                pass
+        # slot released: the tenant admits again
+        with g.admit(tenant="noisy/App-0"):
+            pass
+
+    def test_plan_tenant_extraction(self):
+        from filodb_tpu.coordinator.query_service import plan_tenant
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+        plan = parse_query('heap_usage{_ws_="demo",_ns_="App-3"}',
+                           TimeStepParams(START, 60, START + 600))
+        assert plan_tenant(plan) == "demo/App-3"
+        plan2 = parse_query("heap_usage",
+                            TimeStepParams(START, 60, START + 600))
+        assert plan_tenant(plan2) == ""
+
+
+class TestDurableManifests:
+    def test_localstore_manifest_roundtrip(self, tmp_path):
+        from filodb_tpu.core.store.localstore import LocalDiskColumnStore
+        cs = LocalDiskColumnStore(str(tmp_path / "columnstore"))
+        try:
+            assert cs.read_migration_manifest("ds", 1) is None
+            cs.write_migration_manifest("ds", 1, b'{"phase": "syncing"}')
+            assert cs.read_migration_manifest("ds", 1) == \
+                b'{"phase": "syncing"}'
+            cs.delete_migration_manifest("ds", 1)
+            assert cs.read_migration_manifest("ds", 1) is None
+            cs.delete_migration_manifest("ds", 1)  # idempotent
+        finally:
+            cs.close()
+
+    def test_objectstore_manifest_roundtrip(self, tmp_path):
+        from filodb_tpu.core.store.objectstore import open_object_store
+        cs, meta = open_object_store({"endpoint": None, "bucket": "t"},
+                                     str(tmp_path))
+        try:
+            assert cs.read_migration_manifest("ds", 2) is None
+            cs.write_migration_manifest("ds", 2, b'{"phase": "catchup"}')
+            assert cs.read_migration_manifest("ds", 2) == \
+                b'{"phase": "catchup"}'
+            cs.delete_migration_manifest("ds", 2)
+            assert cs.read_migration_manifest("ds", 2) is None
+        finally:
+            cs.close()
+            meta.close()
