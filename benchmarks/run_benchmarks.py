@@ -474,6 +474,12 @@ def _bench_migration():
     return bench_migration()
 
 
+def _bench_rules():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from rules import bench_rules
+    return bench_rules()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -491,6 +497,7 @@ ALL = {
     "overload": _bench_overload,
     "objectstore": _bench_objectstore,
     "migration": _bench_migration,
+    "rules": _bench_rules,
 }
 
 

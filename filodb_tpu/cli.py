@@ -97,6 +97,37 @@ def cmd_shardmap(args):
                   f"{str(quota):>10} {str(infl):>12}")
 
 
+def cmd_rules(args):
+    """Standing-query status: every rule group's watermark plus per-rule
+    health, and all active alerts with their state/activation time
+    (``/api/v1/rules`` + ``/api/v1/alerts``)."""
+    import urllib.request
+    with urllib.request.urlopen(f"http://{args.host}/api/v1/rules") as r:
+        groups = json.load(r)["data"]["groups"]
+    if not groups:
+        print("no rule groups configured")
+        return
+    for g in groups:
+        wm = g.get("watermark")
+        print(f"group {g['name']} dataset={g['dataset']} "
+              f"interval={g['interval']}s watermark={wm if wm else '-'}")
+        for rule in g.get("rules", []):
+            print(f"  {rule['type']:<9} {rule['name']:<28} "
+                  f"health={rule['health']:<8} {rule['query']}")
+            if rule.get("lastError"):
+                print(f"            lastError: {rule['lastError']}")
+    with urllib.request.urlopen(f"http://{args.host}/api/v1/alerts") as r:
+        alerts = json.load(r)["data"]["alerts"]
+    print(f"\n{'ALERT':<28} {'STATE':<8} {'ACTIVE_AT':<26} LABELS")
+    for a in alerts:
+        labels = ",".join(f"{k}={v}" for k, v in sorted(a["labels"].items())
+                          if k != "alertname")
+        print(f"{a['labels'].get('alertname', '?'):<28} {a['state']:<8} "
+              f"{a['activeAt']:<26} {labels}")
+    if not alerts:
+        print("(no active alerts)")
+
+
 def cmd_indexnames(args):
     cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
@@ -350,6 +381,7 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=20)
     sub.add_parser("status")
     sub.add_parser("shardmap")
+    sub.add_parser("rules")
     sub.add_parser("indexnames")
     p = sub.add_parser("labelvalues")
     p.add_argument("label")
@@ -379,7 +411,7 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
-            "shardmap": cmd_shardmap,
+            "shardmap": cmd_shardmap, "rules": cmd_rules,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
             "importcsv": cmd_importcsv, "promql": cmd_promql,
             "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,

@@ -67,6 +67,9 @@ DEFAULTS = {
         "max_result_bytes": 0,
         "max_group_cardinality": 0,
         "budget_degrade": "partial",  # "partial" | "error"
+        # concurrent standing-query (rule) evaluations; their own lowest-
+        # priority admission class (never queued, shed outside OK)
+        "rules_max_inflight": 2,
         # per-tenant admission classes + cardinality quotas keyed on the
         # _ws_ or _ws_/_ns_ shard-key prefix, e.g.
         #   "tenants": {"demo/App-0": {"max_inflight": 8,
@@ -81,6 +84,21 @@ DEFAULTS = {
                                       # imbalance and watchdog pressure
         "lag_threshold": 0,           # max replay-offset lag at flip
         "catchup_timeout_s": 30.0,    # abort CATCHUP after this long
+    },
+    # standing queries (filodb_tpu/rules): recording + alerting rule
+    # groups evaluated incrementally on ingest progress. Each group:
+    #   {"name": ..., "interval": "60s", "dataset": <defaults to first>,
+    #    "rules": [{"record": "job:heap:avg", "expr": "...",
+    #               "labels": {...}},
+    #              {"alert": "HighHeap", "expr": "... > 0.9",
+    #               "for": "5m", "labels": {...},
+    #               "annotations": {...}}]}
+    # intervals must be whole seconds; durations accept Prometheus
+    # strings ("5m") or bare numbers meaning seconds.
+    "rules": {
+        "tick_s": 1.0,                # evaluation-loop poll interval
+        "max_catchup_steps": 512,     # cap on steps replayed per tick
+        "groups": [],
     },
     # durable-store backend selection. "local" = sqlite-per-shard on
     # data_dir (default); "object" = S3-compatible object-store tier
@@ -153,6 +171,7 @@ class ServerConfig:
     governor: dict = field(default_factory=dict)  # GovernorConfig overrides
     store: dict = field(default_factory=dict)  # durable-store backend block
     migration: dict = field(default_factory=dict)  # live-migration knobs
+    rules: dict = field(default_factory=dict)  # standing-query rule groups
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -198,7 +217,8 @@ class ServerConfig:
             result_cache=cfg.get("result_cache", {}),
             governor=cfg.get("governor", {}),
             store=cfg.get("store", {}),
-            migration=cfg.get("migration", {}))
+            migration=cfg.get("migration", {}),
+            rules=cfg.get("rules", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

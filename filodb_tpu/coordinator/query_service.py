@@ -20,6 +20,7 @@ from filodb_tpu.query.model import QueryContext, QueryResult
 from filodb_tpu.utils.governor import (
     CHEAP,
     EXPENSIVE,
+    RULES,
     default_budget,
     governor,
     tenant_of,
@@ -346,8 +347,12 @@ class QueryService:
         deadline = Deadline.after(timeout_s)
         # admission gate: single choke point for the mesh and exec engines
         # (and the cache's per-extent sub-queries); over-capacity queries
-        # wait bounded by the deadline, then shed with QueryRejected (503)
-        with governor().admit(deadline=deadline, cost=_admission_cost(plan),
+        # wait bounded by the deadline, then shed with QueryRejected (503).
+        # Standing-query evaluations (QueryContext.origin == "rules")
+        # admit as their own lowest-priority class.
+        cost = RULES if qcontext.origin == "rules" \
+            else _admission_cost(plan)
+        with governor().admit(deadline=deadline, cost=cost,
                               tenant=plan_tenant(plan)):
             if self.mesh_engine is not None and self._mesh_eligible() \
                     and self.mesh_engine.supports(plan):

@@ -106,9 +106,11 @@ class FastHttpServer:
 
     def __init__(self, services: dict, host="127.0.0.1", port=8080,
                  cluster=None, shard_maps=None, reuse_port: bool = False,
-                 response_cache: bool = True):
+                 response_cache: bool = True, rule_managers=None):
         self.services = services
         self.cluster = cluster
+        # dataset -> RuleManager (standing queries); serves /api/v1/rules
+        self.rule_managers = rule_managers or {}
         self.shard_maps = shard_maps or {}
         self.response_cache = ResponseCache() if response_cache else None
         self.dispatcher = HttpDispatcher(self)

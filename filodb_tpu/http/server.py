@@ -119,7 +119,10 @@ def response_cache_key(svc, kind: str, params: tuple) -> tuple:
     never ``id()``: a new service allocated at a freed service's address
     would alias its cache entries (stale responses for a different
     dataset/epoch)."""
-    serial = getattr(svc, "serial", None) or id(svc)
+    serial = getattr(svc, "serial", None)
+    if serial is None:  # not `or`: a legitimate serial of 0 must not
+        serial = id(svc)  # fall back to an aliasable address
+
     if kind == "instant":
         return (serial, "instant", params[0], params[1])
     return (serial, "range", *params)
@@ -208,7 +211,23 @@ class HttpDispatcher:
         if len(parts) >= 3 and parts[0] == "api" and parts[1] == "v1" \
                 and parts[2] == "cluster":
             return self._cluster_api(parts[3:], qs)
+        if parts == ["api", "v1", "rules"]:
+            # top-level Prom-compat view aggregating every dataset's groups
+            groups = []
+            for mgr in self._rule_managers().values():
+                groups.extend(mgr.rules_snapshot())
+            return self._json(200, {"status": "success",
+                                    "data": {"groups": groups}})
+        if parts == ["api", "v1", "alerts"]:
+            alerts = []
+            for mgr in self._rule_managers().values():
+                alerts.extend(mgr.alerts_snapshot())
+            return self._json(200, {"status": "success",
+                                    "data": {"alerts": alerts}})
         return self._json(404, promjson.error_json("not found", "not_found"))
+
+    def _rule_managers(self) -> dict:
+        return getattr(self.app, "rule_managers", None) or {}
 
     # -- Prom API --
 
@@ -281,6 +300,16 @@ class HttpDispatcher:
                 label = "_metric_"
             vals = svc.memstore.label_values(svc.dataset, label)
             return self._json(200, {"status": "success", "data": vals})
+        if rest == ["rules"]:
+            mgr = self._rule_managers().get(svc.dataset)
+            groups = mgr.rules_snapshot() if mgr is not None else []
+            return self._json(200, {"status": "success",
+                                    "data": {"groups": groups}})
+        if rest == ["alerts"]:
+            mgr = self._rule_managers().get(svc.dataset)
+            alerts = mgr.alerts_snapshot() if mgr is not None else []
+            return self._json(200, {"status": "success",
+                                    "data": {"alerts": alerts}})
         if rest == ["debug", "trace"]:
             # span-traced execution (reference: Kamon spans around exec,
             # ExecPlan.scala:101 / startODPSpan — surfaced here as JSON
@@ -478,9 +507,12 @@ class _ReusePortHTTPServer(ThreadingHTTPServer):
 class FiloHttpServer:
     def __init__(self, services: dict[str, QueryService], host="127.0.0.1",
                  port=8080, cluster=None, shard_maps=None,
-                 reuse_port: bool = False, response_cache: bool = True):
+                 reuse_port: bool = False, response_cache: bool = True,
+                 rule_managers=None):
         self.services = services
         self.cluster = cluster
+        # dataset -> RuleManager (standing queries); serves /api/v1/rules
+        self.rule_managers = rule_managers or {}
         # member mode: dataset -> mirrored ShardMapper (StatusActor
         # subscription) so members answer cluster-status queries locally
         self.shard_maps = shard_maps or {}

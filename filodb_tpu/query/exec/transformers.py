@@ -582,6 +582,11 @@ class ScalarOperationMapper(RangeVectorTransformer):
 
     def apply(self, data: StepMatrix) -> StepMatrix:
         v = jnp.asarray(data.values)
+        if v.size == 0:
+            # no series: comparing/combining an empty vector with a
+            # scalar is the empty vector (broadcast_to would reject
+            # shaping a stepped scalar to the (0, 0) values array)
+            return data.derive([k.drop_metric() for k in data.keys], v)
         if isinstance(self.scalar, ScalarResult):
             sc = jnp.asarray(self.scalar.values)[None, :]
         else:

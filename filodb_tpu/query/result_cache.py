@@ -319,6 +319,15 @@ class ResultCache:
         version = sum(s.data_version for s in shards)
         max_ts = min((s.max_ingested_ts for s in shards), default=-1)
         horizon = max_ts - self.config.ooo_allowance_ms
+        # standing-query hook (rules/manager.py): recording rules write
+        # series AT timestamps at/below the ingest horizon, i.e. inside
+        # the "immutable" region. Clamp immutability to what the rules
+        # have verifiably written so an extent of a rule-output series is
+        # never frozen before the rule's write lands; extents past the
+        # clamp carry a version stamp and self-invalidate on the write.
+        floor = getattr(svc, "rules_horizon_floor", None)
+        if floor is not None:
+            horizon = min(horizon, floor() if callable(floor) else floor)
         sig = plan_signature(plan)
 
         extent_ms = self.config.extent_steps * step
@@ -343,8 +352,11 @@ class ResultCache:
                 else:
                     misses += 1
                     sub = retime_extent(plan, fs, fe)
+                    # origin rides along so rule-driven sub-queries admit
+                    # under the governor's RULES class, not EXPENSIVE
                     r = svc._execute_uncached(
-                        sub, QueryContext(planner_params=pp),
+                        sub, QueryContext(planner_params=pp,
+                                          origin=qcontext.origin),
                         materialize=True)
                     if r.partial or r.warnings:
                         # degraded extents must not be cached OR spliced

@@ -1,0 +1,19 @@
+"""Standing queries: recording rules and alert evaluation on ingest."""
+
+from filodb_tpu.rules.model import (
+    AlertingRule,
+    RecordingRule,
+    RuleGroup,
+    load_groups,
+)
+from filodb_tpu.rules.manager import LogSink, MemstoreSink, RuleManager
+
+__all__ = [
+    "AlertingRule",
+    "RecordingRule",
+    "RuleGroup",
+    "RuleManager",
+    "LogSink",
+    "MemstoreSink",
+    "load_groups",
+]

@@ -26,6 +26,9 @@ from filodb_tpu.core.store.localstore import (
 # imported unconditionally so the filodb_objectstore_* metric families are
 # registered (and scrape-visible) regardless of the configured backend
 from filodb_tpu.core.store.objectstore import open_object_store
+# likewise the filodb_rules_*/filodb_alerts_* families render even with no
+# rule groups configured
+from filodb_tpu.rules import LogSink, RuleManager, load_groups
 from filodb_tpu.gateway.server import ContainerSink, GatewayServer
 from filodb_tpu.http.server import FiloHttpServer
 from filodb_tpu.kafka.log import SegmentedFileLog
@@ -268,6 +271,7 @@ class FiloServer:
         # every node, not just failover-enabled ones
         self.is_coordinator = not cfg.seeds
         services = {}
+        self.rule_managers: dict[str, RuleManager] = {}
         if cfg.seeds:
             # member role: register with the coordinator; shard assignments
             # arrive as start_shard control messages
@@ -333,6 +337,25 @@ class FiloServer:
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
             self.cluster.start_failure_detector()
+            # standing queries: one RuleManager per dataset with groups,
+            # writing outputs through the shard WAL (first-class series)
+            rules_cfg = cfg.rules or {}
+            if rules_cfg.get("groups"):
+                first_ds = next(iter(cfg.datasets))
+                by_ds: dict[str, list] = {}
+                for grp in load_groups(rules_cfg, first_ds):
+                    by_ds.setdefault(grp.dataset, []).append(grp)
+                for ds, grps in by_ds.items():
+                    ing = cfg.datasets[ds]
+                    sink = LogSink(
+                        {s: self._shard_log(ds, s)
+                         for s in range(ing.num_shards)},
+                        ing.num_shards, cfg.spreads.get(ds, 1))
+                    self.rule_managers[ds] = RuleManager(
+                        services[ds], sink, grps,
+                        max_catchup_steps=int(
+                            rules_cfg.get("max_catchup_steps", 512))
+                    ).start(float(rules_cfg.get("tick_s", 1.0)))
         shard_maps = {
             name: (lambda n=name: self.shard_subscribers[n].mapper)
             for name in getattr(self, "shard_subscribers", {})
@@ -347,7 +370,8 @@ class FiloServer:
                              if not cfg.seeds else None,
                              shard_maps=shard_maps,
                              reuse_port=cfg.http_reuse_port,
-                             response_cache=cfg.http_response_cache).start()
+                             response_cache=cfg.http_response_cache,
+                             rule_managers=self.rule_managers).start()
         if cfg.gateway_port:
             first = next(iter(cfg.datasets.values()))
             sink = ContainerSink(
@@ -611,6 +635,8 @@ class FiloServer:
         self.is_coordinator = True
 
     def shutdown(self):
+        for mgr in getattr(self, "rule_managers", {}).values():
+            mgr.stop()
         if getattr(self, "watchdog", None) is not None:
             self.watchdog.stop()  # also resets the governor state to OK
         if getattr(self, "_failover_stop", None) is not None:

@@ -51,8 +51,11 @@ OK, DEGRADED, CRITICAL = "ok", "degraded", "critical"
 _STATE_VALUE = {OK: 0, DEGRADED: 1, CRITICAL: 2}
 
 # admission cost classes: "cheap" (instant/metadata — stays admissible under
-# CRITICAL) vs "expensive" (range scans — shed first under pressure)
-CHEAP, EXPENSIVE = "cheap", "expensive"
+# CRITICAL) vs "expensive" (range scans — shed first under pressure) vs
+# "rules" (background standing-query evaluation — strictly lowest priority:
+# capped by ``rules_max_inflight``, never queued, shed the moment the node
+# leaves OK; a shed evaluation just retries on a later tick)
+CHEAP, EXPENSIVE, RULES = "cheap", "expensive", "rules"
 
 
 # ---------------------------------------------------------------------------
@@ -90,7 +93,7 @@ _memory_util_gauge = Gauge("filodb_governor_memory_utilization")
 _admitted = Counter("filodb_governor_admitted")
 _rejected = {r: Counter("filodb_governor_rejected", {"reason": r})
              for r in ("capacity", "deadline", "queue_full", "critical",
-                       "tenant")}
+                       "tenant", "rules")}
 _transitions = {s: Counter("filodb_governor_transitions", {"to": s})
                 for s in (OK, DEGRADED, CRITICAL)}
 _budget_exceeded = Counter("filodb_governor_budget_exceeded")
@@ -122,6 +125,10 @@ class GovernorConfig:
     degraded_threshold: float = 0.75   # max source utilization -> degraded
     critical_threshold: float = 0.92   # max source utilization -> critical
     watchdog_interval_s: float = 0.5
+    # concurrent standing-query (rule) evaluations; rule evals are their
+    # own admission class so a pathological rule cannot starve
+    # interactive queries (they never queue and shed outside OK)
+    rules_max_inflight: int = 2
     # budget limits; 0 = unlimited (no budget attached to queries)
     max_samples_scanned: int = 0
     max_result_bytes: int = 0
@@ -314,6 +321,7 @@ class ResourceGovernor:
         self._cond = threading.Condition()
         self._inflight = 0
         self._waiters = 0
+        self._rules_inflight = 0
         self._tenant_inflight: dict[str, int] = {}
         self._state = OK
         _state_gauge.set(_STATE_VALUE[OK])
@@ -372,7 +380,7 @@ class ResourceGovernor:
         try:
             yield self
         finally:
-            self._release(tenant)
+            self._release(tenant, cost)
 
     def _tenant_gate(self, tenant: str) -> None:
         """Per-tenant concurrency cap; caller holds ``_cond``. Rejects
@@ -393,12 +401,30 @@ class ResourceGovernor:
         t0 = time.monotonic()
         with self._cond:
             self._tenant_gate(tenant)
+            if cost == RULES:
+                # background standing-query work: strictly lowest
+                # priority. Shed the moment the node leaves OK, cap
+                # concurrent evaluations, and never occupy the wait
+                # queue — interactive queries own it. A shed evaluation
+                # retries on a later tick with nothing lost.
+                if self._state != OK:
+                    self._reject("rules",
+                                 f"rule evaluation shed: node {self._state}")
+                cap = max(1, int(self.cfg.rules_max_inflight))
+                if self._rules_inflight >= cap:
+                    self._reject("rules",
+                                 f"rule evaluations at max_inflight={cap}")
+                if self._inflight >= self.capacity() or self._waiters:
+                    self._reject("rules",
+                                 "no spare capacity for rule evaluation")
+                self._admit_locked(t0, tenant, cost)
+                return
             if self._state == CRITICAL and cost == EXPENSIVE:
                 self._reject("critical",
                              "node under memory pressure; only cheap "
                              "queries admitted")
             if self._inflight < self.capacity() and self._waiters == 0:
-                self._admit_locked(t0, tenant)
+                self._admit_locked(t0, tenant, cost)
                 return
             if self._waiters >= cfg.admission_queue_limit:
                 self._reject("queue_full",
@@ -412,7 +438,7 @@ class ResourceGovernor:
                         self._reject("critical",
                                      "node went critical while queued")
                     if self._inflight < self.capacity():
-                        self._admit_locked(t0, tenant)
+                        self._admit_locked(t0, tenant, cost)
                         return
                     budget = cfg.max_queue_wait_s - (time.monotonic() - t0)
                     if deadline is not None:
@@ -430,11 +456,14 @@ class ResourceGovernor:
                 self._waiters -= 1
                 _queue_depth_gauge.set(self._waiters)
 
-    def _admit_locked(self, t0: float, tenant: str = "") -> None:
+    def _admit_locked(self, t0: float, tenant: str = "",
+                      cost: str = EXPENSIVE) -> None:
         self._inflight += 1
         _inflight_gauge.set(self._inflight)
         _admitted.inc()
         _queue_wait.observe(time.monotonic() - t0)
+        if cost == RULES:
+            self._rules_inflight += 1
         if tenant:
             n = self._tenant_inflight.get(tenant, 0) + 1
             self._tenant_inflight[tenant] = n
@@ -442,10 +471,12 @@ class ResourceGovernor:
             get_counter("filodb_tenant_admitted", {"tenant": tenant}).inc()
             _tenant_admitted.inc()
 
-    def _release(self, tenant: str = "") -> None:
+    def _release(self, tenant: str = "", cost: str = EXPENSIVE) -> None:
         with self._cond:
             self._inflight = max(0, self._inflight - 1)
             _inflight_gauge.set(self._inflight)
+            if cost == RULES:
+                self._rules_inflight = max(0, self._rules_inflight - 1)
             if tenant:
                 n = max(0, self._tenant_inflight.get(tenant, 0) - 1)
                 self._tenant_inflight[tenant] = n

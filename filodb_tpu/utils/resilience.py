@@ -413,6 +413,10 @@ class FaultInjector:
     - ``migration.*``       (ctx: dataset, shard, source, dest, phase) —
       live-migration kill-points, one per state transition
       (``coordinator/migration.py`` ``KILL_POINTS``)
+    - ``rules.eval``        (ctx: group, start, end) — standing-query group
+      evaluation start (``rules/manager.py``)
+    - ``rules.write``       (ctx: group, rule, count) — rule-output write,
+      fired before the sink append so a kill leaves the watermark unmoved
     """
 
     _faults: dict[str, list[Fault]] = {}

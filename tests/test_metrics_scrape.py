@@ -145,6 +145,30 @@ TENANT_NAMES = [
 ]
 
 
+# standing queries (filodb_tpu/rules) — registered at import; standalone
+# imports the package unconditionally, so the families render before (and
+# whether or not) any rule group is configured
+RULES_NAMES = [
+    "filodb_rules_groups",
+    "filodb_rules_evals_total",
+    "filodb_rules_eval_failures_total",
+    "filodb_rules_evals_shed_total",
+    "filodb_rules_steps_evaluated_total",
+    "filodb_rules_steps_skipped_total",
+    "filodb_rules_samples_written_total",
+    "filodb_rules_eval_seconds_bucket",
+    "filodb_rules_eval_seconds_count",
+    "filodb_rules_eval_seconds_sum",
+    "filodb_rules_last_eval_ts",
+]
+
+ALERTS_NAMES = [
+    "filodb_alerts_firing",
+    "filodb_alerts_pending",
+    "filodb_alerts_transitions_total",
+]
+
+
 # object-store durable tier (core/store/objectstore.py) — registered at
 # import; standalone imports the module regardless of the configured backend
 OBJECTSTORE_NAMES = [
@@ -260,6 +284,11 @@ class TestMetricsScrape:
         # per-tenant isolation families render before any tenant config
         missing_t = [n for n in TENANT_NAMES if n not in names_present]
         assert not missing_t, f"missing tenant metrics: {missing_t}"
+
+        # standing-query + alert families render with no rules configured
+        missing_r = [n for n in RULES_NAMES + ALERTS_NAMES
+                     if n not in names_present]
+        assert not missing_r, f"missing rules metrics: {missing_r}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])

@@ -1,0 +1,754 @@
+"""Standing queries: incremental recording rules and alert evaluation.
+
+The contract under test (see ``filodb_tpu/rules/manager.py``):
+
+- recorded series are equivalent to polling the same PromQL over the
+  same absolute step-aligned range (identical key sets, identical NaN
+  masks, values at kernel-dtype tolerance — the repo-wide equivalence
+  standard from test_result_cache.py);
+- per-tick evaluation cost is proportional to newly-completed steps ONLY
+  (asserted via the evaluated-steps counter: idle ticks cost zero);
+- alerts run the inactive→pending→firing machine with ``for:``
+  hysteresis and emit synthetic ``ALERTS``/``ALERTS_FOR_STATE`` series;
+- state survives restart by recomputing from those series: a fresh
+  manager resumes at the durable watermark with no skipped extent and no
+  double-write;
+- kill-points (``rules.eval``, ``rules.write``) prove crash-at-any-point
+  safety: a failed tick leaves the watermark unmoved, and the retried
+  window deduplicates against whatever the crash left behind;
+- rule evaluations admit through the governor as their own lowest-
+  priority cost class and are shed (watermark unmoved) under pressure;
+- rule outputs pass per-tenant cardinality quotas like any other ingest.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.rules import (
+    AlertingRule,
+    MemstoreSink,
+    RecordingRule,
+    RuleGroup,
+    RuleManager,
+    load_groups,
+)
+from filodb_tpu.rules import manager as mgr_mod
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils import governor as gov
+from filodb_tpu.utils.resilience import FaultInjector
+
+NUM_SHARDS = 4
+START = 1_600_000_000          # epoch sec (NOT on the 60s grid)
+INTERVAL = 10_000              # ingest cadence, ms
+GROUP_MS = 60_000              # rule-group interval, ms
+
+# steps are absolute epoch multiples of the interval, never aligned to
+# the data start: the first complete step after START is this
+FIRST_STEP = (START * 1000 // GROUP_MS + 1) * GROUP_MS
+
+
+def build_store(n_samples, num_shards=NUM_SHARDS):
+    """Fresh store with gauge data in two namespaces (a single shard key
+    reaches only 2^spread shards; two cover all four)."""
+    ms = TimeSeriesMemStore()
+    for s in range(num_shards):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    keys = (machine_metrics_series(8, ns="App-0")
+            + machine_metrics_series(8, ns="App-1"))
+    extend(ms, keys, n_samples, num_shards)
+    return ms, keys
+
+
+def extend(ms, keys, n_samples, num_shards=NUM_SHARDS):
+    """Advance ingest to ``n_samples`` per series; the stream is
+    deterministic from the start, and shards deduplicate the re-sent
+    prefix as out-of-order, so only the new tail applies."""
+    ingest_routed(ms, "timeseries",
+                  gauge_stream(keys, n_samples, start_ms=START * 1000,
+                               interval_ms=INTERVAL, seed=11),
+                  num_shards, spread=1)
+
+
+def make_svc(ms, num_shards=NUM_SHARDS):
+    return QueryService(ms, "timeseries", num_shards, spread=1,
+                        result_cache={"extent_steps": 8,
+                                      "ooo_allowance_ms": 0})
+
+
+def make_manager(svc, ms, groups, num_shards=NUM_SHARDS, **kw):
+    sink = MemstoreSink(ms, "timeseries", num_shards, spread=1)
+    return RuleManager(svc, sink, groups, ooo_allowance_ms=0, **kw)
+
+
+def drain(mgr, limit=20):
+    """Tick until a tick evaluates nothing; returns total evaluations."""
+    total = 0
+    for _ in range(limit):
+        n = mgr.tick()
+        if n == 0:
+            return total
+        total += n
+    raise AssertionError("tick never converged")
+
+
+def rec_group(name="heap", expr="avg_over_time(heap_usage[3m])",
+              record="ns:heap:avg"):
+    return RuleGroup(name=name, interval_ms=GROUP_MS, dataset="timeseries",
+                     rules=(RecordingRule(record=record, expr=expr),))
+
+
+def series_rows(res):
+    """Index a range-query result's rows by (namespace, instance)."""
+    m = res.result
+    out = {}
+    for i, key in enumerate(m.keys):
+        labels = dict(key.labels)
+        out[(labels.get("_ns_"), labels["instance"])] = \
+            np.asarray(m.values)[i]
+    return out
+
+
+def assert_rows_equivalent(polled, recorded):
+    p, r = series_rows(polled), series_rows(recorded)
+    assert set(p) == set(r) and p
+    for k in p:
+        assert np.array_equal(np.isnan(p[k]), np.isnan(r[k])), k
+        # kernel-dtype tolerance (float32), the repo-wide standard:
+        # chunk batching may differ between the rule's extent evals and
+        # the single-shot poll, so the final ulp may too
+        assert np.allclose(p[k], r[k], rtol=2e-5, atol=1e-9,
+                           equal_nan=True), k
+
+
+class TestPollEquivalence:
+    def test_recorded_equals_polled(self):
+        # manager starts while only 5min of data exists (fresh start =
+        # one step), then ingest advances 35 more minutes and the
+        # manager catches up step by step
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        assert mgr.tick() == 1      # fresh start: exactly one step
+        wm0 = mgr._state["heap"].last_step
+        assert wm0 % GROUP_MS == 0  # absolute alignment
+        extend(ms, keys, 240)
+        drain(mgr)
+        wm = mgr._state["heap"].last_step
+        assert wm > wm0
+
+        control = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        polled = control.query_range("avg_over_time(heap_usage[3m])",
+                                     wm0 // 1000, 60, wm // 1000)
+        recorded = control.query_range("ns:heap:avg",
+                                       wm0 // 1000, 60, wm // 1000)
+        assert_rows_equivalent(polled, recorded)
+
+    def test_recorded_series_carry_source_and_rule_labels(self):
+        ms, keys = build_store(60)
+        svc = make_svc(ms)
+        g = RuleGroup(name="lbl", interval_ms=GROUP_MS,
+                      dataset="timeseries",
+                      rules=(RecordingRule(
+                          record="ns:heap:max",
+                          expr="max_over_time(heap_usage[2m])",
+                          labels=(("tier", "gold"),)),))
+        mgr = make_manager(svc, ms, [g])
+        drain(mgr)
+        res = svc.query_range('ns:heap:max{tier="gold"}',
+                              FIRST_STEP // 1000, 60,
+                              mgr._state["lbl"].last_step // 1000)
+        m = res.result
+        assert m.num_series == len(keys)
+        for key in m.keys:
+            labels = dict(key.labels)
+            assert labels["tier"] == "gold"
+            assert labels["_ws_"] == "demo"        # inherited, not default
+            assert labels["_ns_"] in ("App-0", "App-1")
+            assert "instance" in labels            # per-series identity
+
+
+class TestIncrementality:
+    def test_idle_ticks_cost_zero(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        c0 = mgr_mod.rules_steps_evaluated.value
+        assert mgr.tick() == 1
+        assert mgr_mod.rules_steps_evaluated.value == c0 + 1
+        for _ in range(3):          # no new data → no work at all
+            assert mgr.tick() == 0
+        assert mgr_mod.rules_steps_evaluated.value == c0 + 1
+
+    def test_cost_proportional_to_new_steps_only(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        mgr.tick()
+        wm = mgr._state["heap"].last_step
+        extend(ms, keys, 120)       # 15 more minutes of data
+        horizon = min(s.max_ingested_ts
+                      for s in ms.shards_for("timeseries"))
+        expected = (horizon // GROUP_MS * GROUP_MS - wm) // GROUP_MS
+        assert expected > 1
+        c0 = mgr_mod.rules_steps_evaluated.value
+        assert mgr.tick() == expected
+        assert mgr_mod.rules_steps_evaluated.value == c0 + expected
+        assert mgr.tick() == 0
+
+    def test_catchup_cap_skips_and_counts(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()], max_catchup_steps=4)
+        mgr.tick()
+        wm0 = mgr._state["heap"].last_step
+        s0 = mgr_mod.rules_steps_skipped.value
+        extend(ms, keys, 480)       # ~70 new steps, far over the cap
+        assert mgr.tick() == 4      # capped
+        assert mgr_mod.rules_steps_skipped.value > s0
+        assert mgr._state["heap"].last_step > wm0
+
+    def test_horizon_floor_tracks_watermark(self):
+        ms, keys = build_store(60)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        # unrecovered: floor is very negative → nothing frozen yet
+        assert svc.rules_horizon_floor() < 0
+        drain(mgr)
+        # MemstoreSink is synchronous: committed == visible
+        assert svc.rules_horizon_floor() == mgr._state["heap"].last_step
+
+
+def ingest_temp(ms, sink, values_by_index):
+    """Write a controlled single-series gauge through the sink (1-shard
+    stores only: keeps the ingest-progress horizon deterministic)."""
+    from filodb_tpu.core.partkey import PartKey
+    from filodb_tpu.core.record import IngestRecord, RecordContainer
+    labels = {"_ws_": "demo", "_ns_": "App-0", "_metric_": "temp",
+              "host": "h1"}
+    cont = RecordContainer()
+    for i, v in values_by_index:
+        cont.add(IngestRecord(PartKey.create("gauge", labels),
+                              START * 1000 + i * INTERVAL, (v,)))
+    sink.write(cont)
+
+
+class TestAlerting:
+    def make(self, for_ms=120_000):
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+        svc = make_svc(ms, num_shards=1)
+        sink = MemstoreSink(ms, "timeseries", 1, spread=0)
+        g = RuleGroup(
+            name="alerts", interval_ms=GROUP_MS, dataset="timeseries",
+            rules=(AlertingRule(alert="TempHigh", expr="avg(temp) > 0.5",
+                                for_ms=for_ms,
+                                annotations=(("summary", "too hot"),)),))
+        mgr = RuleManager(svc, sink, [g], ooo_allowance_ms=0)
+        return ms, svc, sink, mgr
+
+    def hot_after_cold(self, ms, sink, mgr):
+        """Cold 10min → tick (fresh start) → hot 10min → catch up;
+        returns (t0, wm): first hot-visible step and the watermark."""
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(60)])
+        mgr.tick()
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(60, 120)])
+        drain(mgr)
+        hot_ms = START * 1000 + 60 * INTERVAL
+        t0 = (hot_ms + GROUP_MS - 1) // GROUP_MS * GROUP_MS
+        return t0, mgr._state["alerts"].last_step
+
+    def test_pending_to_firing_with_for_hysteresis(self):
+        ms, svc, sink, mgr = self.make()
+        tr0 = mgr_mod.alerts_transitions.value
+        t0, wm = self.hot_after_cold(ms, sink, mgr)
+        snap = mgr.alerts_snapshot()
+        assert len(snap) == 1
+        a = snap[0]
+        assert a["state"] == "firing"
+        assert a["activeAt"] == t0 / 1000.0
+        assert a["labels"]["alertname"] == "TempHigh"
+        assert a["annotations"] == {"summary": "too hot"}
+
+        # synthetic series: pending exactly until for: elapses, firing on
+        pend = svc.query_range('ALERTS{alertstate="pending"}',
+                               t0 // 1000, 60, wm // 1000)
+        fire = svc.query_range('ALERTS{alertstate="firing"}',
+                               t0 // 1000, 60, wm // 1000)
+        pv = np.asarray(pend.result.values)[0]
+        fv = np.asarray(fire.result.values)[0]
+        # pending at t0 and t0+60; firing from t0+120 (for: 2m)
+        assert not math.isnan(pv[0]) and not math.isnan(pv[1])
+        assert math.isnan(fv[0]) and math.isnan(fv[1])
+        assert not np.isnan(fv[2:]).any()
+        # ALERTS_FOR_STATE carries seconds-active at each step — small
+        # integers, float32-exact (an epoch timestamp would not be)
+        fs = svc.query_range('ALERTS_FOR_STATE{alertname="TempHigh"}',
+                             t0 // 1000, 60, wm // 1000)
+        fsv = np.asarray(fs.result.values)[0]
+        want = np.arange(0, (wm - t0) // 1000 + 1, 60, dtype=float)
+        assert np.array_equal(fsv, want)
+        # transitions: inactive→pending and pending→firing at least
+        assert mgr_mod.alerts_transitions.value >= tr0 + 2
+        assert mgr_mod.alerts_firing.value >= 1
+
+    def test_recovery_resumes_firing_state(self):
+        ms, svc, sink, mgr = self.make()
+        t0, wm = self.hot_after_cold(ms, sink, mgr)
+        orig = mgr._state["alerts"].alert_states["TempHigh"]
+        assert orig, "precondition: alert active"
+
+        mgr2 = RuleManager(svc, sink, [mgr.groups[0]], ooo_allowance_ms=0)
+        assert mgr2.tick() == 0     # nothing re-evaluated
+        rec = mgr2._state["alerts"].alert_states["TempHigh"]
+        assert set(rec) == set(orig)
+        for k in orig:
+            assert rec[k].active_since_ms == orig[k].active_since_ms
+            assert rec[k].active_since_ms == t0
+            assert rec[k].firing and orig[k].firing
+
+    def test_alert_deactivates_when_condition_clears(self):
+        ms, svc, sink, mgr = self.make(for_ms=0)
+        # cold → hot 5min → cold again, phased so the manager actually
+        # evaluates through the whole episode
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(30)])
+        mgr.tick()
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(30, 60)])
+        drain(mgr)
+        assert mgr.alerts_snapshot(), "precondition: firing during episode"
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(60, 120)])
+        drain(mgr)
+        assert mgr.alerts_snapshot() == []      # back to inactive
+        # but the firing episode is durably recorded
+        wm = mgr._state["alerts"].last_step
+        res = svc.query_range('ALERTS{alertstate="firing"}',
+                              FIRST_STEP // 1000, 60, wm // 1000)
+        assert res.result.num_series == 1
+        assert not np.isnan(np.asarray(res.result.values)).all()
+
+
+class TestRestartRecovery:
+    def test_no_double_write_no_gap(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        mgr.tick()
+        extend(ms, keys, 180)
+        drain(mgr)
+        wm = mgr._state["heap"].last_step
+
+        def recorded_cells():
+            r = svc.query_range("ns:heap:avg", FIRST_STEP // 1000, 60,
+                                wm // 1000)
+            return int((~np.isnan(np.asarray(r.result.values))).sum())
+
+        cells = recorded_cells()
+        assert cells > 0
+        mgr2 = make_manager(svc, ms, [rec_group()])
+        assert mgr2.tick() == 0
+        assert mgr2._state["heap"].last_step == wm
+        assert recorded_cells() == cells        # no double-write
+
+        # each recorded step holds EXACTLY one stored sample per series
+        wm_lo = wm - 4 * GROUP_MS
+        r = svc.query_range("count_over_time(ns:heap:avg[60s])",
+                            wm_lo // 1000, 60, wm // 1000)
+        vals = np.asarray(r.result.values)
+        assert vals.size and np.all(vals[~np.isnan(vals)] == 1.0)
+
+
+class TestChaos:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        FaultInjector.reset()
+        yield
+        FaultInjector.reset()
+
+    def two_rule_group(self):
+        return RuleGroup(
+            name="pair", interval_ms=GROUP_MS, dataset="timeseries",
+            rules=(RecordingRule(record="ns:a",
+                                 expr="avg_over_time(heap_usage[3m])"),
+                   RecordingRule(record="ns:b",
+                                 expr="max_over_time(heap_usage[3m])")))
+
+    def test_kill_at_eval_holds_watermark(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        mgr.tick()
+        wm = mgr._state["heap"].last_step
+        extend(ms, keys, 90)
+        f0 = mgr_mod.rules_eval_failures.value
+        FaultInjector.arm("rules.eval", error=ConnectionError, times=1)
+        assert mgr.tick() == 0
+        assert mgr_mod.rules_eval_failures.value == f0 + 1
+        assert mgr._state["heap"].last_step == wm   # unmoved
+        # fault exhausted: the SAME window is retried — no skipped extent
+        assert mgr.tick() > 0
+        assert mgr._state["heap"].last_step > wm
+
+    def test_kill_mid_group_write_then_retry_dedups(self):
+        # fault on the SECOND rule's write: rule a's outputs land, the
+        # watermark does not — the retry must re-write a (deduplicated)
+        # and complete b with no gap and no double-write
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [self.two_rule_group()])
+        mgr.tick()
+        wm = mgr._state["pair"].last_step
+        extend(ms, keys, 90)
+        FaultInjector.arm("rules.write", error=ConnectionError,
+                          match=lambda ctx: ctx.get("rule") == "ns:b")
+        assert mgr.tick() == 0
+        assert mgr._state["pair"].last_step == wm
+        FaultInjector.reset()
+        drain(mgr)
+        wm2 = mgr._state["pair"].last_step
+        assert wm2 > wm
+
+        control = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        for rec, expr in (("ns:a", "avg_over_time(heap_usage[3m])"),
+                          ("ns:b", "max_over_time(heap_usage[3m])")):
+            assert_rows_equivalent(
+                control.query_range(expr, (wm + GROUP_MS) // 1000, 60,
+                                    wm2 // 1000),
+                control.query_range(rec, (wm + GROUP_MS) // 1000, 60,
+                                    wm2 // 1000))
+            # exactly one stored sample per step per series: the retried
+            # re-write of rule a was absorbed by out-of-order dedup
+            c = control.query_range(f"count_over_time({rec}[60s])",
+                                    (wm + GROUP_MS) // 1000, 60,
+                                    wm2 // 1000)
+            vals = np.asarray(c.result.values)
+            assert vals.size and np.all(vals[~np.isnan(vals)] == 1.0), rec
+
+    def test_kill_between_outputs_and_commit_record(self):
+        # crash after every rule output landed but before the watermark
+        # marker: restart recovers the OLD watermark and re-evaluates the
+        # window; dedup absorbs the duplicate outputs — no double-write
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        mgr.tick()
+        extend(ms, keys, 90)
+        wm = mgr._state["heap"].last_step
+
+        orig_write = mgr.sink.write
+        fired = {"n": 0}
+
+        def flaky_write(cont):
+            names = {r.part_key.label_map.get("_metric_")
+                     for r in cont.records}
+            if "FILODB_RULES_WATERMARK" in names and not fired["n"]:
+                fired["n"] = 1
+                raise ConnectionError("crash before commit record")
+            return orig_write(cont)
+
+        mgr.sink.write = flaky_write
+        assert mgr.tick() == 0                   # failed after outputs
+        assert mgr._state["heap"].last_step == wm
+        # restart from durable state only
+        mgr2 = make_manager(svc, ms, [rec_group()])
+        assert drain(mgr2) > 0
+        wm2 = mgr2._state["heap"].last_step
+        control = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        c = control.query_range("count_over_time(ns:heap:avg[60s])",
+                                (wm + GROUP_MS) // 1000, 60, wm2 // 1000)
+        vals = np.asarray(c.result.values)
+        assert vals.size and np.all(vals[~np.isnan(vals)] == 1.0)
+        assert not np.isnan(vals).any()          # and no gap
+
+
+class TestGovernorIntegration:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        gov.reset()
+        yield
+        gov.reset()
+
+    def test_shed_under_pressure_then_catchup_no_gap(self):
+        ms, keys = build_store(30)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        mgr.tick()
+        wm = mgr._state["heap"].last_step
+        extend(ms, keys, 90)
+        gov.governor().set_state(gov.DEGRADED)
+        s0 = mgr_mod.rules_evals_shed.value
+        assert mgr.tick() == 0
+        assert mgr_mod.rules_evals_shed.value == s0 + 1
+        assert mgr._state["heap"].last_step == wm   # unmoved
+        assert "shed" in mgr._state["heap"].last_error
+        gov.governor().set_state(gov.OK)
+        drain(mgr)
+        wm2 = mgr._state["heap"].last_step
+        assert wm2 > wm
+        # every step between the shed point and now was evaluated
+        r = svc.query_range("count_over_time(ns:heap:avg[60s])",
+                            (wm + GROUP_MS) // 1000, 60, wm2 // 1000)
+        vals = np.asarray(r.result.values)
+        assert vals.size and not np.isnan(vals).any()
+
+    def test_rules_cost_class_never_queues(self):
+        g = gov.ResourceGovernor(gov.GovernorConfig(rules_max_inflight=1))
+        with g.admit(cost=gov.RULES):
+            with pytest.raises(gov.QueryRejected) as ei:
+                with g.admit(cost=gov.RULES):
+                    pass
+            assert ei.value.reason == "rules"
+            # interactive queries are unaffected by the rules cap
+            with g.admit(cost=gov.EXPENSIVE):
+                pass
+        with g.admit(cost=gov.RULES):
+            pass
+
+    def test_rules_shed_when_capacity_contended(self):
+        g = gov.ResourceGovernor(gov.GovernorConfig(admission_capacity=1))
+        with g.admit(cost=gov.EXPENSIVE):
+            # a rule evaluation never waits behind interactive load
+            with pytest.raises(gov.QueryRejected) as ei:
+                with g.admit(cost=gov.RULES):
+                    pass
+            assert ei.value.reason == "rules"
+
+
+class TestTenantQuota:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        gov.reset()
+        yield
+        gov.reset()
+
+    def test_rule_outputs_respect_cardinality_quota(self):
+        from filodb_tpu.utils.metrics import get_counter
+        # quota must be configured BEFORE shard construction (quotas are
+        # applied to the tracker at setup)
+        gov.configure(tenants={"demo/App-0": {"max_series": 10}})
+        ms = TimeSeriesMemStore()
+        shard = ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100,
+                                                      groups_per_shard=4))
+        keys = machine_metrics_series(8, ns="App-0")
+        ingest_routed(ms, "timeseries",
+                      gauge_stream(keys, 60, start_ms=START * 1000,
+                                   interval_ms=INTERVAL, seed=11),
+                      1, spread=0)
+        svc = make_svc(ms, num_shards=1)
+        mgr = make_manager(svc, ms, [rec_group()], num_shards=1)
+        d0 = shard.stats.quota_dropped.value
+        drain(mgr)
+        # 8 source series fit the quota of 10; the rule's 8 outputs do
+        # not — the overflow is dropped and accounted to the tenant
+        assert shard.stats.quota_dropped.value > d0
+        assert get_counter("filodb_tenant_ingest_dropped",
+                           {"tenant": "demo/App-0"}).value > 0
+        assert shard.cardinality.cardinality(
+            ["demo", "App-0"]).active_ts == 10
+
+
+class TestResponseCacheIntegration:
+    def test_rule_writes_bump_service_version(self):
+        # regression (satellite): internal rule-output writes must bump
+        # the data_version the HTTP response cache keys on, so a cached
+        # pre-rule-write response can never be served afterwards
+        from filodb_tpu.http.server import service_version
+        ms, keys = build_store(60)
+        svc = make_svc(ms)
+        v0 = service_version(svc)
+        mgr = make_manager(svc, ms, [rec_group()])
+        assert drain(mgr) > 0
+        assert service_version(svc) > v0
+
+    def test_serial_zero_is_not_id_fallback(self):
+        from filodb_tpu.http.server import response_cache_key
+
+        class Svc:
+            serial = 0
+
+        key = response_cache_key(Svc(), "range", ("q", 1, 2, 3))
+        assert key[0] == 0          # serial 0 is legitimate, not falsy
+
+
+class TestStandaloneE2E:
+    """Boot the full server with a rules: config block: evaluation rides
+    the WAL (LogSink), outputs become first-class queryable series, and
+    the Prom-compat endpoints + CLI surface the state."""
+
+    @pytest.fixture
+    def server(self, tmp_path):
+        import json as _json
+        import socket as _socket
+
+        from filodb_tpu.config import ServerConfig
+        from filodb_tpu.standalone import FiloServer
+        cfg_path = tmp_path / "server.json"
+        cfg_path.write_text(_json.dumps({
+            "node_name": "rules-node",
+            "data_dir": str(tmp_path / "data"),
+            "http_port": 0,
+            "gateway_port": 0,
+            "rules": {
+                "tick_s": 0.2,
+                "groups": [{
+                    "name": "std", "interval": "60s",
+                    "rules": [
+                        {"record": "job:scrape:sum",
+                         "expr": "sum(scrape_metric)"},
+                        {"alert": "ScrapeAlive",
+                         "expr": "avg(scrape_metric) > -1",
+                         "annotations": {"summary": "scrape data flows"}},
+                    ]}]},
+            "datasets": {"timeseries": {
+                "num_shards": 2, "spread": 1,
+                "store": {"max_chunk_size": 50, "groups_per_shard": 2}}},
+        }))
+        cfg = ServerConfig.load(str(cfg_path))
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            object.__setattr__(cfg, "gateway_port", s.getsockname()[1])
+        srv = FiloServer(cfg).start()
+        yield srv
+        srv.shutdown()
+
+    def _get(self, port, path):
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            assert r.status == 200
+            return _json.load(r)
+
+    def test_rules_evaluate_and_surface_over_http(self, server, capsys):
+        import socket as _socket
+        import time as _time
+        srv = server
+        with _socket.create_connection(("127.0.0.1",
+                                        srv.gateway.port)) as s:
+            for i in range(150):
+                ts_ns = (START + i * 10) * 1_000_000_000
+                s.sendall(f"scrape_metric,host=h{i % 5},_ws_=demo,"
+                          f"_ns_=App-0 value={i} {ts_ns}\n".encode())
+        # rules use the default 300s ooo allowance here, so the horizon
+        # trails max ts by 5min — still leaves ~19 complete steps
+        deadline = _time.monotonic() + 30
+        doc = None
+        while _time.monotonic() < deadline:
+            srv.gateway.sink.flush()
+            doc = self._get(srv.http.port, "/api/v1/rules")
+            groups = doc["data"]["groups"]
+            if groups and groups[0]["watermark"]:
+                break
+            _time.sleep(0.3)
+        assert doc["status"] == "success"
+        g = doc["data"]["groups"][0]
+        assert g["name"] == "std" and g["watermark"], doc
+        kinds = {r["name"]: r["type"] for r in g["rules"]}
+        assert kinds == {"job:scrape:sum": "recording",
+                        "ScrapeAlive": "alerting"}
+        assert all(r["health"] == "ok" for r in g["rules"])
+
+        # the per-dataset Prom route serves the same groups
+        ds = self._get(srv.http.port, "/promql/timeseries/api/v1/rules")
+        assert ds["data"]["groups"][0]["name"] == "std"
+
+        # recorded output is a first-class queryable series over HTTP
+        wm = g["watermark"] // 1000
+        deadline = _time.monotonic() + 15
+        result = []
+        while _time.monotonic() < deadline:
+            q = self._get(
+                srv.http.port,
+                f"/promql/timeseries/api/v1/query_range?"
+                f"query=job:scrape:sum&start={wm - 300}&end={wm}&step=60")
+            result = q["data"]["result"]
+            if result and result[0]["values"]:  # NaN cells are elided
+                break
+            _time.sleep(0.3)
+        assert result, "recorded series never became queryable"
+
+        # the always-true alert fires (for: 0 → immediately)
+        alerts = self._get(srv.http.port, "/api/v1/alerts")["data"]["alerts"]
+        assert [a for a in alerts if a["state"] == "firing"
+                and a["labels"]["alertname"] == "ScrapeAlive"], alerts
+        assert alerts[0]["annotations"] == {"summary": "scrape data flows"}
+
+        # rules metrics made it to the exposition
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.http.port}/metrics") as r:
+            text = r.read().decode()
+        assert "filodb_rules_evals_total" in text
+        assert "filodb_alerts_firing" in text
+
+        # operator CLI renders groups + active alerts from the same API
+        from filodb_tpu.cli import main as cli_main
+        cli_main(["--host", f"127.0.0.1:{srv.http.port}", "rules"])
+        out = capsys.readouterr().out
+        assert "group std" in out
+        assert "job:scrape:sum" in out
+        assert "ScrapeAlive" in out and "firing" in out
+
+    def test_threaded_front_accepts_rule_managers(self):
+        # both HTTP fronts share the dispatcher; this smoke proves the
+        # threaded ctor accepts the wiring and serves the empty payloads
+        from filodb_tpu.http.server import FiloHttpServer
+        srv = FiloHttpServer({}, port=0, rule_managers={}).start()
+        try:
+            doc = self._get(srv.port, "/api/v1/rules")
+            assert doc == {"status": "success", "data": {"groups": []}}
+            doc = self._get(srv.port, "/api/v1/alerts")
+            assert doc == {"status": "success", "data": {"alerts": []}}
+        finally:
+            srv.stop()
+
+
+class TestModelValidation:
+    def test_load_groups_happy_path(self):
+        groups = load_groups({"groups": [
+            {"name": "g1", "interval": "2m", "rules": [
+                {"record": "job:x:avg", "expr": "avg(x)",
+                 "labels": {"team": "core"}},
+                {"alert": "XHigh", "expr": "avg(x) > 1", "for": "5m",
+                 "annotations": {"summary": "x too high"}},
+            ]}]}, "timeseries")
+        assert len(groups) == 1
+        g = groups[0]
+        assert g.interval_ms == 120_000 and g.dataset == "timeseries"
+        rec, al = g.rules
+        assert isinstance(rec, RecordingRule)
+        assert dict(rec.labels) == {"team": "core"}
+        assert isinstance(al, AlertingRule) and al.for_ms == 300_000
+
+    @pytest.mark.parametrize("block", [
+        {"groups": [{"name": "g", "rules": [
+            {"expr": "x"}]}]},                       # neither record/alert
+        {"groups": [{"name": "g", "rules": [
+            {"record": "a", "alert": "b", "expr": "x"}]}]},  # both
+        {"groups": [{"name": "g", "rules": [
+            {"record": "1bad", "expr": "x"}]}]},     # invalid name
+        {"groups": [{"name": "g", "rules": [
+            {"record": "a::b", "expr": "x"}]}]},     # reserved ::
+        {"groups": [{"name": "g", "rules": [
+            {"record": "ALERTS", "expr": "x"}]}]},   # reserved name
+        {"groups": [{"name": "g", "rules": [
+            {"record": "a", "expr": "x", "for": "5m"}]}]},  # for on record
+        {"groups": [{"name": "g", "rules": [
+            {"alert": "A", "expr": "x",
+             "labels": {"alertstate": "no"}}]}]},    # reserved label
+        {"groups": [{"name": "g", "interval": "500ms", "rules": []}]},
+        {"groups": [{"name": "g", "rules": []},
+                    {"name": "g", "rules": []}]},    # duplicate group
+        {"groups": [{"name": "g", "rules": [
+            {"record": "a", "expr": "x"},
+            {"record": "a", "expr": "y"}]}]},        # duplicate rule
+    ])
+    def test_load_groups_rejects(self, block):
+        with pytest.raises(ValueError):
+            load_groups(block, "timeseries")
