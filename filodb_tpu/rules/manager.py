@@ -44,7 +44,20 @@ immutable. The manager therefore publishes ``svc.rules_horizon_floor``
 (min over groups of the last step whose outputs are known VISIBLE in the
 memstore); the cache clamps its immutability horizon to that floor so an
 extent of a rule-output series can never be frozen before the rule's
-write lands.
+write lands. The floor is a plain int republished at every commit and
+read lock-free — the cache's per-query call never blocks behind an
+in-flight evaluation. A group that has not yet recovered contributes a
+BOUNDED conservative floor (recovery and catch-up never write below
+``horizon − (max_catchup_steps+1)·interval``) instead of an open-ended
+sentinel, so a group stuck before its first recovery costs cache
+efficiency over a bounded window only; ``filodb_rules_unrecovered_groups``
+surfaces how many groups are still pinning it.
+
+Locking: ``_eval_lock`` serializes ticks; ``_lock`` guards group state
+and is held only for brief snapshot reads and commit writes, never
+across query evaluation or sink writes — so ``/api/v1/rules`` and
+``/api/v1/alerts`` snapshots and interactive queries cannot stall
+behind a slow evaluation or a post-restart catch-up.
 """
 
 from __future__ import annotations
@@ -86,9 +99,18 @@ rules_steps_skipped = Counter("filodb_rules_steps_skipped")
 rules_samples_written = Counter("filodb_rules_samples_written")
 rules_eval_seconds = Histogram("filodb_rules_eval_seconds")
 rules_last_eval_ts = Gauge("filodb_rules_last_eval_ts")
+rules_unrecovered_groups = Gauge("filodb_rules_unrecovered_groups")
 alerts_firing = Gauge("filodb_alerts_firing")
 alerts_pending = Gauge("filodb_alerts_pending")
 alerts_transitions = Counter("filodb_alerts_transitions")
+
+
+def _q(value: str) -> str:
+    """Quote a string as a PromQL label-value literal. Group and alert
+    names are charset-validated at config load, but selector fragments
+    are still escaped here so a lexer-breaking name can never turn into
+    a silently never-recovering group."""
+    return '"' + value.replace("\\", "\\\\").replace('"', '\\"') + '"'
 
 
 class LogSink:
@@ -184,7 +206,13 @@ class RuleManager:
         self.default_labels = dict(default_labels
                                    or {"_ws_": "default", "_ns_": "default"})
         self._state = {g.name: _GroupState() for g in self.groups}
+        # _lock guards group state for brief commits/snapshots only;
+        # _eval_lock serializes ticks so queries and sink writes run
+        # without blocking state readers
         self._lock = threading.RLock()
+        self._eval_lock = threading.Lock()
+        self._floor = (1 << 62) if not self.groups else _UNRECOVERED
+        self._stalled_ticks = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         rules_groups.set(rules_groups.value + len(self.groups))
@@ -205,12 +233,51 @@ class RuleManager:
         return max_ts - self.ooo_allowance_ms
 
     def horizon_floor(self) -> int:
-        """Min over groups of the last shard-visible committed step."""
+        """Min over groups of the last shard-visible committed step.
+
+        Lock-free: the value is republished as a plain int at every
+        commit (a single attribute store/load is atomic in CPython), so
+        the result cache's per-query call can never block behind an
+        in-flight evaluation or catch-up."""
+        return self._floor
+
+    def _publish_floor(self, horizon: int) -> None:
+        """Recompute and publish the cache floor. A group that has not
+        recovered yet contributes ``horizon − (max_catchup_steps+1)·
+        interval`` — recovery's lookback and the catch-up cap both bound
+        how far back its writes can land — rather than the far-negative
+        sentinel, so the cache regression before first recovery covers a
+        bounded window only."""
+        floor = 1 << 62
+        unrecovered = 0
         with self._lock:
-            if not self.groups:
-                return 1 << 62
-            return min(self._state[g.name].visible_step
-                       for g in self.groups)
+            for g in self.groups:
+                st = self._state[g.name]
+                if st.last_step is None:
+                    unrecovered += 1
+                    floor = min(floor, horizon - (self.max_catchup_steps
+                                                  + 1) * g.interval_ms)
+                else:
+                    floor = min(floor, st.visible_step)
+        self._floor = floor
+        rules_unrecovered_groups.set(unrecovered)
+
+    def _note_no_horizon(self) -> None:
+        """No ingest progress yet: nothing to evaluate or recover, but
+        surface unrecovered groups so a floor stuck at the sentinel is
+        visible instead of a silent cache-efficiency drain."""
+        with self._lock:
+            unrecovered = sum(1 for g in self.groups
+                              if self._state[g.name].last_step is None)
+        rules_unrecovered_groups.set(unrecovered)
+        if not unrecovered:
+            return
+        self._stalled_ticks += 1
+        if self._stalled_ticks == 10 or self._stalled_ticks % 600 == 0:
+            log.warning(
+                "rules: no ingest horizon after %d ticks; %d group(s) "
+                "unrecovered, cache floor pinned at sentinel until data "
+                "flows", self._stalled_ticks, unrecovered)
 
     # ------------------------------------------------------------- loop
 
@@ -238,29 +305,42 @@ class RuleManager:
 
     def tick(self) -> int:
         """Evaluate every group over its newly-completed steps; returns
-        the number of (rule, step) evaluations performed."""
-        horizon = self.horizon_ms()
-        if horizon is None:
-            return 0
-        evaluated = 0
-        with self._lock:
+        the number of (rule, step) evaluations performed.
+
+        Queries and sink writes run WITHOUT the state lock: ``_lock`` is
+        taken only for the brief commit of each group's watermark and
+        alert state, so floor reads and snapshots never wait out a slow
+        evaluation. ``_eval_lock`` keeps ticks themselves serial."""
+        with self._eval_lock:
+            horizon = self.horizon_ms()
+            if horizon is None:
+                self._note_no_horizon()
+                return 0
+            self._stalled_ticks = 0
+            self._publish_floor(horizon)
+            evaluated = 0
             for g in self.groups:
                 st = self._state[g.name]
-                self._check_visibility(g, st)
+                with self._lock:
+                    self._check_visibility(g, st)
                 try:
                     evaluated += self._tick_group(g, st, horizon)
                 except governor_mod.QueryRejected as e:
                     # shed under pressure: watermark unmoved, the same
                     # window is retried next tick — no skipped extent
                     rules_evals_shed.inc()
-                    st.last_error = f"shed: {e}"
+                    with self._lock:
+                        st.last_error = f"shed: {e}"
                 except Exception as e:
                     rules_eval_failures.inc()
-                    st.last_error = str(e)
+                    with self._lock:
+                        st.last_error = str(e)
                     log.warning("rule group %s eval failed", g.name,
                                 exc_info=True)
-            self._update_alert_gauges()
-        return evaluated
+            with self._lock:
+                self._update_alert_gauges()
+            self._publish_floor(horizon)
+            return evaluated
 
     # ------------------------------------------------------ group eval
 
@@ -270,11 +350,12 @@ class RuleManager:
         if horizon < 0:
             return 0
         last_complete = (horizon // interval) * interval
-        if st.last_step is None:
-            self._recover(g, st, last_complete)
-        if last_complete <= st.last_step:
+        last_step = st.last_step
+        if last_step is None:
+            last_step = self._recover(g, st, last_complete)
+        if last_complete <= last_step:
             return 0
-        first = st.last_step + interval
+        first = last_step + interval
         nsteps = (last_complete - first) // interval + 1
         if nsteps > self.max_catchup_steps:
             skipped = nsteps - self.max_catchup_steps
@@ -292,7 +373,7 @@ class RuleManager:
             # in bounded memory for wide outputs; instead write per rule
             # and rely on idempotent re-writes, but stage alert-state
             # commits so a mid-group failure retries from clean state
-            staged_states: dict[str, dict] = {}
+            staged_states: dict[str, tuple[dict, int]] = {}
             offsets: dict[int, int] = {}
             for rule in g.rules:
                 res = self.svc.query_range(
@@ -305,9 +386,10 @@ class RuleManager:
                 if isinstance(rule, RecordingRule):
                     samples = self._recording_samples(rule, res)
                 else:
-                    samples, new_states = self._alerting_samples(
-                        g, rule, res, first, interval, last_complete)
-                    staged_states[rule.name] = new_states
+                    samples, new_states, transitions = \
+                        self._alerting_samples(g, rule, res, first,
+                                               interval, last_complete)
+                    staged_states[rule.name] = (new_states, transitions)
                 FaultInjector.fire("rules.write", group=g.name,
                                    rule=rule.name, count=len(samples))
                 if samples:
@@ -323,18 +405,29 @@ class RuleManager:
                 last_complete, last_complete / 1000.0)]))
             for s, o in offs.items():
                 offsets[s] = max(offsets.get(s, -1), o)
-        st.last_step = last_complete
-        for name, states in staged_states.items():
-            st.alert_states[name] = states
-        if offsets:
-            st.pending_offsets = offsets
-            st.pending_step = last_complete
-            self._check_visibility(g, st)
-        else:
-            st.visible_step = last_complete
-        st.last_error = ""
-        st.last_eval_wall = time.time()
-        st.last_eval_duration = time.perf_counter() - t0
+        with self._lock:
+            st.last_step = last_complete
+            for name, (states, transitions) in staged_states.items():
+                st.alert_states[name] = states
+                if transitions:
+                    # counted only here: a discarded stage (failed or
+                    # shed group) re-evaluates the same window next tick
+                    # and must not double-count its transitions
+                    alerts_transitions.inc(transitions)
+            if offsets:
+                if st.visible_step == _UNRECOVERED:
+                    # fresh start over a WAL sink: nothing was ever
+                    # written at or below the resume point, which
+                    # bounds the floor until the offsets are consumed
+                    st.visible_step = last_step
+                st.pending_offsets = offsets
+                st.pending_step = last_complete
+                self._check_visibility(g, st)
+            else:
+                st.visible_step = last_complete
+            st.last_error = ""
+            st.last_eval_wall = time.time()
+            st.last_eval_duration = time.perf_counter() - t0
         rules_evals.inc()
         rules_steps_evaluated.inc(nsteps * len(g.rules))
         rules_eval_seconds.observe(st.last_eval_duration)
@@ -365,8 +458,13 @@ class RuleManager:
     # -------------------------------------------------------- recovery
 
     def _recover(self, g: RuleGroup, st: _GroupState,
-                 last_complete: int) -> None:
-        """Resume the group from its durable commit record.
+                 last_complete: int) -> int:
+        """Resume the group from its durable commit record; returns the
+        watermark step to resume after. A recovered marker is committed
+        to group state immediately (outputs through it are durably
+        written); a FRESH START is not — its resume point carries no
+        recorded data, so it must not surface as a snapshot watermark
+        until the first window's outputs commit.
 
         ``max_over_time(marker[interval])`` windows are (t−i, t] — each
         step sees exactly the marker sample written AT that step, so
@@ -381,7 +479,7 @@ class RuleManager:
         wm = None
         if last_complete >= 0:
             q = (f'max_over_time({WATERMARK_METRIC}'
-                 f'{{group="{g.name}"}}[{g.interval_s}s])')
+                 f'{{group={_q(g.name)}}}[{g.interval_s}s])')
             res = self.svc.query_range(q, start // 1000, interval // 1000,
                                        last_complete // 1000,
                                        QueryContext(origin="rules"))
@@ -394,27 +492,30 @@ class RuleManager:
                 if idx.size:
                     wm = int(np.asarray(m.steps_ms)[idx[-1]])
         if wm is None:
-            st.last_step = last_complete - interval
-            st.visible_step = st.last_step
-            log.info("rule group %s: fresh start at %d", g.name,
-                     st.last_step)
-            return
-        st.last_step = wm
-        st.visible_step = wm
-        for rule in g.rules:
-            if isinstance(rule, AlertingRule):
-                st.alert_states[rule.name] = self._recover_alert_states(
-                    g, rule, wm)
+            fresh = last_complete - interval
+            log.info("rule group %s: fresh start at %d", g.name, fresh)
+            return fresh
+        recovered = {rule.name: self._recover_alert_states(g, rule, wm)
+                     for rule in g.rules if isinstance(rule, AlertingRule)}
+        with self._lock:
+            st.last_step = wm
+            st.visible_step = wm
+            st.alert_states.update(recovered)
         log.info("rule group %s: recovered watermark %d", g.name, wm)
+        return wm
 
     def _recover_alert_states(self, g: RuleGroup, rule: AlertingRule,
                               wm: int) -> dict:
         """``ALERTS_FOR_STATE`` values are SECONDS-ACTIVE at the sample's
         own step (not the activation timestamp, which float32 query
         materialization could not carry exactly); the activation time is
-        reconstructed as ``wm − value``."""
+        reconstructed as ``wm − value``. The selector is scoped by the
+        ``_group_`` stamp the evaluator puts on every for-state sample:
+        an equally-named alert in another group (or a leftover series
+        from a deleted rule elsewhere) must not resurrect here."""
         q = (f'max_over_time({ALERTS_FOR_STATE_METRIC}'
-             f'{{alertname="{rule.name}"}}[{g.interval_s}s])')
+             f'{{alertname={_q(rule.name)},_group_={_q(g.name)}}}'
+             f'[{g.interval_s}s])')
         res = self.svc.query_range(q, wm // 1000, g.interval_s, wm // 1000,
                                    QueryContext(origin="rules"))
         m = res.result
@@ -425,7 +526,8 @@ class RuleManager:
                 continue
             active_since = wm - int(round(v)) * 1000
             labels = tuple(sorted(
-                (k, val) for k, val in key.labels if k != "_metric_"))
+                (k, val) for k, val in key.labels
+                if k not in ("_metric_", "_group_")))
             states[labels] = AlertState(
                 active_since_ms=active_since,
                 firing=(wm - active_since) >= rule.for_ms,
@@ -435,7 +537,10 @@ class RuleManager:
     # ------------------------------------------------------- rule eval
 
     def _output_labels(self, rule, series_labels) -> dict[str, str]:
-        out = {k: v for k, v in series_labels if k != "_metric_"}
+        # _group_ is system-owned (the for-state recovery scope stamp)
+        # and never flows from inputs to outputs
+        out = {k: v for k, v in series_labels
+               if k not in ("_metric_", "_group_")}
         out.update(rule.labels)
         for k, v in self.default_labels.items():
             out.setdefault(k, v)
@@ -462,8 +567,9 @@ class RuleManager:
     def _alerting_samples(self, g: RuleGroup, rule: AlertingRule, res,
                           first: int, interval: int, last: int):
         """Run the inactive→pending→firing state machine over the new
-        steps; returns (samples, new_states) with state committed by the
-        caller only after the group's writes all succeed."""
+        steps; returns (samples, new_states, transitions) with state —
+        and the transition count — committed by the caller only after
+        the group's writes all succeed."""
         m = res.result
         vals = np.asarray(m.values, dtype=float) if m.num_series else None
         if vals is not None and vals.ndim != 2:
@@ -481,6 +587,7 @@ class RuleManager:
         steps = np.asarray(m.steps_ms) if m.num_series else np.arange(
             first, last + interval, interval, dtype=np.int64)
         samples = []
+        transitions = 0
         for j, ts in enumerate(int(t) for t in steps):
             active: dict = {}
             if vals is not None:
@@ -493,15 +600,15 @@ class RuleManager:
                 if stt is None:
                     states[k] = stt = AlertState(active_since_ms=ts,
                                                  firing=False, value=v)
-                    alerts_transitions.inc()  # inactive -> pending
+                    transitions += 1  # inactive -> pending
                 stt.value = v
                 firing = (ts - stt.active_since_ms) >= rule.for_ms
                 if firing and not stt.firing:
-                    alerts_transitions.inc()  # pending -> firing
+                    transitions += 1  # pending -> firing
                 stt.firing = firing
             for k in [k for k in states if k not in active]:
                 del states[k]
-                alerts_transitions.inc()  # -> inactive
+                transitions += 1  # -> inactive
             for k, stt in states.items():
                 labels = dict(k)
                 alert_labels = dict(labels)
@@ -511,12 +618,16 @@ class RuleManager:
                 samples.append((alert_labels, ts, 1.0))
                 for_labels = dict(labels)
                 for_labels["_metric_"] = ALERTS_FOR_STATE_METRIC
+                # recovery scope stamp: restart filters for-state by
+                # {alertname, _group_} so same-named alerts in other
+                # groups cannot cross-contaminate recovered state
+                for_labels["_group_"] = g.name
                 # seconds-active at this step: small enough to survive
                 # float32 query materialization exactly (epoch seconds
                 # would not); recovery computes wm − value
                 samples.append((for_labels, ts,
                                 (ts - stt.active_since_ms) / 1000.0))
-        return samples, states
+        return samples, states, transitions
 
     @staticmethod
     def _container(samples) -> RecordContainer:

@@ -22,13 +22,19 @@ from filodb_tpu.promql.parser import parse_duration_ms
 # metric::column extension and is rejected up front.
 _NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_:]*$")
 
+# group names are interpolated into recovery selectors as label values
+# (and so are alert names, which additionally must be valid metric names
+# per _NAME_RE, matching Prometheus); restrict both to a charset that
+# can never break the selector lexer — no quotes, backslashes, or braces
+_GROUP_NAME_RE = re.compile(r"^[A-Za-z0-9_.:/\- ]+$")
+
 # synthetic series owned by the manager; a recording rule shadowing one
 # would corrupt alert-state recovery
 _RESERVED_NAMES = {"ALERTS", "ALERTS_FOR_STATE", "FILODB_RULES_WATERMARK"}
 
-# labels a rule may not override: output identity and alert state are
-# assigned by the evaluator itself
-_RESERVED_LABELS = {"__name__", "_metric_", "alertstate"}
+# labels a rule may not override: output identity, alert state, and the
+# recovery scope stamp are assigned by the evaluator itself
+_RESERVED_LABELS = {"__name__", "_metric_", "alertstate", "_group_"}
 
 
 @dataclass(frozen=True)
@@ -123,8 +129,10 @@ def _load_rule(raw: dict, group: str):
                              f"for:/annotations:")
         return RecordingRule(record=name, expr=expr, labels=labels)
     name = str(raw["alert"])
-    if not name:
-        raise ValueError("rules: alert name must be non-empty")
+    if not _NAME_RE.match(name):
+        # alert names become the alertname label value AND the recovery
+        # selector; Prometheus applies the same metric-name restriction
+        raise ValueError(f"rules: invalid alert name {name!r}")
     for_ms = _duration_ms(raw.get("for", 0), f"alert {name!r} for:")
     if for_ms < 0:
         raise ValueError(f"rules: alert {name!r} for: must be >= 0")
@@ -148,6 +156,9 @@ def load_groups(block, default_dataset: str) -> list[RuleGroup]:
         if not isinstance(g, dict) or not g.get("name"):
             raise ValueError("rules: each group needs a name:")
         name = str(g["name"])
+        if not _GROUP_NAME_RE.match(name):
+            raise ValueError(f"rules: invalid group name {name!r} (group "
+                             f"names appear in recovery selectors)")
         if name in seen:
             raise ValueError(f"rules: duplicate group name {name!r}")
         seen.add(name)

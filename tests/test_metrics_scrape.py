@@ -160,6 +160,7 @@ RULES_NAMES = [
     "filodb_rules_eval_seconds_count",
     "filodb_rules_eval_seconds_sum",
     "filodb_rules_last_eval_ts",
+    "filodb_rules_unrecovered_groups",
 ]
 
 ALERTS_NAMES = [

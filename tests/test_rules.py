@@ -224,6 +224,59 @@ class TestIncrementality:
         # MemstoreSink is synchronous: committed == visible
         assert svc.rules_horizon_floor() == mgr._state["heap"].last_step
 
+    def test_horizon_floor_reads_never_block_on_state_lock(self):
+        # the result cache calls the floor on EVERY cached query; a slow
+        # evaluation (or catch-up) holding the state lock must not stall
+        # it — the floor is a plain published int, read lock-free
+        import threading
+
+        ms, keys = build_store(60)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()])
+        drain(mgr)
+        expect = mgr._state["heap"].last_step
+        acquired, release = threading.Event(), threading.Event()
+
+        def hold():
+            with mgr._lock:
+                acquired.set()
+                release.wait(5)
+
+        t = threading.Thread(target=hold, daemon=True)
+        t.start()
+        assert acquired.wait(5)
+        try:
+            assert svc.rules_horizon_floor() == expect
+        finally:
+            release.set()
+            t.join()
+
+    def test_unrecovered_floor_bounded_not_sentinel(self):
+        # a group stuck before first recovery must pin a BOUNDED floor
+        # (horizon − (max_catchup_steps+1)·interval), not −2^62: the
+        # cache-efficiency cost of a stuck group covers a bounded window
+        ms, keys = build_store(60)
+        svc = make_svc(ms)
+        mgr = make_manager(svc, ms, [rec_group()], max_catchup_steps=4)
+
+        def boom(*a, **kw):
+            raise RuntimeError("recovery unavailable")
+
+        mgr._recover = boom
+        f0 = mgr_mod.rules_eval_failures.value
+        assert mgr.tick() == 0
+        assert mgr_mod.rules_eval_failures.value == f0 + 1
+        horizon = min(s.max_ingested_ts
+                      for s in ms.shards_for("timeseries"))
+        assert svc.rules_horizon_floor() == horizon - 5 * GROUP_MS
+        assert mgr_mod.rules_unrecovered_groups.value == 1
+        # and the bound is conservative: recovery + full catch-up never
+        # write below it
+        del mgr._recover
+        drain(mgr)
+        assert mgr._state["heap"].last_step > horizon - 5 * GROUP_MS
+        assert mgr_mod.rules_unrecovered_groups.value == 0
+
 
 def ingest_temp(ms, sink, values_by_index):
     """Write a controlled single-series gauge through the sink (1-shard
@@ -313,6 +366,60 @@ class TestAlerting:
             assert rec[k].active_since_ms == orig[k].active_since_ms
             assert rec[k].active_since_ms == t0
             assert rec[k].firing and orig[k].firing
+
+    def test_transitions_counted_only_on_commit(self):
+        # a failed group write discards the staged alert states and the
+        # same window is re-evaluated next tick; the transitions counter
+        # must not count the discarded stage (unlike samples, a counter
+        # bump cannot be deduplicated on retry)
+        ms, svc, sink, mgr = self.make(for_ms=0)
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(30)])
+        mgr.tick()
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(30, 90)])
+        tr0 = mgr_mod.alerts_transitions.value
+        try:
+            FaultInjector.arm("rules.write", error=ConnectionError,
+                              times=1)
+            assert mgr.tick() == 0
+            assert mgr_mod.alerts_transitions.value == tr0
+        finally:
+            FaultInjector.reset()
+        drain(mgr)
+        # exactly one inactive→pending and one pending→firing (for: 0
+        # fires within the activation step), counted once despite the
+        # earlier discarded evaluation of the same window
+        assert mgr_mod.alerts_transitions.value == tr0 + 2
+
+    def test_recovery_scoped_to_group(self):
+        # two groups carry an equally-named alert; only one fires. The
+        # restarted manager must recover each group's state from ITS OWN
+        # for-state series (the _group_ stamp), not resurrect the other
+        # group's instance under different for:/expr semantics
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+        svc = make_svc(ms, num_shards=1)
+        sink = MemstoreSink(ms, "timeseries", 1, spread=0)
+
+        def grp(name, expr):
+            return RuleGroup(
+                name=name, interval_ms=GROUP_MS, dataset="timeseries",
+                rules=(AlertingRule(alert="TempHigh", expr=expr,
+                                    for_ms=0),))
+
+        groups = [grp("hot", "avg(temp) > 0.5"),
+                  grp("cold", "avg(temp) > 2")]
+        mgr = RuleManager(svc, sink, groups, ooo_allowance_ms=0)
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(120)])
+        drain(mgr)
+        assert mgr._state["hot"].alert_states["TempHigh"]
+        assert not mgr._state["cold"].alert_states.get("TempHigh")
+
+        mgr2 = RuleManager(svc, sink, groups, ooo_allowance_ms=0)
+        assert mgr2.tick() == 0
+        assert (set(mgr2._state["hot"].alert_states["TempHigh"])
+                == set(mgr._state["hot"].alert_states["TempHigh"]))
+        assert not mgr2._state["cold"].alert_states.get("TempHigh")
 
     def test_alert_deactivates_when_condition_clears(self):
         ms, svc, sink, mgr = self.make(for_ms=0)
@@ -742,6 +849,12 @@ class TestModelValidation:
         {"groups": [{"name": "g", "rules": [
             {"alert": "A", "expr": "x",
              "labels": {"alertstate": "no"}}]}]},    # reserved label
+        {"groups": [{"name": "g", "rules": [
+            {"alert": "A", "expr": "x",
+             "labels": {"_group_": "no"}}]}]},       # reserved scope stamp
+        {"groups": [{"name": 'g"x', "rules": []}]},  # lexer-breaking group
+        {"groups": [{"name": "g", "rules": [
+            {"alert": 'A{bad="l"}', "expr": "x"}]}]},  # lexer-breaking alert
         {"groups": [{"name": "g", "interval": "500ms", "rules": []}]},
         {"groups": [{"name": "g", "rules": []},
                     {"name": "g", "rules": []}]},    # duplicate group
