@@ -17,6 +17,22 @@ Scala/JVM/Akka/Cassandra) designed TPU-first:
 __version__ = "0.1.0"
 
 
+def _maybe_install_lockcheck():
+    # FILODB_LOCKCHECK=1 arms the debug runtime lock-order validator for
+    # the whole process. Must run at package import, before any filodb
+    # module creates its locks — later-created locks are the only ones
+    # the checker can wrap.
+    import os
+    if os.environ.get("FILODB_LOCKCHECK", "") not in ("", "0", "false"):
+        from filodb_tpu.utils import lockcheck
+        lockcheck.install(
+            strict=os.environ.get("FILODB_LOCKCHECK_STRICT",
+                                  "") not in ("", "0", "false"))
+
+
+_maybe_install_lockcheck()
+
+
 def __getattr__(name):
     # lazy convenience exports (keep bare import light; jax loads on demand)
     if name == "FiloClient":

@@ -1,0 +1,32 @@
+"""filolint — concurrency-discipline and invariant static analysis.
+
+Every review round before this package existed caught a concurrency
+defect by hand: PR 1's thread-unsafe shared ``ExecContext`` in gather
+workers, PR 5's compaction read race, PR 7's priority inversion from
+rule evaluation blocking behind the state lock. With 30+ lock
+instantiations across the tree, those bug classes are caught by a tool
+now — the ThreadSanitizer/MapReduce-linter move of shifting a defect
+class from review into CI.
+
+Passes (each a ``run(ctx) -> list[Finding]`` module):
+
+- :mod:`~filodb_tpu.analysis.lockdiscipline` — per-class lock graphs
+  from ``with self._lock:`` scopes; blocking calls under a held lock
+  (LD101), statically-approximated lock-order cycles (LD102), and
+  attributes mutated both under and outside any lock (LD103).
+- :mod:`~filodb_tpu.analysis.parity` — wire-registry closure (PR201/2),
+  ``filodb_*`` metric name parity with the scrape test's expected lists
+  (PR203/4), Prometheus name charset (PR205).
+- :mod:`~filodb_tpu.analysis.hotpath` — host syncs and Python-side
+  wall-clock/randomness inside jitted ``query/engine`` kernels
+  (HP301/2).
+
+Findings diff against a checked-in baseline (``conf/
+filolint_baseline.json``) so the CI gate (``tests/test_filolint.py``)
+fails only on NEW violations; see ``doc/static_analysis.md``.
+"""
+
+from filodb_tpu.analysis.model import Baseline, Finding
+from filodb_tpu.analysis.runner import AnalysisContext, run_all
+
+__all__ = ["AnalysisContext", "Baseline", "Finding", "run_all"]

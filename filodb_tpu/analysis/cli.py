@@ -1,0 +1,98 @@
+"""filolint command-line driver.
+
+Usage::
+
+    python tools/filolint.py [--root REPO] [--baseline PATH]
+                             [--update-baseline] [--format text|json]
+
+Exit status: 0 when every finding is baselined (stale baseline entries
+are warnings), 1 when new findings exist, 2 on analyzer errors (a file
+that fails to parse is an analyzer error, not a clean run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from filodb_tpu.analysis.model import Baseline
+from filodb_tpu.analysis.runner import AnalysisContext, run_all
+
+DEFAULT_BASELINE = os.path.join("conf", "filolint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="filolint",
+        description="FiloDB concurrency-discipline and invariant "
+                    "static analysis")
+    ap.add_argument("--root", default=".",
+                    help="repo root containing filodb_tpu/ "
+                         "(default: cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: "
+                         f"<root>/{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current finding "
+                         "set (existing justifications are kept; new "
+                         "entries get a TODO)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+
+    # parse errors must fail loudly — an unparseable file is unanalyzed
+    ctx = AnalysisContext.build(root)
+    if ctx.errors:
+        for e in ctx.errors:
+            print(f"filolint: parse error: {e}", file=sys.stderr)
+        return 2
+
+    findings = run_all(root)
+
+    if args.update_baseline:
+        bl = Baseline.load(baseline_path)
+        bl.update(findings)
+        bl.save(baseline_path)
+        print(f"filolint: wrote {len(bl.entries)} entries to "
+              f"{baseline_path}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        bl = Baseline.load(baseline_path)
+        new, stale = bl.diff(findings)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) | {"key": f.key} for f in new],
+            "stale_baseline": stale,
+            "total_findings": len(findings),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"filolint: warning: stale baseline entry "
+                  f"{e['key']} (finding no longer produced; remove it)",
+                  file=sys.stderr)
+        if new:
+            print(f"filolint: {len(new)} new finding(s) "
+                  f"({len(findings)} total, "
+                  f"{len(findings) - len(new)} baselined)",
+                  file=sys.stderr)
+        else:
+            print(f"filolint: clean ({len(findings)} baselined "
+                  f"finding(s))", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,16 @@ DEFAULTS = {
         "tick_s": 1.0,                # evaluation-loop poll interval
         "max_catchup_steps": 512,     # cap on steps replayed per tick
         "groups": [],
+        # alert notification egress (rules/notify.py): Alertmanager-style
+        # webhook POSTed on alert state transitions. webhook_url=None
+        # disables egress entirely. Delivery is at-most-once off a
+        # bounded queue; the POST never runs under the manager's locks.
+        "notify": {
+            "webhook_url": None,
+            "timeout_s": 5.0,         # per-POST socket timeout
+            "max_attempts": 4,        # RetryPolicy attempts per batch
+            "queue_depth": 256,       # pending batches before dropping
+        },
     },
     # durable-store backend selection. "local" = sqlite-per-shard on
     # data_dir (default); "object" = S3-compatible object-store tier

@@ -443,7 +443,7 @@ class TimeSeriesShard:
             self.stats.partitions_created.inc()
         self.stats.num_partitions.set(len(self.index))
 
-    def _ingest_native(self, raw: bytes, offset: int) -> int:
+    def _ingest_native_locked(self, raw: bytes, offset: int) -> int:
         """Fast lane: container bytes parsed + appended + sealed in C++.
         Returns rows ingested, or -1 → caller takes the host loop."""
         core = self._native_core
@@ -478,7 +478,7 @@ class TimeSeriesShard:
                 and not self.cardinality.has_quotas:
             raw = getattr(data.container, "raw", None)
             if raw is not None:
-                n = self._ingest_native(raw, offset)
+                n = self._ingest_native_locked(raw, offset)
                 if n >= 0:
                     return n
         n = 0

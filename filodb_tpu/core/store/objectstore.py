@@ -550,10 +550,17 @@ class ObjectStoreColumnStore(ColumnStore):
     def _state(self, dataset: str, shard: int) -> _ShardState:
         with self._lock:
             st = self._states.get((dataset, shard))
-            if st is None:
-                st = self._load_state(dataset, shard)
-                self._states[(dataset, shard)] = st
-            return st
+            if st is not None:
+                return st
+        # Cold load runs OUTSIDE _lock: recovery does retried network
+        # GETs per live segment, and holding the store lock across them
+        # would stall every other shard's reads and the uploader's
+        # completion marking for the whole recovery. Two racing loaders
+        # both pay the read; setdefault keeps the first committed state
+        # so any mutations applied to it are never discarded.
+        st = self._load_state(dataset, shard)
+        with self._lock:
+            return self._states.setdefault((dataset, shard), st)
 
     def _load_state(self, dataset: str, shard: int) -> _ShardState:
         """Cold-start recovery: manifest → full-GET each live segment

@@ -240,7 +240,7 @@ class _RemoteConn:
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
@@ -254,7 +254,7 @@ class _RemoteConn:
             self._sock = s
         return self._sock
 
-    def _drop(self) -> None:
+    def _drop_locked(self) -> None:
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -265,7 +265,7 @@ class _RemoteConn:
     def _roundtrip(self, msg):
         FaultInjector.fire("store.call", host=self.host, port=self.port,
                            op=msg[0])
-        sock = self._conn()
+        sock = self._conn_locked()
         _send_msg(sock, msg)
         return _recv_msg(sock)
 
@@ -282,14 +282,14 @@ class _RemoteConn:
                     try:
                         resp = self._roundtrip(msg)
                     except TRANSPORT_ERRORS:
-                        self._drop()
+                        self._drop_locked()
                         if not pooled:
                             raise
                         # stale pooled socket: one retry on a fresh
                         # connection
                         resp = self._roundtrip(msg)
                 except TRANSPORT_ERRORS:
-                    self._drop()
+                    self._drop_locked()
                     raise
         if resp[0] == "ok":
             return resp[1]

@@ -219,7 +219,7 @@ class KafkaProtocolClient:
 
     # -- transport --
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
@@ -245,7 +245,7 @@ class KafkaProtocolClient:
                 .string(self.client_id).done()
             frame = header + body
             try:
-                sock = self._conn()
+                sock = self._conn_locked()
                 sock.sendall(struct.pack(">i", len(frame)) + frame)
                 resp = self._read_frame(sock)
             except (ConnectionError, OSError):

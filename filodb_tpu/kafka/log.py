@@ -52,12 +52,18 @@ class InMemoryLog(ReplayLog):
 
     def read_from(self, offset: int) -> Iterator[SomeData]:
         start = max(offset, 0)
-        for i in range(start, len(self._entries)):
-            yield SomeData(self._entries[i], i)
+        # snapshot under the lock (taken at first next(), when the
+        # generator body runs): replay sees a consistent prefix instead
+        # of racing concurrent appends mid-iteration
+        with self._lock:
+            entries = self._entries[start:]
+        for i, container in enumerate(entries):
+            yield SomeData(container, start + i)
 
     @property
     def latest_offset(self) -> int:
-        return len(self._entries) - 1
+        with self._lock:
+            return len(self._entries) - 1
 
 
 class FileLog(ReplayLog):
@@ -109,6 +115,13 @@ class FileLog(ReplayLog):
         self._f = None if read_only else open(path, "ab")
 
     def _recover_scan(self):
+        # only called from __init__, but _count/_index are lock-guarded
+        # everywhere else — hold it here too so the invariant is uniform
+        # (and checkable) rather than "guarded except during recovery"
+        with self._lock:
+            self._recover_scan_locked()
+
+    def _recover_scan_locked(self):
         size = os.path.getsize(self.path)
         with open(self.path, "rb") as f:
             magic = f.read(5)
@@ -194,7 +207,8 @@ class FileLog(ReplayLog):
 
     @property
     def latest_offset(self) -> int:
-        return self._count - 1
+        with self._lock:
+            return self._count - 1
 
     def close(self):
         if self._f is not None:
@@ -292,9 +306,10 @@ class SegmentedFileLog(ReplayLog):
 
     @property
     def latest_offset(self) -> int:
-        if not self._segments:
-            return -1
-        first, seg = self._segments[-1]
+        with self._lock:
+            if not self._segments:
+                return -1
+            first, seg = self._segments[-1]
         return first + seg.latest_offset
 
     def align_after(self, offset: int) -> None:
@@ -341,7 +356,8 @@ class SegmentedFileLog(ReplayLog):
 
     @property
     def earliest_offset(self) -> int:
-        return self._segments[0][0] if self._segments else 0
+        with self._lock:
+            return self._segments[0][0] if self._segments else 0
 
     def close(self):
         for _, seg in self._segments:

@@ -180,7 +180,7 @@ class RemoteLog(ReplayLog):
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
@@ -197,7 +197,7 @@ class RemoteLog(ReplayLog):
     def _call(self, *msg):
         with self._lock:
             try:
-                sock = self._conn()
+                sock = self._conn_locked()
                 _send_msg(sock, msg)
                 resp = _recv_msg(sock)
             except (ConnectionError, OSError):

@@ -394,7 +394,7 @@ class TagIndexNative:
             self.generation += 1
             self._lib.tagindex_purge_pid(self._h, pid)
 
-    def _out(self, fn, *args) -> np.ndarray:
+    def _out_locked(self, fn, *args) -> np.ndarray:
         n = fn(self._h, *args, _as_ptr(self._buf, ctypes.c_int32),
                len(self._buf))
         if n < 0:
@@ -408,7 +408,8 @@ class TagIndexNative:
         with self._lock:
             self._flush()
             lb, vb = label.encode(), value.encode()
-            return self._out(self._lib.tagindex_equals, lb, len(lb), vb, len(vb))
+            return self._out_locked(self._lib.tagindex_equals,
+                                    lb, len(lb), vb, len(vb))
 
     @staticmethod
     def encode_pairs(pairs: list[tuple[str, str]]) -> bytes:
@@ -432,7 +433,7 @@ class TagIndexNative:
         with self._lock:
             self._flush()
             bb = self.encode_pairs(pairs)
-            return self._out(
+            return self._out_locked(
                 lambda h, o, c: self._lib.tagindex_intersect_equals(
                     h, ctypes.cast(bb, ctypes.POINTER(ctypes.c_uint8)),
                     len(pairs), o, c))
@@ -486,7 +487,7 @@ class TagIndexNative:
         with self._lock:
             self._flush()
             lb = label.encode()
-            return self._out(self._lib.tagindex_label_all, lb, len(lb))
+            return self._out_locked(self._lib.tagindex_label_all, lb, len(lb))
 
     def values(self, label: str) -> list[str]:
         with self._lock:
@@ -513,7 +514,7 @@ class TagIndexNative:
             self._flush()
             lb = label.encode()
             vids = np.ascontiguousarray(vids, np.int32)
-            return self._out(
+            return self._out_locked(
                 lambda h, o, c: self._lib.tagindex_union_values(
                     h, lb, len(lb), _as_ptr(vids, ctypes.c_int32), len(vids),
                     o, c))

@@ -7,13 +7,16 @@ from filodb_tpu.rules.model import (
     load_groups,
 )
 from filodb_tpu.rules.manager import LogSink, MemstoreSink, RuleManager
+from filodb_tpu.rules.notify import AlertEvent, WebhookNotifier
 
 __all__ = [
+    "AlertEvent",
     "AlertingRule",
     "RecordingRule",
     "RuleGroup",
     "RuleManager",
     "LogSink",
     "MemstoreSink",
+    "WebhookNotifier",
     "load_groups",
 ]

@@ -74,6 +74,7 @@ from filodb_tpu.coordinator.ingestion import route_container
 from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.record import IngestRecord, RecordContainer, SomeData
 from filodb_tpu.query.model import QueryContext
+from filodb_tpu.rules import notify
 from filodb_tpu.rules.model import AlertingRule, RecordingRule, RuleGroup
 from filodb_tpu.utils import governor as governor_mod
 from filodb_tpu.utils.metrics import Counter, Gauge, Histogram, get_gauge
@@ -193,9 +194,15 @@ class RuleManager:
     def __init__(self, svc, sink, groups: list[RuleGroup],
                  ooo_allowance_ms: int | None = None,
                  max_catchup_steps: int = 512,
-                 default_labels: dict[str, str] | None = None):
+                 default_labels: dict[str, str] | None = None,
+                 notifier=None):
         self.svc = svc
         self.sink = sink
+        # WebhookNotifier (or anything with submit(events)); transition
+        # events are handed off AFTER the state-lock commit — the
+        # hand-off is non-blocking and the POST runs on the notifier's
+        # own worker (lock-discipline pass verifies the placement)
+        self._notifier = notifier
         self.groups = list(groups)
         if ooo_allowance_ms is None:
             rc = getattr(svc, "result_cache", None)
@@ -262,10 +269,11 @@ class RuleManager:
         self._floor = floor
         rules_unrecovered_groups.set(unrecovered)
 
-    def _note_no_horizon(self) -> None:
+    def _note_no_horizon_locked(self) -> None:
         """No ingest progress yet: nothing to evaluate or recover, but
         surface unrecovered groups so a floor stuck at the sentinel is
-        visible instead of a silent cache-efficiency drain."""
+        visible instead of a silent cache-efficiency drain. Caller holds
+        ``_eval_lock`` (guards ``_stalled_ticks``)."""
         with self._lock:
             unrecovered = sum(1 for g in self.groups
                               if self._state[g.name].last_step is None)
@@ -302,6 +310,8 @@ class RuleManager:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._notifier is not None:
+            self._notifier.close()
 
     def tick(self) -> int:
         """Evaluate every group over its newly-completed steps; returns
@@ -314,7 +324,7 @@ class RuleManager:
         with self._eval_lock:
             horizon = self.horizon_ms()
             if horizon is None:
-                self._note_no_horizon()
+                self._note_no_horizon_locked()
                 return 0
             self._stalled_ticks = 0
             self._publish_floor(horizon)
@@ -373,7 +383,7 @@ class RuleManager:
             # in bounded memory for wide outputs; instead write per rule
             # and rely on idempotent re-writes, but stage alert-state
             # commits so a mid-group failure retries from clean state
-            staged_states: dict[str, tuple[dict, int]] = {}
+            staged_states: dict[str, tuple[dict, int, list]] = {}
             offsets: dict[int, int] = {}
             for rule in g.rules:
                 res = self.svc.query_range(
@@ -386,10 +396,13 @@ class RuleManager:
                 if isinstance(rule, RecordingRule):
                     samples = self._recording_samples(rule, res)
                 else:
-                    samples, new_states, transitions = \
+                    samples, new_states, transitions, changes = \
                         self._alerting_samples(g, rule, res, first,
                                                interval, last_complete)
-                    staged_states[rule.name] = (new_states, transitions)
+                    staged_states[rule.name] = (
+                        new_states, transitions,
+                        notify.events_from_transitions(
+                            g.name, rule.annotations, changes))
                 FaultInjector.fire("rules.write", group=g.name,
                                    rule=rule.name, count=len(samples))
                 if samples:
@@ -405,15 +418,19 @@ class RuleManager:
                 last_complete, last_complete / 1000.0)]))
             for s, o in offs.items():
                 offsets[s] = max(offsets.get(s, -1), o)
+        notify_events: list = []
         with self._lock:
             st.last_step = last_complete
-            for name, (states, transitions) in staged_states.items():
+            for name, (states, transitions, events) in \
+                    staged_states.items():
                 st.alert_states[name] = states
                 if transitions:
                     # counted only here: a discarded stage (failed or
                     # shed group) re-evaluates the same window next tick
-                    # and must not double-count its transitions
+                    # and must not double-count its transitions or
+                    # re-notify them
                     alerts_transitions.inc(transitions)
+                    notify_events.extend(events)
             if offsets:
                 if st.visible_step == _UNRECOVERED:
                     # fresh start over a WAL sink: nothing was ever
@@ -428,6 +445,10 @@ class RuleManager:
             st.last_error = ""
             st.last_eval_wall = time.time()
             st.last_eval_duration = time.perf_counter() - t0
+        # notification hand-off OUTSIDE _lock: submit() is a bounded
+        # put_nowait, and the webhook POST runs on the notifier's worker
+        if self._notifier is not None and notify_events:
+            self._notifier.submit(notify_events)
         rules_evals.inc()
         rules_steps_evaluated.inc(nsteps * len(g.rules))
         rules_eval_seconds.observe(st.last_eval_duration)
@@ -567,9 +588,11 @@ class RuleManager:
     def _alerting_samples(self, g: RuleGroup, rule: AlertingRule, res,
                           first: int, interval: int, last: int):
         """Run the inactive→pending→firing state machine over the new
-        steps; returns (samples, new_states, transitions) with state —
-        and the transition count — committed by the caller only after
-        the group's writes all succeed."""
+        steps; returns (samples, new_states, transitions, changes) with
+        state — and the transition count plus the notification change
+        list — committed by the caller only after the group's writes all
+        succeed. ``changes`` entries are
+        ``(labels_key, state, value, active_since_ms, ts_ms)``."""
         m = res.result
         vals = np.asarray(m.values, dtype=float) if m.num_series else None
         if vals is not None and vals.ndim != 2:
@@ -588,6 +611,7 @@ class RuleManager:
             first, last + interval, interval, dtype=np.int64)
         samples = []
         transitions = 0
+        changes: list = []
         for j, ts in enumerate(int(t) for t in steps):
             active: dict = {}
             if vals is not None:
@@ -601,14 +625,19 @@ class RuleManager:
                     states[k] = stt = AlertState(active_since_ms=ts,
                                                  firing=False, value=v)
                     transitions += 1  # inactive -> pending
+                    changes.append((k, notify.PENDING, v, ts, ts))
                 stt.value = v
                 firing = (ts - stt.active_since_ms) >= rule.for_ms
                 if firing and not stt.firing:
                     transitions += 1  # pending -> firing
+                    changes.append((k, notify.FIRING, v,
+                                    stt.active_since_ms, ts))
                 stt.firing = firing
             for k in [k for k in states if k not in active]:
-                del states[k]
+                prev = states.pop(k)
                 transitions += 1  # -> inactive
+                changes.append((k, notify.RESOLVED, prev.value,
+                                prev.active_since_ms, ts))
             for k, stt in states.items():
                 labels = dict(k)
                 alert_labels = dict(labels)
@@ -627,7 +656,7 @@ class RuleManager:
                 # would not); recovery computes wm − value
                 samples.append((for_labels, ts,
                                 (ts - stt.active_since_ms) / 1000.0))
-        return samples, states, transitions
+        return samples, states, transitions, changes
 
     @staticmethod
     def _container(samples) -> RecordContainer:

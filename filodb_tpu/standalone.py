@@ -115,6 +115,22 @@ class FiloServer:
                     fsync=self.config.wal_fsync, read_only=tailer)
         return self.logs[key]
 
+    @staticmethod
+    def _build_notifier(notify_cfg: dict):
+        """Webhook egress for alert transitions; None when unconfigured
+        (the common case — notifications stay opt-in per deployment)."""
+        url = notify_cfg.get("webhook_url")
+        if not url:
+            return None
+        from filodb_tpu.rules.notify import WebhookNotifier
+        from filodb_tpu.utils.resilience import RetryPolicy
+        return WebhookNotifier(
+            url, timeout_s=float(notify_cfg.get("timeout_s", 5.0)),
+            retry_policy=RetryPolicy(
+                max_attempts=int(notify_cfg.get("max_attempts", 4)),
+                base_backoff_s=0.1, max_backoff_s=2.0),
+            queue_depth=int(notify_cfg.get("queue_depth", 256)))
+
     # -- control handlers (member side; reference NodeCoordinatorActor) --
 
     def _handle_start_shard(self, dataset: str, shard: int):
@@ -345,6 +361,7 @@ class FiloServer:
                 by_ds: dict[str, list] = {}
                 for grp in load_groups(rules_cfg, first_ds):
                     by_ds.setdefault(grp.dataset, []).append(grp)
+                notify_cfg = rules_cfg.get("notify", {}) or {}
                 for ds, grps in by_ds.items():
                     ing = cfg.datasets[ds]
                     sink = LogSink(
@@ -354,7 +371,8 @@ class FiloServer:
                     self.rule_managers[ds] = RuleManager(
                         services[ds], sink, grps,
                         max_catchup_steps=int(
-                            rules_cfg.get("max_catchup_steps", 512))
+                            rules_cfg.get("max_catchup_steps", 512)),
+                        notifier=self._build_notifier(notify_cfg),
                     ).start(float(rules_cfg.get("tick_s", 1.0)))
         shard_maps = {
             name: (lambda n=name: self.shard_subscribers[n].mapper)

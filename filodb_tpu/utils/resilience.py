@@ -186,9 +186,9 @@ class CircuitBreaker:
     @property
     def state(self) -> str:
         with self._lock:
-            return self._effective_state()
+            return self._effective_state_locked()
 
-    def _effective_state(self) -> str:
+    def _effective_state_locked(self) -> str:
         if self._state == OPEN and \
                 self.clock() - self._opened_at >= self.reset_timeout_s:
             self._state = HALF_OPEN
@@ -204,7 +204,7 @@ class CircuitBreaker:
         """Whether a call may proceed now. In half-open, only a single
         probe is admitted until it reports back."""
         with self._lock:
-            st = self._effective_state()
+            st = self._effective_state_locked()
             if st == CLOSED:
                 return True
             if st == HALF_OPEN and not self._probing:

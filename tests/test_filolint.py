@@ -1,0 +1,649 @@
+"""filolint static-analysis suite (filodb_tpu/analysis/).
+
+Two layers:
+
+- fixture tests: each pass against small known-bad / known-good
+  sources written into a temp tree, including the PR 7
+  blocking-evaluation-under-lock regression shape;
+- the repo gate: ``run_all`` over THIS repo must produce no finding
+  outside ``conf/filolint_baseline.json``, and no baseline entry may
+  be stale or unjustified. This is the tier-1 enforcement point.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from filodb_tpu.analysis import (
+    AnalysisContext,
+    Baseline,
+    Finding,
+    run_all,
+)
+from filodb_tpu.analysis import cli, hotpath, lockdiscipline, parity
+from filodb_tpu.analysis.model import suppressed
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "conf", "filolint_baseline.json")
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(src))
+    return str(root)
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def run_pass(tmp_path, mod, files):
+    root = write_tree(tmp_path, files)
+    ctx = AnalysisContext.build(root)
+    assert not ctx.errors, ctx.errors
+    return mod.run(ctx)
+
+
+# --------------------------------------------------------------------------
+# LD101 blocking-under-lock
+
+class TestLockDiscipline:
+    def test_sleep_under_lock_flagged(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+            """})
+        assert codes(out) == ["LD101"]
+        assert "time.sleep" in out[0].message
+        assert out[0].symbol == "C.bad"
+
+    def test_pr7_regression_shape_query_under_lock(self, tmp_path):
+        # the PR 7 priority inversion: rule evaluation under the state
+        # lock, stalling lock-free readers behind a slow query
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class Manager:
+                def __init__(self, svc):
+                    self._lock = threading.Lock()
+                    self.svc = svc
+
+                def tick(self):
+                    with self._lock:
+                        return self.svc.query_range("expr", 0, 60, 600)
+            """})
+        assert codes(out) == ["LD101"]
+        assert "query_range" in out[0].detail
+
+    def test_transitive_self_call_chain(self, tmp_path):
+        # blocking two hops away: with lock -> self.a() -> self.b() ->
+        # sock.recv(); the closure expansion must surface the chain
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self, sock):
+                    self._lock = threading.Lock()
+                    self.sock = sock
+
+                def outer(self):
+                    with self._lock:
+                        self.a()
+
+                def a(self):
+                    return self.b()
+
+                def b(self):
+                    return self.sock.recv(4096)
+            """})
+        assert codes(out) == ["LD101"]
+        assert "a.b" in out[0].detail and "recv" in out[0].detail
+
+    def test_blocking_outside_lock_is_fine(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def fine(self):
+                    with self._lock:
+                        x = 1
+                    time.sleep(1)
+                    return x
+            """})
+        assert out == []
+
+    def test_condition_wait_exempts_own_lock(self, tmp_path):
+        # cond.wait() releases the condition's lock while waiting — the
+        # canonical producer/consumer shape must not be flagged
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._cond = threading.Condition(self._lock)
+
+                def wait_ready(self):
+                    with self._cond:
+                        self._cond.wait()
+            """})
+        assert out == []
+
+    def test_nested_def_has_its_own_lock_scope(self, tmp_path):
+        # a worker closure defined under a lock runs on its own thread:
+        # the held stack must not leak into it
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def start(self):
+                    with self._lock:
+                        def worker():
+                            time.sleep(1)
+                        self.t = threading.Thread(target=worker)
+            """})
+        assert codes(out) == []
+
+    def test_dict_get_is_not_a_queue_get(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.d = {}
+
+                def fine(self, k):
+                    with self._lock:
+                        return self.d.get(k)
+            """})
+        assert out == []
+
+    def test_queue_get_under_lock_flagged(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import queue, threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def bad(self):
+                    with self._lock:
+                        return self._q.get()
+            """})
+        assert codes(out) == ["LD101"]
+
+
+# --------------------------------------------------------------------------
+# LD102 lock-order cycles
+
+class TestLockOrder:
+    def test_opposite_orders_make_a_cycle(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """})
+        assert codes(out) == ["LD102"]
+        assert "C._a" in out[0].detail and "C._b" in out[0].detail
+
+    def test_consistent_order_is_fine(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """})
+        assert out == []
+
+    def test_cycle_through_self_call(self, tmp_path):
+        # one() holds A and calls helper() which takes B; two() nests A
+        # under B directly — the deferred-call edges must close the loop
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        self.helper()
+
+                def helper(self):
+                    with self._b:
+                        pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """})
+        assert codes(out) == ["LD102"]
+
+
+# --------------------------------------------------------------------------
+# LD103 mixed-guard attribute stores
+
+class TestMixedGuard:
+    def test_mixed_stores_flagged(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def guarded(self):
+                    with self._lock:
+                        self.n += 1
+
+                def unguarded(self):
+                    self.n = 0
+            """})
+        assert codes(out) == ["LD103"]
+        assert out[0].detail == "n"
+
+    def test_init_stores_do_not_count(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def guarded(self):
+                    with self._lock:
+                        self.n += 1
+            """})
+        assert out == []
+
+    def test_locked_suffix_convention_counts_as_guarded(self, tmp_path):
+        out = run_pass(tmp_path, lockdiscipline, {"filodb_tpu/m.py": """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def guarded(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def _bump_locked(self):
+                    self.n += 1
+            """})
+        assert out == []
+
+
+# --------------------------------------------------------------------------
+# parity pass
+
+WIRE_FIXTURE = """
+    def _build_registry():
+        registry = {}
+        for cls in (Frame, Ghost):
+            registry[cls.__name__] = cls
+        for base in (Plan,):
+            pass
+        return registry
+    """
+
+SCRAPE_FIXTURE = """
+    NAMES = [
+        "filodb_good_total",
+        "filodb_lazy_total",
+        "filodb_phantom_total",
+    ]
+    """
+
+
+class TestParity:
+    def run(self, tmp_path, files):
+        files.setdefault("filodb_tpu/coordinator/wire.py", WIRE_FIXTURE)
+        files.setdefault("tests/test_metrics_scrape.py", SCRAPE_FIXTURE)
+        return run_pass(tmp_path, parity, files)
+
+    def test_unregistered_nested_dataclass(self, tmp_path):
+        out = self.run(tmp_path, {"filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Inner:
+                x: int
+
+            @dataclass
+            class Frame:
+                inner: Inner
+
+            class Ghost:
+                pass
+
+            class Plan:
+                pass
+            """})
+        pr201 = [f for f in out if f.code == "PR201"]
+        assert [f.detail for f in pr201] == ["Inner"]
+
+    def test_stale_registry_name(self, tmp_path):
+        # Ghost is named in the registry but no class defines it
+        out = self.run(tmp_path, {"filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Frame:
+                x: int
+
+            class Plan:
+                pass
+            """})
+        pr202 = [f for f in out if f.code == "PR202"]
+        assert [f.detail for f in pr202] == ["Ghost"]
+
+    def test_subclass_walk_registers_children(self, tmp_path):
+        # SubPlan rides through the `for base in (Plan,)` walk: fields
+        # referencing it from a registered class are fine
+        out = self.run(tmp_path, {"filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            class Plan:
+                pass
+
+            @dataclass
+            class SubPlan(Plan):
+                x: int
+
+            @dataclass
+            class Frame:
+                plan: SubPlan
+
+            class Ghost:
+                pass
+            """})
+        assert [f for f in out if f.code == "PR201"] == []
+
+    def test_wire_fields_must_be_registered(self, tmp_path):
+        out = self.run(tmp_path, {"filodb_tpu/model.py": """
+            class Frame:
+                pass
+
+            class Ghost:
+                pass
+
+            class Plan:
+                pass
+
+            class Orphan:
+                __wire_fields__ = ("x",)
+            """})
+        pr201 = [f for f in out if f.code == "PR201"]
+        assert [f.detail for f in pr201] == ["Orphan"]
+
+    def test_metric_parity(self, tmp_path):
+        out = self.run(tmp_path, {"filodb_tpu/metrics_mod.py": """
+            from filodb_tpu.utils.metrics import Counter, GaugeFn
+
+            good = Counter("filodb_good")
+            uncovered = Counter("filodb_uncovered")
+            ratio = GaugeFn("filodb_ratio", lambda: None)
+
+            def lazy():
+                return Counter("filodb_lazy")
+            """,
+            "filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Frame:
+                x: int
+
+            class Ghost:
+                pass
+
+            class Plan:
+                pass
+            """})
+        # uncovered: module-level, not asserted -> PR203
+        pr203 = [f for f in out if f.code == "PR203"]
+        assert [f.detail for f in pr203] == ["filodb_uncovered_total"]
+        # phantom: asserted, nothing produces it -> PR204; lazy counts
+        # as a producer, GaugeFn is exempt from PR203
+        pr204 = [f for f in out if f.code == "PR204"]
+        assert [f.detail for f in pr204] == ["filodb_phantom_total"]
+
+    def test_prom_charset(self, tmp_path):
+        out = self.run(tmp_path, {"filodb_tpu/metrics_mod.py": """
+            from filodb_tpu.utils.metrics import Counter
+
+            def lazy():
+                return Counter("filodb bad-name")
+            """,
+            "filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Frame:
+                x: int
+
+            class Ghost:
+                pass
+
+            class Plan:
+                pass
+            """})
+        pr205 = [f for f in out if f.code == "PR205"]
+        assert [f.detail for f in pr205] == ["filodb bad-name"]
+
+
+# --------------------------------------------------------------------------
+# hot-path pass
+
+class TestHotPath:
+    def test_host_sync_and_clock_in_kernel(self, tmp_path):
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/query/engine/k.py": """
+            import time
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def kernel(x, meta):
+                t = time.time()
+                v = x.item()
+                a = np.asarray(meta.steps)
+                return v + t + float(meta.window)
+            """})
+        assert codes(out) == ["HP301", "HP301", "HP301", "HP302"]
+
+    def test_nested_def_inherits_kernel_scope(self, tmp_path):
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/query/engine/k.py": """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                def inner(y):
+                    return y.item()
+                return inner(x)
+            """})
+        assert codes(out) == ["HP301"]
+        assert out[0].symbol == "kernel.inner"
+
+    def test_pallas_kernel_detected(self, tmp_path):
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/query/engine/k.py": """
+            from jax.experimental import pallas as pl
+
+            def body(ref, o_ref):
+                o_ref[...] = float(ref[...])
+
+            def launch(x):
+                return pl.pallas_call(body, out_shape=x)(x)
+            """})
+        assert codes(out) == ["HP301"]
+
+    def test_non_kernel_and_non_engine_ignored(self, tmp_path):
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/query/engine/k.py": """
+            def plain(x):
+                return x.item()
+            """,
+            "filodb_tpu/coordinator/c.py": """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                return x.item()
+            """})
+        assert out == []
+
+
+# --------------------------------------------------------------------------
+# model: suppression, baseline, CLI
+
+class TestModel:
+    def test_inline_suppression(self, tmp_path):
+        root = write_tree(tmp_path, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)  # filolint: disable=LD101
+            """})
+        out = run_all(root, passes=[lockdiscipline])
+        assert out == []
+
+    def test_suppression_is_code_scoped(self):
+        lines = ["x = 1  # filolint: disable=LD101"]
+        assert suppressed(lines, 1, "LD101")
+        assert not suppressed(lines, 1, "LD103")
+        assert suppressed(["y  # filolint: disable=all"], 1, "HP302")
+
+    def test_key_is_line_free(self):
+        a = Finding("LD101", "p.py", 10, "C.m", "d", "msg")
+        b = Finding("LD101", "p.py", 99, "C.m", "d", "msg")
+        assert a.key == b.key
+
+    def test_baseline_diff_and_update(self, tmp_path):
+        f1 = Finding("LD101", "p.py", 1, "C.m", "d1", "m1")
+        f2 = Finding("LD101", "p.py", 2, "C.m", "d2", "m2")
+        bl = Baseline()
+        bl.update([f1])
+        bl.entries[f1.key]["justification"] = "intentional"
+        new, stale = bl.diff([f1, f2])
+        assert [f.key for f in new] == [f2.key]
+        assert stale == []
+        new, stale = bl.diff([f2])
+        assert [e["key"] for e in stale] == [f1.key]
+        # update keeps the human-written justification
+        bl.update([f1, f2])
+        assert bl.entries[f1.key]["justification"] == "intentional"
+        assert "TODO" in bl.entries[f2.key]["justification"]
+        path = str(tmp_path / "bl.json")
+        bl.save(path)
+        assert Baseline.load(path).entries == bl.entries
+
+    def test_cli_gate_roundtrip(self, tmp_path, capsys):
+        root = write_tree(tmp_path, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+            """})
+        bl = str(tmp_path / "baseline.json")
+        assert cli.main(["--root", root, "--baseline", bl]) == 1
+        assert cli.main(["--root", root, "--baseline", bl,
+                         "--update-baseline"]) == 0
+        assert cli.main(["--root", root, "--baseline", bl]) == 0
+        out = json.loads(json.dumps(json.load(open(bl))))
+        assert out["entries"][0]["code"] == "LD101"
+        capsys.readouterr()
+
+    def test_cli_parse_error_exits_2(self, tmp_path, capsys):
+        root = write_tree(tmp_path,
+                          {"filodb_tpu/bad.py": "def broken(:\n"})
+        assert cli.main(["--root", root]) == 2
+        capsys.readouterr()
+
+
+# --------------------------------------------------------------------------
+# the repo gate (tier-1 enforcement)
+
+class TestRepoGate:
+    def test_repo_has_no_unbaselined_findings(self):
+        findings = run_all(REPO_ROOT)
+        bl = Baseline.load(BASELINE)
+        new, stale = bl.diff(findings)
+        assert not new, "new filolint findings (fix or baseline with " \
+            "justification):\n" + "\n".join(f.render() for f in new)
+        assert not stale, "stale baseline entries (remove them):\n" + \
+            "\n".join(e["key"] for e in stale)
+
+    def test_repo_parses_clean(self):
+        ctx = AnalysisContext.build(REPO_ROOT)
+        assert ctx.errors == []
+
+    def test_every_baseline_entry_is_justified(self):
+        bl = Baseline.load(BASELINE)
+        assert bl.entries, "baseline should exist and be non-empty"
+        unjustified = [k for k, e in bl.entries.items()
+                       if not e.get("justification")
+                       or "TODO" in e["justification"]]
+        assert not unjustified, unjustified

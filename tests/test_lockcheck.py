@@ -1,0 +1,240 @@
+"""Runtime lock-order validator (filodb_tpu/utils/lockcheck.py).
+
+Each scenario builds fresh locks INSIDE an installed session (only
+locks created after install are wrapped) and checks what the validator
+records — and, just as important, what it does not.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.utils import lockcheck
+
+
+@pytest.fixture(autouse=True)
+def _clean_install():
+    lockcheck.uninstall()
+    yield
+    lockcheck.uninstall()
+
+
+def make_locks(n=2):
+    # one lock per source line: the checker keys nodes by creation site,
+    # and same-site edges are skipped by design
+    out = []
+    for _ in range(n):
+        out.append(threading.Lock())
+    return out
+
+
+class TestCycleDetection:
+    def test_opposite_orders_recorded(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+            vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["lock-order-cycle"]
+
+    def test_consistent_order_clean(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+            vs = lockcheck.violations()
+        assert vs == []
+
+    def test_same_site_reacquisition_not_a_cycle(self):
+        # two instances of one class nest in both orders; the site graph
+        # cannot order instances, so this must stay silent (documented
+        # gap: the static pass / a dedicated hierarchy handles it)
+        with lockcheck.session():
+            a, b = make_locks(2)
+            with a:
+                with b:
+                    pass
+            vs = lockcheck.violations()
+        assert vs == []
+
+    def test_cycle_across_threads(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+
+            def other():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+            vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["lock-order-cycle"]
+
+    def test_strict_mode_raises(self):
+        with lockcheck.session(strict=True):
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with pytest.raises(lockcheck.LockOrderViolation):
+                with b:
+                    with a:
+                        pass
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_lock(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            with a:
+                time.sleep(0)
+            vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["blocking-under-lock"]
+        assert "time.sleep" in vs[0].detail
+
+    def test_queue_get_under_lock(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            q = queue.Queue()
+            q.put(1)
+            with a:
+                q.get()
+            vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["blocking-under-lock"]
+
+    def test_nonblocking_get_is_fine(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            q = queue.Queue()
+            q.put(1)
+            with a:
+                q.get(block=False)
+            vs = lockcheck.violations()
+        assert vs == []
+
+    def test_thread_join_under_lock(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            with a:
+                t.join()
+            vs = lockcheck.violations()
+        assert [v.kind for v in vs] == ["blocking-under-lock"]
+
+    def test_sleep_outside_lock_is_fine(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            with a:
+                pass
+            time.sleep(0)
+            vs = lockcheck.violations()
+        assert vs == []
+
+    def test_duplicate_shapes_reported_once(self):
+        with lockcheck.session():
+            a = threading.Lock()
+            for _ in range(5):
+                with a:
+                    time.sleep(0)
+            vs = lockcheck.violations()
+        assert len(vs) == 1
+
+
+class TestConditionCompat:
+    def test_condition_over_checked_rlock(self):
+        # Condition(wrapped RLock) relies on the private
+        # _release_save/_acquire_restore/_is_owned protocol; wait() must
+        # release the lock (else the notifier deadlocks) and not count
+        # as blocking under it
+        with lockcheck.session():
+            lk = threading.RLock()
+            cond = threading.Condition(lk)
+            ready = []
+
+            def producer():
+                with cond:
+                    ready.append(1)
+                    cond.notify()
+
+            t = threading.Thread(target=producer)
+            with cond:
+                t.start()
+                deadline = time.monotonic() + 5.0
+                while not ready and time.monotonic() < deadline:
+                    cond.wait(0.1)
+            t.join()
+            assert ready
+            vs = lockcheck.violations()
+        assert vs == []
+
+
+class TestLifecycle:
+    def test_install_uninstall_restores_primitives(self):
+        real_lock = threading.Lock
+        real_sleep = time.sleep
+        lockcheck.install(strict=False)
+        assert threading.Lock is not real_lock
+        assert lockcheck.installed()
+        lockcheck.uninstall()
+        assert threading.Lock is real_lock
+        assert time.sleep is real_sleep
+        assert not lockcheck.installed()
+
+    def test_locks_survive_uninstall(self):
+        # a wrapped lock created during the session keeps working after
+        # uninstall (worker threads may outlive a test session)
+        lockcheck.install(strict=False)
+        lk = threading.Lock()
+        lockcheck.uninstall()
+        with lk:
+            pass
+        assert not lk.locked()
+
+    def test_delegates_fork_hook(self):
+        # concurrent.futures registers _at_fork_reinit on a module-level
+        # lock; the wrapper must expose the full primitive surface
+        lockcheck.install(strict=False)
+        try:
+            lk = threading.Lock()
+            lk._at_fork_reinit()
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=1) as ex:
+                assert ex.submit(lambda: 42).result() == 42
+        finally:
+            lockcheck.uninstall()
+
+    def test_reset_clears_state(self):
+        lockcheck.install(strict=False)
+        a = threading.Lock()
+        with a:
+            time.sleep(0)
+        assert lockcheck.violations()
+        lockcheck.reset()
+        assert lockcheck.violations() == []
+        lockcheck.uninstall()
+
+    def test_env_flag(self, monkeypatch):
+        monkeypatch.delenv("FILODB_LOCKCHECK", raising=False)
+        assert not lockcheck.enabled_by_env()
+        monkeypatch.setenv("FILODB_LOCKCHECK", "0")
+        assert not lockcheck.enabled_by_env()
+        monkeypatch.setenv("FILODB_LOCKCHECK", "1")
+        assert lockcheck.enabled_by_env()

@@ -98,6 +98,15 @@ DIST_AGG_NAMES = [
     "filodb_wire_compress_bytes_out_total",
 ]
 
+# query-path resilience (coordinator/query_service.py, utils/resilience.py)
+# — counters registered at import; found missing by the filolint
+# metrics-parity pass (PR203), which now keeps these lists in step with
+# the source tree
+QUERY_RESILIENCE_NAMES = [
+    "filodb_partial_results_total",
+    "filodb_query_retries_total",
+]
+
 # overload protection (utils/governor.py, gateway/server.py) — gauges and
 # counters pre-registered at import so families render before any shed
 GOVERNOR_NAMES = [
@@ -167,6 +176,11 @@ ALERTS_NAMES = [
     "filodb_alerts_firing",
     "filodb_alerts_pending",
     "filodb_alerts_transitions_total",
+    # notification egress (rules/notify.py): registered at import even
+    # when no webhook is configured
+    "filodb_alerts_notifications_total",
+    "filodb_alerts_notification_failures_total",
+    "filodb_alerts_notifications_dropped_total",
 ]
 
 
@@ -271,6 +285,11 @@ class TestMetricsScrape:
         # (pre-registered at import so dashboards see stable zeros)
         missing_os = [n for n in OBJECTSTORE_NAMES if n not in names_present]
         assert not missing_os, f"missing objectstore metrics: {missing_os}"
+
+        # query-path resilience counters render from import time
+        missing_qr = [n for n in QUERY_RESILIENCE_NAMES
+                      if n not in names_present]
+        assert not missing_qr, f"missing resilience metrics: {missing_qr}"
 
         # governor + gateway overload families are exposed, and the range
         # query above passed the admission gate so admissions moved

@@ -1,0 +1,224 @@
+"""Alert notification egress (filodb_tpu/rules/notify.py).
+
+Covers the notifier in isolation (batching, retry, failure accounting,
+bounded-queue drops) and wired into the RuleManager group commit:
+transitions notify exactly once, discarded stages (failed group writes)
+never notify, and the hand-off from the evaluation thread stays
+non-blocking.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.rules import (
+    AlertingRule,
+    MemstoreSink,
+    RuleGroup,
+    RuleManager,
+    WebhookNotifier,
+)
+from filodb_tpu.rules import notify
+from filodb_tpu.utils.resilience import FaultInjector, RetryPolicy
+
+from tests.test_rules import (
+    GROUP_MS,
+    INTERVAL,
+    START,
+    drain,
+    ingest_temp,
+    make_svc,
+)
+
+
+def no_sleep_policy(max_attempts=2):
+    return RetryPolicy(max_attempts=max_attempts, base_backoff_s=0.0,
+                       max_backoff_s=0.0, sleep=lambda s: None)
+
+
+def make_notifier(post, **kw):
+    kw.setdefault("retry_policy", no_sleep_policy())
+    return WebhookNotifier("http://127.0.0.1:9/hook", post=post, **kw)
+
+
+def wait_for(pred, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError("condition not reached in time")
+
+
+def sample_events():
+    key = (("alertname", "TempHigh"), ("host", "h1"))
+    return notify.events_from_transitions(
+        "alerts", (("summary", "too hot"),),
+        [(key, notify.PENDING, 0.9, 1000, 1000),
+         (key, notify.FIRING, 1.2, 1000, 61000)])
+
+
+class TestWebhookNotifier:
+    def test_posts_alertmanager_style_batch(self):
+        posts = []
+        n = make_notifier(lambda b: posts.append(json.loads(b)))
+        assert n.submit(sample_events())
+        n.close()
+        assert len(posts) == 1
+        body = posts[0]
+        assert body["version"] == "4" and len(body["alerts"]) == 2
+        pend, fire = body["alerts"]
+        assert pend["state"] == "pending" and pend["status"] == "firing"
+        assert fire["state"] == "firing"
+        assert pend["labels"] == {"alertname": "TempHigh", "host": "h1"}
+        assert pend["annotations"] == {"summary": "too hot"}
+        assert fire["startsAt"] == 1.0 and fire["evaluatedAt"] == 61.0
+
+    def test_resolved_maps_to_resolved_status(self):
+        posts = []
+        n = make_notifier(lambda b: posts.append(json.loads(b)))
+        key = (("alertname", "TempHigh"),)
+        n.submit(notify.events_from_transitions(
+            "alerts", (), [(key, notify.RESOLVED, 1.2, 1000, 121000)]))
+        n.close()
+        assert posts[0]["alerts"][0]["status"] == "resolved"
+
+    def test_retry_then_success(self):
+        calls = []
+
+        def flaky(body):
+            calls.append(body)
+            if len(calls) == 1:
+                raise ConnectionError("transient")
+
+        before = notify.notifications_sent.value
+        n = make_notifier(flaky, retry_policy=no_sleep_policy(3))
+        n.submit(sample_events())
+        n.close()
+        assert len(calls) == 2
+        assert notify.notifications_sent.value == before + 2
+
+    def test_exhausted_retries_count_failures(self):
+        def down(body):
+            raise ConnectionError("refused")
+
+        before = notify.notification_failures.value
+        n = make_notifier(down)
+        n.submit(sample_events())
+        n.close()
+        assert notify.notification_failures.value == before + 2
+
+    def test_full_queue_drops_and_counts(self):
+        release = threading.Event()
+
+        def slow(body):
+            release.wait(5.0)
+
+        before = notify.notifications_dropped.value
+        n = make_notifier(slow, queue_depth=1)
+        evs = sample_events()
+        n.submit(evs)                    # taken by the worker, blocks
+        wait_for(lambda: n._q.empty())   # worker picked the first batch
+        assert n.submit(evs)             # fills the queue
+        assert not n.submit(evs)         # bounded: dropped, not blocked
+        assert notify.notifications_dropped.value == before + 2
+        release.set()
+        n.close()
+
+    def test_submit_empty_is_noop(self):
+        n = make_notifier(lambda b: pytest.fail("no POST expected"))
+        assert n.submit([])
+        n.close()
+
+    def test_fault_injection_site(self):
+        def ok(body):
+            pass
+
+        before = notify.notification_failures.value
+        n = make_notifier(ok)
+        try:
+            FaultInjector.arm("rules.notify", error=ConnectionError,
+                              times=1)
+            n.submit(sample_events())
+            n.close()
+        finally:
+            FaultInjector.reset()
+        # injected before the retry loop: whole batch fails
+        assert notify.notification_failures.value == before + 2
+
+
+class TestManagerIntegration:
+    def make(self, post, for_ms=0):
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+        svc = make_svc(ms, num_shards=1)
+        sink = MemstoreSink(ms, "timeseries", 1, spread=0)
+        g = RuleGroup(
+            name="alerts", interval_ms=GROUP_MS, dataset="timeseries",
+            rules=(AlertingRule(alert="TempHigh", expr="avg(temp) > 0.5",
+                                for_ms=for_ms,
+                                annotations=(("summary", "too hot"),)),))
+        n = make_notifier(post)
+        mgr = RuleManager(svc, sink, [g], ooo_allowance_ms=0, notifier=n)
+        return ms, svc, sink, mgr, n
+
+    def test_lifecycle_notifies_pending_firing_resolved(self):
+        posts = []
+        ms, svc, sink, mgr, n = self.make(
+            lambda b: posts.append(json.loads(b)), for_ms=120_000)
+        # cold → hot → cold again: full alert lifecycle
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(60)])
+        mgr.tick()
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(60, 120)])
+        drain(mgr)
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(120, 180)])
+        drain(mgr)
+        mgr.stop()                      # closes the notifier, drains queue
+        states = [a["state"] for body in posts for a in body["alerts"]]
+        assert states == ["pending", "firing", "resolved"]
+        al = posts[0]["alerts"][0]
+        assert al["labels"]["alertname"] == "TempHigh"
+        assert al["annotations"] == {"summary": "too hot"}
+
+    def test_discarded_stage_does_not_notify(self):
+        # a failed group write discards staged alert state; the same
+        # window re-evaluates next tick and must notify exactly once
+        posts = []
+        ms, svc, sink, mgr, n = self.make(
+            lambda b: posts.append(json.loads(b)))
+        ingest_temp(ms, sink, [(i, 0.0) for i in range(30)])
+        mgr.tick()
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(30, 90)])
+        try:
+            FaultInjector.arm("rules.write", error=ConnectionError,
+                              times=1)
+            assert mgr.tick() == 0
+        finally:
+            FaultInjector.reset()
+        drain(mgr)
+        mgr.stop()
+        states = [a["state"] for body in posts for a in body["alerts"]]
+        # for: 0 → pending and firing commit in the same evaluation
+        assert states == ["pending", "firing"]
+
+    def test_no_notifier_is_fine(self):
+        ms = TimeSeriesMemStore()
+        ms.setup("timeseries", 0, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+        svc = make_svc(ms, num_shards=1)
+        sink = MemstoreSink(ms, "timeseries", 1, spread=0)
+        g = RuleGroup(
+            name="alerts", interval_ms=GROUP_MS, dataset="timeseries",
+            rules=(AlertingRule(alert="TempHigh", expr="avg(temp) > 0.5",
+                                for_ms=0),))
+        mgr = RuleManager(svc, sink, [g], ooo_allowance_ms=0)
+        ingest_temp(ms, sink, [(i, 1.0) for i in range(60)])
+        drain(mgr)
+        mgr.stop()
+        assert mgr.alerts_snapshot()

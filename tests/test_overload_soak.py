@@ -17,6 +17,7 @@ import pytest
 from filodb_tpu.config import ServerConfig
 from filodb_tpu.standalone import FiloServer
 from filodb_tpu.utils import governor as gov
+from filodb_tpu.utils import lockcheck
 from filodb_tpu.utils.resilience import (
     DeadlineExceeded,
     FaultInjector,
@@ -59,9 +60,16 @@ def server(tmp_path):
     }))
     cfg = ServerConfig.load(str(cfg_path))
     object.__setattr__(cfg, "gateway_port", _free_port())
-    srv = FiloServer(cfg).start()
-    yield srv
-    srv.shutdown()
+    # runtime lock-order checker covers the whole soak: admission,
+    # watchdog, HTTP, and gateway locks are all created (wrapped) inside
+    # the session, and any order cycle or blocking call made under one
+    # of them during the 4x-overload run fails the test at teardown
+    with lockcheck.session():
+        srv = FiloServer(cfg).start()
+        yield srv
+        srv.shutdown()
+        vs = lockcheck.violations()
+    assert vs == [], [v.render() for v in vs]
     FaultInjector.reset()
     gov.reset()
     reset_breakers()

@@ -41,6 +41,7 @@ from filodb_tpu.rules import (
 from filodb_tpu.rules import manager as mgr_mod
 from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
 from filodb_tpu.utils import governor as gov
+from filodb_tpu.utils import lockcheck
 from filodb_tpu.utils.resilience import FaultInjector
 
 NUM_SHARDS = 4
@@ -474,9 +475,15 @@ class TestRestartRecovery:
 class TestChaos:
     @pytest.fixture(autouse=True)
     def _clean(self):
+        # runtime lock-order checker on for the whole chaos matrix: the
+        # fault-injected retry paths must never block under a manager
+        # lock or acquire locks in conflicting orders
         FaultInjector.reset()
-        yield
+        with lockcheck.session():
+            yield
+            vs = lockcheck.violations()
         FaultInjector.reset()
+        assert vs == [], [v.render() for v in vs]
 
     def two_rule_group(self):
         return RuleGroup(

@@ -1,0 +1,26 @@
+#!/usr/bin/env python3
+"""Run filolint (static concurrency/invariant analysis) over the repo.
+
+Thin wrapper so the tool works from a checkout without installation:
+
+    python tools/filolint.py                 # gate against the baseline
+    python tools/filolint.py --no-baseline   # show everything
+    python tools/filolint.py --update-baseline
+
+Installed entry point: ``filolint`` (see pyproject.toml).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from filodb_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = sys.argv[1:]
+    if not any(a.startswith("--root") for a in argv):
+        argv = ["--root", repo] + argv
+    sys.exit(main(argv))
