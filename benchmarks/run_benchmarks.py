@@ -480,6 +480,12 @@ def _bench_rules():
     return bench_rules()
 
 
+def _bench_tracing_overhead():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tracing_overhead import bench_tracing_overhead
+    return bench_tracing_overhead()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -498,6 +504,7 @@ ALL = {
     "objectstore": _bench_objectstore,
     "migration": _bench_migration,
     "rules": _bench_rules,
+    "tracing_overhead": _bench_tracing_overhead,
 }
 
 

@@ -128,6 +128,48 @@ def cmd_rules(args):
         print("(no active alerts)")
 
 
+def cmd_slowlog(args):
+    """Slow-query flight recorder dump: every query (or traced operation)
+    that exceeded ``slow_query_threshold_ms``, newest first, with merged
+    stats and — when sampled — the full distributed span tree
+    (``/promql/{dataset}/api/v1/debug/slow_queries``)."""
+    import urllib.request
+    qs = f"?limit={args.limit}" if args.limit else ""
+    url = (f"http://{args.host}/promql/{args.dataset}"
+           f"/api/v1/debug/slow_queries{qs}")
+    with urllib.request.urlopen(url) as r:
+        entries = json.load(r)["data"]["slow_queries"]
+    if not entries:
+        print("(flight recorder empty)")
+        return
+    if args.json:
+        print(json.dumps(entries, indent=2))
+        return
+    for e in entries:
+        import datetime as dt
+        when = dt.datetime.fromtimestamp(e.get("when", 0)) \
+            .strftime("%Y-%m-%d %H:%M:%S")
+        head = (f"{when}  {e.get('kind', 'query'):<10} "
+                f"{e.get('duration_ms', 0):>9.1f}ms "
+                f"sampled={str(e.get('sampled', False)).lower()}")
+        if e.get("query"):
+            head += f"  {e['query']}"
+        print(head)
+        for k in ("dataset", "group", "phase", "op"):
+            if e.get(k):
+                print(f"    {k}={e[k]}")
+        stats = e.get("stats") or {}
+        if stats:
+            print("    stats: " + " ".join(
+                f"{k}={v}" for k, v in sorted(stats.items()) if v))
+        for s in e.get("spans", []):
+            tags = " ".join(f"{k}={v}"
+                            for k, v in sorted((s.get("tags") or {}).items()))
+            print(f"    {'  ' * s.get('depth', 0)}"
+                  f"{s['name']} {s.get('duration_ms', 0):.3f}ms"
+                  + (f" [{tags}]" if tags else ""))
+
+
 def cmd_indexnames(args):
     cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
@@ -382,6 +424,11 @@ def main(argv=None):
     sub.add_parser("status")
     sub.add_parser("shardmap")
     sub.add_parser("rules")
+    p = sub.add_parser("slowlog")
+    p.add_argument("--limit", type=int, default=0,
+                   help="newest N entries (0 = everything retained)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
     sub.add_parser("indexnames")
     p = sub.add_parser("labelvalues")
     p.add_argument("label")
@@ -412,6 +459,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
             "shardmap": cmd_shardmap, "rules": cmd_rules,
+            "slowlog": cmd_slowlog,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
             "importcsv": cmd_importcsv, "promql": cmd_promql,
             "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,

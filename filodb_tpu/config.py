@@ -78,6 +78,16 @@ DEFAULTS = {
         # quota-dropped ingest), never its neighbors
         "tenants": {},
     },
+    # distributed query tracing + slow-query flight recorder
+    # (filodb_tpu.utils.tracing.TracingConfig): head-sampling rate for
+    # full span trees (deterministic on query_id), tail capture of any
+    # query/operation over the slow threshold into a bounded ring served
+    # at /api/v1/debug/slow_queries and `filo-cli slowlog`
+    "tracing": {
+        "sample_rate": 0.0,           # 0..1 fraction of queries traced
+        "slow_query_threshold_ms": 500.0,  # tail capture; 0 disables
+        "slowlog_capacity": 128,      # flight-recorder ring size
+    },
     # live shard migration / rebalancing (coordinator/migration.py)
     "migration": {
         "auto_rebalance": False,      # migrate shards off joining-node
@@ -182,6 +192,7 @@ class ServerConfig:
     store: dict = field(default_factory=dict)  # durable-store backend block
     migration: dict = field(default_factory=dict)  # live-migration knobs
     rules: dict = field(default_factory=dict)  # standing-query rule groups
+    tracing: dict = field(default_factory=dict)  # TracingConfig overrides
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -228,7 +239,8 @@ class ServerConfig:
             governor=cfg.get("governor", {}),
             store=cfg.get("store", {}),
             migration=cfg.get("migration", {}),
-            rules=cfg.get("rules", {}))
+            rules=cfg.get("rules", {}),
+            tracing=cfg.get("tracing", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -211,18 +211,25 @@ class ShardMigration:
         _started.inc()
         _active_gauge.set(_active_gauge.value + 1)
         try:
+            from filodb_tpu.utils.tracing import traced_operation
             if self.phase == PLANNED:
                 self._persist(PLANNED)
                 FaultInjector.fire("migration.plan", **self._ctx())
                 self._persist(SYNCING)
             if self.phase == SYNCING:
-                self._sync()
+                with traced_operation("migration", phase="sync",
+                                      shard=self.shard, dataset=self.dataset):
+                    self._sync()
                 self._persist(CATCHUP)
             if self.phase == CATCHUP:
-                self._catchup()
+                with traced_operation("migration", phase="catchup",
+                                      shard=self.shard, dataset=self.dataset):
+                    self._catchup()
                 self._persist(FLIPPING)
             if self.phase == FLIPPING:
-                self._flip()
+                with traced_operation("migration", phase="flip",
+                                      shard=self.shard, dataset=self.dataset):
+                    self._flip()
             _completed.inc()
             _seconds.observe(time.monotonic() - t0)
             log.info("migration %s/%d %s -> %s complete", self.dataset,

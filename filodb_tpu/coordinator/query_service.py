@@ -148,11 +148,18 @@ class QueryService:
     def query_range(self, promql: str, start_sec: int, step_sec: int,
                     end_sec: int, qcontext: QueryContext | None = None
                     ) -> QueryResult:
-        from filodb_tpu.utils.tracing import span
+        from filodb_tpu.utils.tracing import span, traced_query
+        qcontext = qcontext or QueryContext()
         params = TimeStepParams(start_sec, step_sec, end_sec)
-        with span("parse", promql=promql):
-            plan = self._parse_cached(promql, params)
-        return self.execute_logical(plan, qcontext)
+        # traced_query: joins an active trace (debug endpoint, rules tick)
+        # or head-samples a new one; on exit feeds stage histograms and
+        # tail-captures slow queries into the flight recorder
+        with traced_query(qcontext, query=promql, dataset=self.dataset) as rec:
+            with span("parse", promql=promql):
+                plan = self._parse_cached(promql, params)
+            result = self.execute_logical(plan, qcontext)
+            rec.observe(result)
+        return result
 
     def query_range_many(self, queries, workers: int = 8,
                          return_errors: bool = False) -> list:
@@ -181,6 +188,19 @@ class QueryService:
 
         t0 = time.perf_counter()
         n = len(queries)
+        if n == 1:
+            # a single-member batch has nothing to coalesce; take the
+            # fully-traced query_range path so head-sampling and slow-query
+            # span capture keep working for the HTTP fronts (which funnel
+            # every hot query through here, even singles)
+            promql, start_sec, step_sec, end_sec = queries[0]
+            try:
+                return [self.query_range(promql, start_sec, step_sec,
+                                         end_sec)]
+            except Exception as e:  # noqa: BLE001
+                if not return_errors:
+                    raise
+                return [e]
         plans: list = [None] * n
         outcomes: list = [None] * n  # QueryResult | Exception per query
         for i, q in enumerate(queries):
@@ -291,6 +311,22 @@ class QueryService:
             outcomes[i].stats.wall_time_s = wall
             if not outcomes[i].query_id:
                 outcomes[i].query_id = qcontext.query_id
+        # tail capture for the batched path: members of a slow batch land in
+        # the flight recorder with stats (batched queries are not span-traced
+        # — the whole batch runs as one device program)
+        from filodb_tpu.utils.tracing import config as tracing_config
+        thr = tracing_config().slow_query_threshold_ms
+        if deferred and thr > 0 and wall * 1000.0 > thr:
+            import dataclasses as _dc
+
+            from filodb_tpu.utils.tracing import record_slow
+            for i in sorted(deferred):
+                r = outcomes[i]
+                if isinstance(r, QueryResult):
+                    record_slow("query", wall * 1000.0,
+                                stats=_dc.asdict(r.stats),
+                                query=queries[i][0], dataset=self.dataset,
+                                batched=True)
         return outcomes
 
     def _parse_cached(self, promql: str, params: TimeStepParams):
@@ -309,9 +345,14 @@ class QueryService:
 
     def query_instant(self, promql: str, time_sec: int,
                       qcontext: QueryContext | None = None) -> QueryResult:
+        from filodb_tpu.utils.tracing import traced_query
+        qcontext = qcontext or QueryContext()
         params = TimeStepParams(time_sec, 0, time_sec)
         plan = parse_query(promql, params, self.lookback_ms)
-        return self.execute_logical(plan, qcontext)
+        with traced_query(qcontext, query=promql, dataset=self.dataset) as rec:
+            result = self.execute_logical(plan, qcontext)
+            rec.observe(result)
+        return result
 
     def execute_logical(self, plan: lp.LogicalPlan,
                         qcontext: QueryContext | None = None,
@@ -352,13 +393,16 @@ class QueryService:
         # admit as their own lowest-priority class.
         cost = RULES if qcontext.origin == "rules" \
             else _admission_cost(plan)
+        t_admit = time.perf_counter()
         with governor().admit(deadline=deadline, cost=cost,
                               tenant=plan_tenant(plan)):
+            admission_wait_s = time.perf_counter() - t_admit
             if self.mesh_engine is not None and self._mesh_eligible() \
                     and self.mesh_engine.supports(plan):
                 from filodb_tpu.query.model import QueryStats
                 from filodb_tpu.utils.tracing import span
                 stats = QueryStats()
+                stats.admission_wait_s += admission_wait_s
                 with query_latency.time(), span("mesh-execute"):
                     data = self.mesh_engine.execute(self.memstore,
                                                     self.dataset, plan, stats)
@@ -387,6 +431,7 @@ class QueryService:
                 exec_plan = self.planner.materialize(plan, qcontext)
             ctx = ExecContext(self.memstore, self.dataset, qcontext,
                               deadline=deadline)
+            ctx.stats.admission_wait_s += admission_wait_s
             with query_latency.time(), span("exec-dispatch"):
                 result = exec_plan.dispatcher.dispatch(exec_plan, ctx)
                 if materialize:

@@ -23,6 +23,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 import zlib
 
 from filodb_tpu.coordinator.wire import MAX_FRAME, decode, encode
@@ -34,7 +35,7 @@ from filodb_tpu.utils.resilience import (
     breaker_for,
     default_retry_policy,
 )
-from filodb_tpu.utils.tracing import span
+from filodb_tpu.utils.tracing import graft_spans, span, start_trace
 
 log = logging.getLogger(__name__)
 
@@ -223,16 +224,32 @@ class PlanExecutorServer:
                     QueryRejected,
                     governor,
                 )
+                tc = getattr(qcontext, "trace", None) \
+                    if qcontext is not None else None
+                sampled = tc is not None and getattr(tc, "sampled", False)
                 try:
                     # tenant extracted from the exec plan's leaf filters so
                     # per-tenant inflight caps hold on remote leaves too
+                    t_admit = time.perf_counter()
                     with governor().admit(cost=EXPENSIVE,
                                           tenant=plan_tenant(plan)):
+                        wait_s = time.perf_counter() - t_admit
                         ctx = ExecContext(self.memstore, dataset,
                                           qcontext or QueryContext())
-                        result = plan.execute(ctx)
-                        # wire-encode host, not device
-                        result.result.materialize()
+                        ctx.stats.admission_wait_s += wait_s
+                        if sampled:
+                            # sampled query: join the root's distributed
+                            # trace — execute under a local trace and ship
+                            # the span tree back in the result frame for
+                            # the dispatcher to graft, node-tagged
+                            with start_trace() as trace:
+                                result = plan.execute(ctx)
+                                # wire-encode host, not device
+                                result.result.materialize()
+                            result.spans = trace.as_dicts()
+                        else:
+                            result = plan.execute(ctx)
+                            result.result.materialize()
                         return ("ok", result)
                 except QueryRejected as e:
                     return ("rejected", str(e), e.retry_after_s)
@@ -382,9 +399,12 @@ class RemotePlanDispatcher(PlanDispatcher):
     def _drop_conn(self):
         _pool.drop((self.host, self.port))
 
-    def _roundtrip(self, msg: tuple, timeout: float | None = None):
+    def _roundtrip(self, msg: tuple, timeout: float | None = None,
+                   nbytes_out: list | None = None):
         """One request/response on a pooled (or fresh) socket; transport
-        failure closes the connection so the next attempt redials."""
+        failure closes the connection so the next attempt redials.
+        ``nbytes_out`` collects per-call wire bytes (sent + received) for
+        per-query stats attribution."""
         t = timeout if timeout is not None else self.timeout
         key = (self.host, self.port)
         sock = _pool.checkout(key)
@@ -404,11 +424,14 @@ class RemotePlanDispatcher(PlanDispatcher):
         _pool.checkin(key, sock)
         BYTES_SENT.inc(nsent)
         BYTES_RECEIVED.inc(nrecv)
+        if nbytes_out is not None:
+            nbytes_out.append(nsent + nrecv)
         return resp
 
     def dispatch(self, plan, ctx):
         breaker = breaker_for(self.peer)
         deadline = getattr(ctx, "deadline", None)
+        nbytes: list[int] = []
 
         def attempt():
             timeout = deadline.timeout(cap=self.timeout,
@@ -417,18 +440,33 @@ class RemotePlanDispatcher(PlanDispatcher):
             FaultInjector.fire("remote.dispatch", host=self.host,
                                port=self.port)
             return self._roundtrip(
-                ("execute", ctx.dataset, plan, ctx.qcontext), timeout)
+                ("execute", ctx.dataset, plan, ctx.qcontext), timeout,
+                nbytes_out=nbytes)
 
         # calling() records a failure only for genuine transport errors —
         # a DeadlineExceeded (raised before even dialing) or an open
         # breaker must not count against a healthy peer — and guarantees
         # a half-open probe reports exactly one outcome
-        with span("dispatch", peer=self.peer), \
+        with span("dispatch", peer=self.peer) as dspan, \
                 breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
             resp = default_retry_policy().call(
                 attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
         if resp[0] == "ok":
-            return resp[1]
+            result = resp[1]
+            stats = getattr(result, "stats", None)
+            if stats is not None:
+                # attributed on the CHILD's stats object (this thread owns
+                # it until the gather settles; root ctx.stats is not
+                # thread-safe under concurrent workers), folded upward by
+                # settle()'s merge
+                stats.wire_bytes += sum(nbytes)
+            rspans = getattr(result, "spans", None)
+            if rspans:
+                # graft the peer's span tree under this dispatch span;
+                # top-level remote spans get the node tag
+                graft_spans(rspans, dspan, node=self.peer)
+                result.spans = []
+            return result
         if resp[0] == "rejected":
             # the peer's admission gate shed the query: a healthy-peer
             # verdict (breaker already recorded success above). Re-raise

@@ -49,6 +49,7 @@ def _build_registry() -> dict[str, type]:
         RangeVectorKey,
         ScalarResult,
         StepMatrix,
+        TraceContext,
     )
     from filodb_tpu.coordinator.migration import MigrationManifest
     from filodb_tpu.utils.governor import QueryBudget
@@ -67,7 +68,7 @@ def _build_registry() -> dict[str, type]:
     for cls in (ColumnFilter, PartKey, Chunk, HistogramColumn,
                 MigrationManifest, PlannerParams,
                 QueryBudget, QueryContext, QueryResult, QueryStats,
-                RangeVectorKey, ScalarResult, StepMatrix):
+                RangeVectorKey, ScalarResult, StepMatrix, TraceContext):
         reg[cls.__name__] = cls
     return reg
 

@@ -469,7 +469,12 @@ class ObjectStoreColumnStore(ColumnStore):
                     continue
                 if kind == "segment":
                     seq, key, data = task[3], task[4], task[5]
-                    self._uploader_put(key, data)
+                    # slow uploads land in the flight recorder (same tail-
+                    # capture ring as slow queries)
+                    from filodb_tpu.utils.tracing import traced_operation
+                    with traced_operation("objectstore", op="upload",
+                                          shard=shard, nbytes=len(data)):
+                        self._uploader_put(key, data)
                     with self._lock:
                         st = self._states.get((dataset, shard))
                         if st is not None:

@@ -343,6 +343,9 @@ class FastHttpServer:
         if svc is None:
             return None
         qs = parse_qs(url.query)
+        if qs.get("stats", [""])[0] == "all":
+            # expanded-stats rendering isn't batched — generic path
+            return None
         try:
             if parts[5] == "query_range":
                 q, start, step, end = HttpDispatcher.range_params(qs)

@@ -49,12 +49,25 @@ def _flatten_histograms(m: StepMatrix) -> StepMatrix:
                       else np.zeros((0, m.num_steps)), m.steps_ms)
 
 
-def _stats_json(result: QueryResult) -> dict:
+def _stats_json(result: QueryResult, full: bool = False) -> dict:
     s = result.stats
-    return {"seriesScanned": s.series_scanned,
-            "samplesScanned": s.samples_scanned,
-            "resultSeries": s.result_series,
-            "wallTimeMs": round(s.wall_time_s * 1000.0, 3)}
+    out = {"seriesScanned": s.series_scanned,
+           "samplesScanned": s.samples_scanned,
+           "resultSeries": s.result_series,
+           "wallTimeMs": round(s.wall_time_s * 1000.0, 3)}
+    if full:
+        # ?stats=all — the expanded per-query counters merged across
+        # remote children (distributed tracing / flight-recorder stats)
+        out.update({
+            "chunksTouched": s.chunks_touched,
+            "cacheHits": s.cache_hits,
+            "cacheMisses": s.cache_misses,
+            "wireBytes": s.wire_bytes,
+            "admissionWaitMs": round(s.admission_wait_s * 1000.0, 3),
+            "decodeMs": round(s.decode_s * 1000.0, 3),
+            "reduceMs": round(s.reduce_s * 1000.0, 3),
+        })
+    return out
 
 
 def _partial_fields(result: QueryResult) -> dict:
@@ -122,7 +135,7 @@ def _value_strings(vals: np.ndarray) -> np.ndarray:
     return sv
 
 
-def matrix_json_str(result: QueryResult) -> str:
+def matrix_json_str(result: QueryResult, full_stats: bool = False) -> str:
     """Prom matrix response rendered straight to a JSON string — numpy
     formats every sample value in one vectorized pass instead of a
     per-value Python loop (the reference leans on Jackson streaming for the
@@ -144,21 +157,26 @@ def matrix_json_str(result: QueryResult) -> str:
         body = ",".join(f'[{ts_str[k]},"{row[k]}"]' for k in idx.tolist())
         parts.append('{"metric":%s,"values":[%s]}'
                      % (_labels_json_str(key), body))
-    stats = json.dumps(_stats_json(result), separators=(",", ":"))
+    stats = json.dumps(_stats_json(result, full=full_stats),
+                       separators=(",", ":"))
     return ('{"status":"success","data":{"resultType":"matrix","result":[%s'
             ']},"queryStats":%s%s}' % (",".join(parts), stats,
                                        _partial_fields_str(result)))
 
 
-def vector_json_str(result: QueryResult) -> str:
+def vector_json_str(result: QueryResult, with_stats: bool = False) -> str:
     """Prom vector response rendered straight to a JSON string."""
     m = result.result
     if m.is_histogram:
         m = _flatten_histograms(m)
     m.materialize()
+    statstr = ""
+    if with_stats:
+        statstr = ',"queryStats":%s' % json.dumps(
+            _stats_json(result, full=True), separators=(",", ":"))
     if not m.num_steps or not m.num_series:
         return ('{"status":"success","data":{"resultType":"vector",'
-                '"result":[]}%s}' % _partial_fields_str(result))
+                '"result":[]}%s%s}' % (statstr, _partial_fields_str(result)))
     k = m.num_steps - 1
     vals = np.asarray(m.values[:, k], np.float64)
     ok = ~np.isnan(vals)
@@ -169,10 +187,11 @@ def vector_json_str(result: QueryResult) -> str:
                                              t, sv[i])
         for i in np.flatnonzero(ok).tolist()]
     return ('{"status":"success","data":{"resultType":"vector","result":'
-            '[%s]}%s}' % (",".join(parts), _partial_fields_str(result)))
+            '[%s]}%s%s}' % (",".join(parts), statstr,
+                            _partial_fields_str(result)))
 
 
-def vector_json(result: QueryResult) -> dict:
+def vector_json(result: QueryResult, with_stats: bool = False) -> dict:
     m = result.result
     if m.is_histogram:
         m = _flatten_histograms(m)
@@ -183,9 +202,12 @@ def vector_json(result: QueryResult) -> dict:
         if not math.isnan(v):
             out.append({"metric": _labels_json(key),
                         "value": [m.steps_ms[k] / 1000.0, _fmt(v)]})
-    return {"status": "success",
+    resp = {"status": "success",
             "data": {"resultType": "vector", "result": out},
             **_partial_fields(result)}
+    if with_stats:
+        resp["queryStats"] = _stats_json(result, full=True)
+    return resp
 
 
 def scalar_json(result: QueryResult) -> dict:

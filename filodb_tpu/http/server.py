@@ -249,7 +249,8 @@ class HttpDispatcher:
             t = int(_time.time())
         return qs["query"][0], t
 
-    def _cached_query(self, svc: QueryService, kind: str, params: tuple):
+    def _cached_query(self, svc: QueryService, kind: str, params: tuple,
+                      full_stats: bool = False):
         """Hot query with the rendered-response cache around it."""
         cache = self.app.response_cache
         key = version = None
@@ -259,24 +260,34 @@ class HttpDispatcher:
                 cache = None  # remote shards: stamp can't witness staleness
             else:
                 key = response_cache_key(svc, kind, params)
+                if full_stats:
+                    # ?stats=all renders a different body — distinct entry
+                    key = key + ("stats",)
                 body = cache.get(key, version)
                 if body is not None:
                     return 200, {"Content-Type": JSON_CT}, body
         r = self.app.batched(svc).query_range(*params)
-        rendered = promjson.matrix_json_str(r) if kind == "range" \
-            else promjson.vector_json_str(r)
+        rendered = promjson.matrix_json_str(r, full_stats=full_stats) \
+            if kind == "range" \
+            else promjson.vector_json_str(r, with_stats=full_stats)
         out = self._json(200, rendered)
         if cache is not None:
             cache.put(key, version, out[2])
         return out
 
+    @staticmethod
+    def _want_stats(qs: dict) -> bool:
+        return qs.get("stats", [""])[0] == "all"
+
     def _prom_api(self, svc: QueryService, rest: list[str], qs: dict):
         if rest == ["query_range"]:
             params = self.range_params(qs)
-            return self._cached_query(svc, "range", params)
+            return self._cached_query(svc, "range", params,
+                                      full_stats=self._want_stats(qs))
         if rest == ["query"]:
             query, t = self.instant_params(qs)
-            return self._cached_query(svc, "instant", (query, t, 0, t))
+            return self._cached_query(svc, "instant", (query, t, 0, t),
+                                      full_stats=self._want_stats(qs))
         if rest == ["series"]:
             matches = qs.get("match[]", [])
             start = int(parse_time(qs.get("start", ["0"])[0]))
@@ -313,7 +324,9 @@ class HttpDispatcher:
         if rest == ["debug", "trace"]:
             # span-traced execution (reference: Kamon spans around exec,
             # ExecPlan.scala:101 / startODPSpan — surfaced here as JSON
-            # instead of a zipkin reporter)
+            # instead of a zipkin reporter). Force-samples this one query:
+            # the active trace is joined by traced_query(), so remote
+            # children ship their span trees back and they land here too.
             from filodb_tpu.utils.tracing import start_trace
             if "start" in qs:
                 query, start, step, end = self.range_params(qs)
@@ -331,6 +344,21 @@ class HttpDispatcher:
                              "samples_scanned": r.stats.samples_scanned,
                              "wall_time_s": r.stats.wall_time_s,
                          }}})
+        if rest == ["debug", "slow_queries"]:
+            # slow-query flight recorder: bounded ring of queries (and
+            # traced operations) that exceeded slow_query_threshold_ms,
+            # newest first, full span tree + stats when sampled
+            from filodb_tpu.utils.tracing import slow_queries
+            try:
+                limit = int(qs.get("limit", ["0"])[0])
+            except ValueError:
+                limit = 0
+            entries = [e for e in slow_queries()
+                       if e.get("dataset") in (None, svc.dataset)]
+            if limit > 0:
+                entries = entries[:limit]
+            return self._json(200, {"status": "success",
+                                    "data": {"slow_queries": entries}})
         return self._json(404, promjson.error_json("unknown endpoint"))
 
     def _remote_read(self, parts: list[str], body: bytes):

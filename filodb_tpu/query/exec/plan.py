@@ -19,6 +19,7 @@ full-gather path; see ``doc/dist_agg.md``). The device mesh path
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -40,6 +41,7 @@ from filodb_tpu.query.model import (
     ScalarResult,
     StepMatrix,
 )
+from filodb_tpu.utils.tracing import activate, current_span, current_trace, span
 
 
 class PlanDispatcher:
@@ -185,6 +187,17 @@ class SelectRawPartitionsExec(ExecPlan):
     dataset_name: str | None = None
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:
+        with span("scan", shard=self.shard):
+            outs = self._scan_batches(ctx)
+        if outs is None:
+            return StepMatrix.empty()
+        with span("reduce"):
+            t0 = time.perf_counter()
+            data = self._apply_transformers(outs, ctx)
+            ctx.stats.reduce_s += time.perf_counter() - t0
+        return data
+
+    def _scan_batches(self, ctx: ExecContext) -> list | None:
         memstore = self.store if self.store is not None else ctx.memstore
         dataset = self.dataset_name or ctx.dataset
         shard = memstore.get_shard(dataset, self.shard)
@@ -201,7 +214,7 @@ class SelectRawPartitionsExec(ExecPlan):
         parts = [p for p in parts if p is not None]
         ctx.stats.series_scanned += len(parts)
         if not parts:
-            return StepMatrix.empty()
+            return None
         # multi-schema: group by schema, batch per schema
         # (reference MultiSchemaPartitionsExec discovers the schema here)
         by_schema: dict[str, list] = {}
@@ -218,27 +231,41 @@ class SelectRawPartitionsExec(ExecPlan):
             cached = shard.batch_cache.get(cache_key)
             if cached is not None and cached[0] == version:
                 _, batch, keys, is_counter = cached
+                ctx.stats.cache_hits += 1
             else:
-                # on-demand paging: pull cold chunks for partitions whose
-                # in-memory data doesn't reach back to the query start
-                # (skipped on cache hits — resident data didn't change)
-                extra_chunks = None
-                if shard.config.demand_paging_enabled:
-                    from filodb_tpu.core.memstore.odp import page_partitions
-                    extra_chunks = page_partitions(
-                        shard, sparts, self.chunk_start, self.chunk_end,
-                        shard.odp_cache)
-                if self._use_device_path(shard, schema, col):
-                    from filodb_tpu.query.engine.device_batch import (
-                        build_device_batch,
-                    )
-                    batch = build_device_batch(sparts, self.chunk_start,
-                                               self.chunk_end, col,
-                                               extra_chunks=extra_chunks)
-                else:
-                    batch = build_batch(sparts, self.chunk_start,
-                                        self.chunk_end, col,
-                                        extra_chunks=extra_chunks)
+                ctx.stats.cache_misses += 1
+                # chunk accounting is best-effort: downsample-store
+                # PagedReadablePartition duck-types only the read API
+                ctx.stats.chunks_touched += sum(
+                    len(p.chunks_in_range(self.chunk_start, self.chunk_end,
+                                          include_buffer=False))
+                    for p in sparts if hasattr(p, "chunks_in_range"))
+                t0 = time.perf_counter()
+                with span("decode", schema=schema_name,
+                          partitions=len(sparts)):
+                    # on-demand paging: pull cold chunks for partitions whose
+                    # in-memory data doesn't reach back to the query start
+                    # (skipped on cache hits — resident data didn't change)
+                    extra_chunks = None
+                    if shard.config.demand_paging_enabled:
+                        from filodb_tpu.core.memstore.odp import (
+                            page_partitions,
+                        )
+                        extra_chunks = page_partitions(
+                            shard, sparts, self.chunk_start, self.chunk_end,
+                            shard.odp_cache)
+                    if self._use_device_path(shard, schema, col):
+                        from filodb_tpu.query.engine.device_batch import (
+                            build_device_batch,
+                        )
+                        batch = build_device_batch(sparts, self.chunk_start,
+                                                   self.chunk_end, col,
+                                                   extra_chunks=extra_chunks)
+                    else:
+                        batch = build_batch(sparts, self.chunk_start,
+                                            self.chunk_end, col,
+                                            extra_chunks=extra_chunks)
+                ctx.stats.decode_s += time.perf_counter() - t0
                 keys = [p.part_key.range_vector_key for p in sparts]
                 is_counter = schema.data.columns[col].is_counter
                 if len(shard.batch_cache) >= shard.batch_cache_cap:
@@ -258,6 +285,9 @@ class SelectRawPartitionsExec(ExecPlan):
             if ctx.budget is not None and ctx.budget.check_samples(
                     ctx, leaf_scanned):
                 break
+        return outs
+
+    def _apply_transformers(self, outs: list, ctx: ExecContext) -> StepMatrix:
         # the first transformer must be the windowing mapper — it consumes the
         # batch directly; the rest apply to the concatenated step matrix
         from filodb_tpu.query.exec.transformers import PeriodicSamplesMapper
@@ -384,9 +414,19 @@ class NonLeafExecPlan(ExecPlan):
             else rc.partial_max_fraction
         failures: list[tuple[int, list[int], Exception]] = []
 
+        # gather workers run on pool threads that don't inherit the caller's
+        # thread-local trace; capture the handle (and the open span to parent
+        # under) here and adopt it inside run() — a no-op on the calling
+        # thread, where the trace is already active
+        trace = current_trace()
+        parent_span = current_span()
+
         def run(i, c):
             FaultInjector.fire("gather.child", index=i,
                                shards=plan_shards(c), plan=c)
+            if trace is not None:
+                with activate(trace, parent_span):
+                    return c.dispatcher.dispatch(c, ctx)
             return c.dispatcher.dispatch(c, ctx)
 
         def settle(i, ok, payload):
@@ -400,12 +440,11 @@ class NonLeafExecPlan(ExecPlan):
                     ctx.warnings.extend(w for w in result.warnings
                                         if w not in ctx.warnings)
                 # remote children carry their own stats object; fold its
-                # scan counters upward (in-process children share THIS
-                # ctx.stats — merging would double-count)
+                # scan/decode/cache/wire counters upward (in-process children
+                # share THIS ctx.stats — merging would double-count)
                 stats = getattr(result, "stats", None)
                 if stats is not None and stats is not ctx.stats:
-                    ctx.stats.series_scanned += stats.series_scanned
-                    ctx.stats.samples_scanned += stats.samples_scanned
+                    ctx.stats.merge_counts(stats)
                 fold(result.result)
                 return
             err = payload
@@ -525,11 +564,19 @@ class ReduceAggregateExec(NonLeafExecPlan):
             folder = PartialAggregateFolder(self.op, self.params, self.by,
                                             self.without)
             self.gather_each(ctx, folder.fold)
-            return folder.finalize()
+            with span("reduce", op=self.op):
+                t0 = time.perf_counter()
+                out = folder.finalize()
+                ctx.stats.reduce_s += time.perf_counter() - t0
+            return out
         data = StepMatrix.concat(self.gather(ctx))
         amr = AggregateMapReduce(self.op, self.params, self.by, self.without)
         amr.bind(ctx)  # group-cardinality budget sees the query's ctx
-        return amr.apply(data)
+        with span("reduce", op=self.op):
+            t0 = time.perf_counter()
+            out = amr.apply(data)
+            ctx.stats.reduce_s += time.perf_counter() - t0
+        return out
 
     def __repr__(self):
         pd = ", pushdown" if self.pushdown else ""

@@ -195,6 +195,41 @@ class QueryStats:
     wall_time_s: float = 0.0
     cpu_prep_s: float = 0.0
     device_time_s: float = 0.0
+    # distributed observability: leaf/decode/reduce attribution merged
+    # across remote children by the gather's settle() fold
+    chunks_touched: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    wire_bytes: int = 0
+    admission_wait_s: float = 0.0
+    decode_s: float = 0.0
+    reduce_s: float = 0.0
+
+    def merge_counts(self, other: "QueryStats") -> None:
+        """Fold a remote child's stats into this one (count/duration
+        accumulators only; wall_time_s/result_series are root-owned)."""
+        self.series_scanned += other.series_scanned
+        self.samples_scanned += other.samples_scanned
+        self.cpu_prep_s += other.cpu_prep_s
+        self.device_time_s += other.device_time_s
+        self.chunks_touched += other.chunks_touched
+        self.cache_hits += other.cache_hits
+        self.cache_misses += other.cache_misses
+        self.wire_bytes += other.wire_bytes
+        self.admission_wait_s += other.admission_wait_s
+        self.decode_s += other.decode_s
+        self.reduce_s += other.reduce_s
+
+
+@dataclass
+class TraceContext:
+    """Distributed-trace propagation context: rides ``QueryContext`` over
+    the plan-shipping wire so remote executors join the root's trace
+    (``utils/tracing.py``). ``sampled`` gates remote span collection."""
+
+    trace_id: str = ""
+    parent_span_id: int = 0
+    sampled: bool = False
 
 
 @dataclass
@@ -207,6 +242,10 @@ class QueryResult:
     # JSON encoder surfaces these as "partial" + "warnings" fields
     partial: bool = False
     warnings: list[str] = field(default_factory=list)
+    # remote span-tree ship-back: a sampled executor fills this with
+    # Span.as_dict() dicts; the dispatching root grafts them (node-tagged)
+    # under its dispatch span and strips them before returning upward
+    spans: list = field(default_factory=list)
 
 
 @dataclass
@@ -241,6 +280,9 @@ class QueryContext:
         default_factory=lambda: int(_time.time() * 1000))
     origin: str = ""
     planner_params: PlannerParams = field(default_factory=PlannerParams)
+    # distributed tracing: set by traced_query() when the query is sampled
+    # (or joins an active trace); remote executors check trace.sampled
+    trace: "TraceContext | None" = None
 
 
 class QueryLimitExceeded(RuntimeError):

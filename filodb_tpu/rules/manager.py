@@ -79,7 +79,7 @@ from filodb_tpu.rules.model import AlertingRule, RecordingRule, RuleGroup
 from filodb_tpu.utils import governor as governor_mod
 from filodb_tpu.utils.metrics import Counter, Gauge, Histogram, get_gauge
 from filodb_tpu.utils.resilience import FaultInjector
-from filodb_tpu.utils.tracing import span
+from filodb_tpu.utils.tracing import traced_operation
 
 log = logging.getLogger("filodb.rules")
 
@@ -378,7 +378,7 @@ class RuleManager:
         FaultInjector.fire("rules.eval", group=g.name, start=first,
                            end=last_complete)
         t0 = time.perf_counter()
-        with span("rules", group=g.name, steps=nsteps):
+        with traced_operation("rules", group=g.name, steps=nsteps):
             # evaluate ALL rules before writing anything is not possible
             # in bounded memory for wide outputs; instead write per rule
             # and rely on idempotent re-writes, but stage alert-state

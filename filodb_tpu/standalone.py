@@ -45,6 +45,9 @@ class FiloServer:
         if config.governor:
             from filodb_tpu.utils import governor
             governor.configure(**config.governor)
+        if config.tracing:
+            from filodb_tpu.utils import tracing
+            tracing.configure(**config.tracing)
         self.watchdog = None
         os.makedirs(config.data_dir, exist_ok=True)
         self.store_server = None

@@ -4,6 +4,10 @@ Counterpart of the reference's Kamon counters/gauges/histograms
 (``TimeSeriesShardStats``, ``KamonLogger.scala``): a process-wide registry that
 the HTTP server exposes in Prometheus text exposition format (the reference's
 "metrics sink" concept, ``README.md:860-876``).
+
+Updates are thread-safe: ``Counter.inc``, ``Gauge.set``, and
+``Histogram.observe`` synchronize on a per-metric lock, since updates race
+across gather workers, the write-behind uploader, and rules threads.
 """
 
 from __future__ import annotations
@@ -17,9 +21,12 @@ _lock = threading.Lock()
 
 
 class Metric:
-    def __init__(self, name: str, tags: dict[str, str] | None = None):
+    def __init__(self, name: str, tags: dict[str, str] | None = None,
+                 help: str | None = None):
         self.name = name
         self.tags = tags or {}
+        self.help = help or name
+        self._mlock = threading.Lock()
         key = self._key()
         with _lock:
             _registry[key] = self
@@ -30,21 +37,25 @@ class Metric:
 
 
 class Counter(Metric):
-    def __init__(self, name: str, tags: dict[str, str] | None = None):
-        super().__init__(name, tags)
+    def __init__(self, name: str, tags: dict[str, str] | None = None,
+                 help: str | None = None):
+        super().__init__(name, tags, help)
         self.value = 0
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._mlock:
+            self.value += n
 
 
 class Gauge(Metric):
-    def __init__(self, name: str, tags: dict[str, str] | None = None):
-        super().__init__(name, tags)
+    def __init__(self, name: str, tags: dict[str, str] | None = None,
+                 help: str | None = None):
+        super().__init__(name, tags, help)
         self.value = 0.0
 
     def set(self, v: float) -> None:
-        self.value = v
+        with self._mlock:
+            self.value = v
 
 
 class GaugeFn(Metric):
@@ -54,8 +65,9 @@ class GaugeFn(Metric):
     callback returning ``None`` (e.g. its subject was torn down) drops
     the series from the exposition instead of rendering NaN."""
 
-    def __init__(self, name: str, fn, tags: dict[str, str] | None = None):
-        super().__init__(name, tags)
+    def __init__(self, name: str, fn, tags: dict[str, str] | None = None,
+                 help: str | None = None):
+        super().__init__(name, tags, help)
         self.fn = fn
 
     @property
@@ -75,19 +87,20 @@ class Histogram(Metric):
               1.0, 2.5, 5.0, 10.0)
 
     def __init__(self, name: str, tags: dict[str, str] | None = None,
-                 bounds: tuple | None = None):
-        super().__init__(name, tags)
+                 bounds: tuple | None = None, help: str | None = None):
+        super().__init__(name, tags, help)
         self.bounds = tuple(bounds) if bounds is not None else self.BOUNDS
         self.buckets = defaultdict(int)
         self.count = 0
         self.sum = 0.0
 
     def observe(self, v: float) -> None:
-        self.count += 1
-        self.sum += v
-        for b in self.bounds:
-            if v <= b:
-                self.buckets[b] += 1
+        with self._mlock:
+            self.count += 1
+            self.sum += v
+            for b in self.bounds:
+                if v <= b:
+                    self.buckets[b] += 1
 
     def time(self):
         return _Timer(self)
@@ -105,7 +118,8 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0)
 
 
-def get_counter(name: str, tags: dict[str, str] | None = None) -> Counter:
+def get_counter(name: str, tags: dict[str, str] | None = None,
+                help: str | None = None) -> Counter:
     """Idempotent counter lookup: error-path call sites (flush loops,
     protocol handlers) increment per-(name, tags) counters without each
     having to hold a module-level instance — re-registering would reset the
@@ -116,10 +130,11 @@ def get_counter(name: str, tags: dict[str, str] | None = None) -> Counter:
         m = _registry.get(key)
     if isinstance(m, Counter):
         return m
-    return Counter(name, tags)
+    return Counter(name, tags, help)
 
 
-def get_gauge(name: str, tags: dict[str, str] | None = None) -> Gauge:
+def get_gauge(name: str, tags: dict[str, str] | None = None,
+              help: str | None = None) -> Gauge:
     """Idempotent gauge lookup (per-(name, tags)) — the gauge analog of
     :func:`get_counter`, for dynamically-tagged series (per-tenant,
     per-migration) where re-registering would drop the live value."""
@@ -129,30 +144,48 @@ def get_gauge(name: str, tags: dict[str, str] | None = None) -> Gauge:
         m = _registry.get(key)
     if isinstance(m, Gauge):
         return m
-    return Gauge(name, tags)
+    return Gauge(name, tags, help)
 
 
 def render_prometheus() -> str:
-    """Expose all metrics in Prometheus text format."""
-    lines = []
+    """Expose all metrics in Prometheus text format, series grouped per
+    family under ``# HELP``/``# TYPE`` headers (the help string defaults to
+    the family name unless the metric was created with ``help=``)."""
     with _lock:
         metrics = list(_registry.values())
+    families: dict[tuple[str, str], list[Metric]] = {}
     for m in metrics:
-        tagstr = ",".join(f'{k}="{v}"' for k, v in sorted(m.tags.items()))
-        tagstr = f"{{{tagstr}}}" if tagstr else ""
         if isinstance(m, Counter):
-            lines.append(f"{m.name}_total{tagstr} {m.value}")
+            fam = (f"{m.name}_total", "counter")
         elif isinstance(m, (Gauge, GaugeFn)):
-            v = m.value
-            if v is None:
-                continue  # subject gone (GaugeFn over a dead shard)
-            lines.append(f"{m.name}{tagstr} {v}")
+            fam = (m.name, "gauge")
         elif isinstance(m, Histogram):
-            for b in m.bounds:
-                t = tagstr[:-1] + f',le="{b}"}}' if tagstr else f'{{le="{b}"}}'
-                lines.append(f"{m.name}_bucket{t} {m.buckets.get(b, 0)}")
-            t = tagstr[:-1] + ',le="+Inf"}' if tagstr else '{le="+Inf"}'
-            lines.append(f"{m.name}_bucket{t} {m.count}")
-            lines.append(f"{m.name}_count{tagstr} {m.count}")
-            lines.append(f"{m.name}_sum{tagstr} {m.sum}")
+            fam = (m.name, "histogram")
+        else:
+            continue
+        families.setdefault(fam, []).append(m)
+    lines = []
+    for (fam, typ), members in families.items():
+        help_text = " ".join(str(members[0].help).split())
+        lines.append(f"# HELP {fam} {help_text}")
+        lines.append(f"# TYPE {fam} {typ}")
+        for m in members:
+            tagstr = ",".join(f'{k}="{v}"' for k, v in sorted(m.tags.items()))
+            tagstr = f"{{{tagstr}}}" if tagstr else ""
+            if isinstance(m, Counter):
+                lines.append(f"{m.name}_total{tagstr} {m.value}")
+            elif isinstance(m, (Gauge, GaugeFn)):
+                v = m.value
+                if v is None:
+                    continue  # subject gone (GaugeFn over a dead shard)
+                lines.append(f"{m.name}{tagstr} {v}")
+            elif isinstance(m, Histogram):
+                for b in m.bounds:
+                    t = (tagstr[:-1] + f',le="{b}"}}' if tagstr
+                         else f'{{le="{b}"}}')
+                    lines.append(f"{m.name}_bucket{t} {m.buckets.get(b, 0)}")
+                t = tagstr[:-1] + ',le="+Inf"}' if tagstr else '{le="+Inf"}'
+                lines.append(f"{m.name}_bucket{t} {m.count}")
+                lines.append(f"{m.name}_count{tagstr} {m.count}")
+                lines.append(f"{m.name}_sum{tagstr} {m.sum}")
     return "\n".join(lines) + "\n"

@@ -184,6 +184,18 @@ ALERTS_NAMES = [
 ]
 
 
+# distributed query tracing + slow-query flight recorder
+# (utils/tracing.py) — stage histograms pre-registered at import from the
+# whitelisted stage names; sampling/recorder counters too
+TRACING_NAMES = [
+    "filodb_query_stage_seconds_bucket",
+    "filodb_query_stage_seconds_count",
+    "filodb_query_stage_seconds_sum",
+    "filodb_queries_sampled_total",
+    "filodb_slow_queries_recorded_total",
+]
+
+
 # object-store durable tier (core/store/objectstore.py) — registered at
 # import; standalone imports the module regardless of the configured backend
 OBJECTSTORE_NAMES = [
@@ -309,6 +321,11 @@ class TestMetricsScrape:
         missing_r = [n for n in RULES_NAMES + ALERTS_NAMES
                      if n not in names_present]
         assert not missing_r, f"missing rules metrics: {missing_r}"
+
+        # tracing stage histograms + flight-recorder counters render from
+        # import time (stage labels are a bounded whitelist)
+        missing_tr = [n for n in TRACING_NAMES if n not in names_present]
+        assert not missing_tr, f"missing tracing metrics: {missing_tr}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])

@@ -1,0 +1,312 @@
+"""Distributed query tracing: cross-node span trees over TCP plan
+shipping, merged per-query stats, deterministic head sampling, the
+slow-query flight recorder, and the debug/slow_queries HTTP surface.
+
+A sampled aggregate fanned out over two plan-executor peers must come
+back as ONE span tree: the remote leaves' scan/decode/reduce spans are
+shipped in the result frame and grafted — node-tagged — under the root's
+dispatch spans, and the leaves' QueryStats fold into the root's.
+"""
+
+import dataclasses
+import json
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.remote import (
+    PlanExecutorServer,
+    RemotePlanDispatcher,
+    reset_pool,
+)
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils import tracing
+from filodb_tpu.utils.resilience import reset_breakers
+
+NUM_SHARDS = 4
+START = 1_600_000_000
+QS, STEP, QE = START + 100, 60, START + 2000
+PROMQL = "sum(heap_usage) by (host)"
+
+
+def build_store():
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    stream = gauge_stream(machine_metrics_series(10, ns="App-0"), 240,
+                          start_ms=START * 1000, interval_ms=10_000, seed=5)
+    ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    return ms
+
+
+@pytest.fixture(scope="module")
+def store():
+    return build_store()
+
+
+@pytest.fixture(autouse=True)
+def restore_tracing():
+    prev = dataclasses.asdict(tracing.config())
+    yield
+    tracing.configure(**prev)
+    tracing.flight_recorder().clear()
+
+
+def _clear_batch_caches(store):
+    for sh in store.shards_for("timeseries"):
+        sh.batch_cache.clear()
+
+
+@pytest.fixture()
+def two_peer_env(store):
+    reset_breakers()
+    reset_pool()
+    srv_a = PlanExecutorServer(store).start()
+    srv_b = PlanExecutorServer(store).start()
+    disp_a = RemotePlanDispatcher("127.0.0.1", srv_a.port)
+    disp_b = RemotePlanDispatcher("127.0.0.1", srv_b.port)
+    svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+    svc.planner.dispatcher_for_shard = \
+        lambda s: disp_a if s < NUM_SHARDS // 2 else disp_b
+    yield svc, disp_a.peer, disp_b.peer
+    srv_a.stop()
+    srv_b.stop()
+    reset_pool()
+    reset_breakers()
+
+
+class TestDistributedSpanTree:
+    def test_one_tree_with_node_tagged_remote_children(self, store,
+                                                       two_peer_env):
+        svc, peer_a, peer_b = two_peer_env
+        _clear_batch_caches(store)
+        with tracing.start_trace() as trace:
+            r = svc.query_range(PROMQL, QS, STEP, QE)
+        spans = trace.as_dicts()
+
+        # every shard's dispatch span is in THIS trace (worker threads
+        # adopted the root's trace handle instead of dropping spans)
+        dispatch = [s for s in spans if s["name"] == "dispatch"]
+        assert len(dispatch) == NUM_SHARDS
+        assert {s["tags"]["peer"] for s in dispatch} == {peer_a, peer_b}
+
+        # the remote trees arrived node-tagged, from BOTH peers
+        nodes = {s["tags"]["node"] for s in spans
+                 if "node" in (s.get("tags") or {})}
+        assert nodes == {peer_a, peer_b}
+
+        # remote leaf stage spans were shipped back and grafted
+        names = {s["name"] for s in spans}
+        assert {"scan", "decode", "reduce"} <= names
+
+        # parent links: every remote scan span walks up to a dispatch span
+        # (one connected tree, not four disjoint fragments)
+        by_id = {s["span_id"]: s for s in spans}
+        scans = [s for s in spans if s["name"] == "scan"]
+        assert len(scans) == NUM_SHARDS
+        for s in scans:
+            ancestors, cur, hops = [], s, 0
+            while cur.get("parent_id") and hops < 32:
+                cur = by_id[cur["parent_id"]]
+                ancestors.append(cur["name"])
+                hops += 1
+            assert "dispatch" in ancestors, (s, ancestors)
+
+        # the leaves' stats folded into the root result
+        assert r.stats.series_scanned > 0
+        assert r.stats.samples_scanned > 0
+        assert r.stats.chunks_touched > 0
+        assert r.stats.wire_bytes > 0
+        assert r.stats.decode_s > 0
+        # remote spans were stripped from the result after grafting
+        assert r.spans == []
+
+    def test_stats_equivalence_local_vs_remote(self, store, two_peer_env):
+        svc_remote, _, _ = two_peer_env
+        svc_local = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        _clear_batch_caches(store)
+        local = svc_local.query_range(PROMQL, QS, STEP, QE)
+        _clear_batch_caches(store)
+        remote = svc_remote.query_range(PROMQL, QS, STEP, QE)
+        assert remote.stats.series_scanned == local.stats.series_scanned
+        assert remote.stats.samples_scanned == local.stats.samples_scanned
+        assert remote.stats.chunks_touched == local.stats.chunks_touched
+        # wire accounting exists only on the remote path
+        assert local.stats.wire_bytes == 0
+        assert remote.stats.wire_bytes > 0
+
+    def test_unsampled_query_has_zero_spans(self, two_peer_env):
+        svc, _, _ = two_peer_env
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=0.0)
+        before = len(tracing.flight_recorder())
+        r = svc.query_range(PROMQL, QS, STEP, QE)
+        assert r.spans == []
+        assert tracing.current_trace() is None
+        assert len(tracing.flight_recorder()) == before
+        assert r.stats.samples_scanned > 0  # stats still collected
+
+    def test_head_sampled_slow_query_lands_in_recorder(self, two_peer_env):
+        svc, peer_a, peer_b = two_peer_env
+        tracing.configure(sample_rate=1.0, slow_query_threshold_ms=0.001,
+                          slowlog_capacity=16)
+        tracing.flight_recorder().clear()
+        svc.query_range(PROMQL, QS, STEP, QE)
+        entries = tracing.slow_queries()
+        assert entries
+        e = entries[0]
+        assert e["kind"] == "query"
+        assert e["sampled"] is True
+        assert e["query"] == PROMQL
+        assert e["dataset"] == "timeseries"
+        assert e["stats"]["samples_scanned"] > 0
+        names = {s["name"] for s in e["spans"]}
+        # root-side parse + dispatch AND remote leaf scans, one tree
+        assert {"parse", "dispatch", "scan"} <= names
+        nodes = {s["tags"]["node"] for s in e["spans"]
+                 if "node" in (s.get("tags") or {})}
+        assert nodes == {peer_a, peer_b}
+
+
+class TestSampling:
+    def test_deterministic_verdicts(self):
+        ids = [f"query-{i:04d}" for i in range(400)]
+        first = [tracing.should_sample(q, rate=0.3) for q in ids]
+        second = [tracing.should_sample(q, rate=0.3) for q in ids]
+        assert first == second
+        frac = sum(first) / len(first)
+        assert 0.15 < frac < 0.45  # roughly the configured rate
+        assert not any(tracing.should_sample(q, rate=0.0) for q in ids)
+        assert all(tracing.should_sample(q, rate=1.0) for q in ids)
+
+    def test_rate_zero_never_starts_a_trace(self, store):
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=0.0)
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        svc.query_range(PROMQL, QS, STEP, QE)
+        assert tracing.current_trace() is None
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_evicts_oldest(self):
+        rec = tracing.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.record({"kind": "query", "i": i})
+        assert len(rec) == 4
+        assert [e["i"] for e in rec.snapshot()] == [6, 7, 8, 9]
+        rec.resize(2)  # shrink keeps the newest entries
+        assert [e["i"] for e in rec.snapshot()] == [8, 9]
+        rec.clear()
+        assert len(rec) == 0
+
+    def test_slow_queries_newest_first_with_limit(self):
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=1.0,
+                          slowlog_capacity=8)
+        tracing.flight_recorder().clear()
+        for i in range(5):
+            tracing.record_slow("query", 50.0 + i, query=f"q{i}")
+        entries = tracing.slow_queries()
+        assert [e["query"] for e in entries] == ["q4", "q3", "q2", "q1", "q0"]
+        assert [e["query"] for e in tracing.slow_queries(limit=2)] \
+            == ["q4", "q3"]
+
+    def test_threshold_gates_recording(self):
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=100.0,
+                          slowlog_capacity=8)
+        tracing.flight_recorder().clear()
+        tracing.record_slow("query", 50.0, query="fast")
+        tracing.record_slow("query", 150.0, query="slow")
+        assert [e["query"] for e in tracing.slow_queries()] == ["slow"]
+
+    def test_traced_operation_records_slow_runs(self):
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=0.001,
+                          slowlog_capacity=8)
+        tracing.flight_recorder().clear()
+        with tracing.traced_operation("rules", group="g1", steps=3):
+            pass
+        entries = tracing.slow_queries()
+        assert entries and entries[0]["kind"] == "rules"
+        assert entries[0]["group"] == "g1"
+        assert entries[0]["spans"][0]["name"] == "rules"
+
+
+class TestHttpSurface:
+    @pytest.fixture(params=["threaded", "fast"])
+    def http_env(self, request, store):
+        svc = QueryService(store, "timeseries", NUM_SHARDS, spread=1)
+        if request.param == "threaded":
+            from filodb_tpu.http.server import FiloHttpServer
+            srv = FiloHttpServer({"timeseries": svc}, port=0).start()
+        else:
+            from filodb_tpu.http.fastserver import FastHttpServer
+            srv = FastHttpServer({"timeseries": svc}, port=0).start()
+        yield srv
+        srv.stop()
+
+    def _get(self, srv, path):
+        url = f"http://127.0.0.1:{srv.port}{path}"
+        with urllib.request.urlopen(url) as r:
+            assert r.status == 200
+            return json.load(r)
+
+    def test_stats_all_param_expands_query_stats(self, http_env):
+        qs = urllib.parse.urlencode({
+            "query": PROMQL, "start": QS, "end": QE, "step": STEP,
+            "stats": "all"})
+        doc = self._get(http_env,
+                        f"/promql/timeseries/api/v1/query_range?{qs}")
+        stats = doc["queryStats"]
+        for key in ("seriesScanned", "samplesScanned", "chunksTouched",
+                    "cacheHits", "cacheMisses", "wireBytes",
+                    "admissionWaitMs", "decodeMs", "reduceMs"):
+            assert key in stats, key
+        assert stats["samplesScanned"] > 0
+
+        # without the param the compact stats render (no expanded keys)
+        qs = urllib.parse.urlencode({
+            "query": PROMQL, "start": QS, "end": QE, "step": STEP})
+        doc = self._get(http_env,
+                        f"/promql/timeseries/api/v1/query_range?{qs}")
+        assert "chunksTouched" not in doc["queryStats"]
+
+    def test_slow_queries_endpoint_serves_recorder(self, http_env):
+        tracing.configure(sample_rate=1.0, slow_query_threshold_ms=0.001,
+                          slowlog_capacity=16)
+        tracing.flight_recorder().clear()
+        qs = urllib.parse.urlencode({
+            "query": PROMQL, "start": QS, "end": QE, "step": STEP})
+        self._get(http_env, f"/promql/timeseries/api/v1/query_range?{qs}")
+        doc = self._get(http_env,
+                        "/promql/timeseries/api/v1/debug/slow_queries")
+        entries = doc["data"]["slow_queries"]
+        assert entries
+        e = entries[0]
+        assert e["kind"] == "query"
+        assert e["query"] == PROMQL
+        assert e["stats"]["samples_scanned"] > 0
+        assert any(s["name"] == "parse" for s in e["spans"])
+        # ?limit= caps the dump
+        doc = self._get(
+            http_env,
+            "/promql/timeseries/api/v1/debug/slow_queries?limit=1")
+        assert len(doc["data"]["slow_queries"]) == 1
+
+    def test_debug_trace_joins_and_records(self, http_env):
+        tracing.configure(sample_rate=0.0, slow_query_threshold_ms=0.001,
+                          slowlog_capacity=16)
+        tracing.flight_recorder().clear()
+        qs = urllib.parse.urlencode({
+            "query": PROMQL, "start": QS, "end": QE, "step": STEP})
+        doc = self._get(http_env,
+                        f"/promql/timeseries/api/v1/debug/trace?{qs}")
+        names = [s["name"] for s in doc["data"]["spans"]]
+        assert "parse" in names
+        assert doc["data"]["stats"]["samples_scanned"] > 0
+        # the traced query ALSO tail-captured into the flight recorder
+        # (traced_query joined the endpoint's active trace)
+        assert any(e["kind"] == "query"
+                   for e in tracing.slow_queries())
