@@ -30,7 +30,21 @@ def _maybe_install_lockcheck():
                                   "") not in ("", "0", "false"))
 
 
+def _maybe_install_racecheck():
+    # FILODB_RACECHECK=1 arms the shared-state race sanitizer for the
+    # whole process. Runs after lockcheck (its guard sets come from the
+    # lock checker's held stack) and before any filodb module registers
+    # shared objects.
+    import os
+    if os.environ.get("FILODB_RACECHECK", "") not in ("", "0", "false"):
+        from filodb_tpu.utils import racecheck
+        racecheck.install(
+            strict=os.environ.get("FILODB_RACECHECK_STRICT",
+                                  "") not in ("", "0", "false"))
+
+
 _maybe_install_lockcheck()
+_maybe_install_racecheck()
 
 
 def __getattr__(name):

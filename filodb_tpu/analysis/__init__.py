@@ -14,6 +14,15 @@ Passes (each a ``run(ctx) -> list[Finding]`` module):
   from ``with self._lock:`` scopes; blocking calls under a held lock
   (LD101), statically-approximated lock-order cycles (LD102), and
   attributes mutated both under and outside any lock (LD103).
+- :mod:`~filodb_tpu.analysis.lifecycle` — interprocedural resource
+  lifecycle: acquire/release pairs through exception paths and local
+  call closures. Leak-on-exception (RL401), never-released (RL402),
+  non-daemon thread never joined (RL403), queue ack outside finally
+  (RL404).
+- :mod:`~filodb_tpu.analysis.chokepoint` — whole-repo choke-point
+  proofs: dispatch without a deadline (CP501), query execution outside
+  governor admission (CP502), breaker bookkeeping outside resilience.py
+  (CP503), double outcome in one ``calling()`` path (CP504).
 - :mod:`~filodb_tpu.analysis.parity` — wire-registry closure (PR201/2),
   ``filodb_*`` metric name parity with the scrape test's expected lists
   (PR203/4), Prometheus name charset (PR205).

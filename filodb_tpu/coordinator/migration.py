@@ -41,6 +41,7 @@ import logging
 import time
 
 from filodb_tpu.coordinator.shardmapper import ShardStatus
+from filodb_tpu.utils import racecheck
 from filodb_tpu.utils.metrics import Counter, Gauge, Histogram
 from filodb_tpu.utils.resilience import FaultInjector
 
@@ -111,6 +112,10 @@ class MigrationManifest:
         self.lag_threshold = lag_threshold
         self.started_ms = started_ms
         self.updated_ms = updated_ms
+        # phase transitions are written by the migration driver and read
+        # by control-plane status calls on other threads
+        racecheck.register(
+            self, f"MigrationManifest[{dataset}/{shard}]")
 
     def to_bytes(self) -> bytes:
         return json.dumps({k: getattr(self, k)
@@ -167,6 +172,7 @@ class ShardMigration:
         self.phase = PLANNED
         self.started_ms = int(time.time() * 1000)
         self.lag = -1
+        racecheck.register(self, f"ShardMigration[{dataset}/{shard}]")
 
     # -- plumbing ---------------------------------------------------------
 

@@ -372,28 +372,33 @@ class RemotePlanDispatcher(PlanDispatcher):
                            port=self.port)
         sock = socket.create_connection((self.host, self.port),
                                         timeout=timeout)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        secret = cluster_secret()
-        if secret is not None:
-            _send_msg(sock, ("auth", secret))
-            resp = _recv_msg(sock)
-            if resp[0] != "ok":
-                sock.close()
-                raise ConnectionError("cluster auth rejected")
-        key = (self.host, self.port)
-        if _peer_caps.get(key) is not False:
-            # negotiate frame compression; a pre-compression peer answers
-            # ("err", "unknown message 'hello'") and the connection stays
-            # usable — remember the refusal so later dials skip the
-            # exchange
-            try:
+        # anything that raises between connect and return — setsockopt,
+        # the auth/hello exchange, an encode TypeError, even
+        # KeyboardInterrupt — must not leak the socket; a narrow
+        # TRANSPORT_ERRORS guard here leaked fds for every other
+        # exception class
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            secret = cluster_secret()
+            if secret is not None:
+                _send_msg(sock, ("auth", secret))
+                resp = _recv_msg(sock)
+                if resp[0] != "ok":
+                    raise ConnectionError("cluster auth rejected")
+            key = (self.host, self.port)
+            if _peer_caps.get(key) is not False:
+                # negotiate frame compression; a pre-compression peer
+                # answers ("err", "unknown message 'hello'") and the
+                # connection stays usable — remember the refusal so
+                # later dials skip the exchange
                 _send_msg(sock, ("hello", {"compress": True}))
                 resp = _recv_msg(sock)
-            except TRANSPORT_ERRORS:
-                _close_quietly(sock)
-                raise
-            _peer_caps[key] = (resp[0] == "ok" and isinstance(resp[1], dict)
-                               and bool(resp[1].get("compress")))
+                _peer_caps[key] = (resp[0] == "ok"
+                                   and isinstance(resp[1], dict)
+                                   and bool(resp[1].get("compress")))
+        except BaseException:
+            _close_quietly(sock)
+            raise
         return sock
 
     def _drop_conn(self):
@@ -418,7 +423,12 @@ class RemotePlanDispatcher(PlanDispatcher):
             nsent = _send_msg(sock, msg,
                               compress=_peer_caps.get(key, False))
             resp, nrecv = _recv_frame(sock)
-        except self.TRANSPORT_ERRORS:
+        except BaseException:
+            # broad on purpose: the checked-out socket must reach
+            # checkin or close on EVERY exit edge. Transport errors
+            # still propagate for the retry loop; a non-transport
+            # exception (encode TypeError, KeyboardInterrupt) used to
+            # leak the fd out of the pool forever
             _close_quietly(sock)
             raise
         _pool.checkin(key, sock)

@@ -21,6 +21,7 @@ from filodb_tpu.coordinator.shardmapper import (
     ShardMapper,
     ShardStatus,
 )
+from filodb_tpu.utils import racecheck
 
 log = logging.getLogger(__name__)
 
@@ -81,15 +82,22 @@ class ShardManager:
     _event_log: list = field(default_factory=list)  # [(seq, ShardEvent)]
     # _publish runs on heartbeat/join threads; events_since on executor
     # handler threads — the log and mapper snapshot need a lock
-    _ev_lock: object = field(default_factory=threading.Lock)
+    _ev_lock: object = field(init=False, repr=False)
 
     def __post_init__(self):
+        # created here rather than via default_factory: a class-body
+        # default_factory captures threading.Lock at import time, so the
+        # lock would dodge lockcheck's wrapping and every _publish write
+        # would look guard-free to the race sanitizer
+        self._ev_lock = threading.Lock()
         self.mapper = ShardMapper(self.num_shards)
         # feed-generation token: a restarted coordinator resets _seq to 0,
         # and a follower whose ack lands inside the NEW feed's range would
         # otherwise silently skip events (neither behind nor ahead fires).
         # Followers echo the epoch; any change forces a snapshot resync.
         self.epoch = uuid.uuid4().hex[:16]
+        # shared across heartbeat/join/migration/executor-handler threads
+        racecheck.register(self, f"ShardManager[{self.dataset}]")
 
     # -- membership --
 

@@ -12,6 +12,7 @@ import enum
 from dataclasses import dataclass, field
 
 from filodb_tpu.core.partkey import ingestion_shard, shards_for_shard_key
+from filodb_tpu.utils import racecheck
 
 
 class ShardStatus(enum.Enum):
@@ -55,6 +56,9 @@ class ShardMapper:
         if not self.statuses:
             self.statuses = [ShardStatus.UNASSIGNED] * self.num_shards
             self.owners = [None] * self.num_shards
+        # routing table read by every query/ingest thread, written by
+        # membership and migration events
+        racecheck.register(self, "ShardMapper")
 
     def apply(self, ev: ShardEvent) -> None:
         self.statuses[ev.shard] = ev.status

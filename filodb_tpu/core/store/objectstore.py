@@ -1089,6 +1089,10 @@ class ObjectStoreColumnStore(ColumnStore):
         try:
             self.flush()
         finally:
+            # the uploader stops even when flush() raises (parked shards,
+            # upload errors): _closed makes _uploader_put's retry-forever
+            # loop re-raise instead of backing off, so the drain to _STOP
+            # cannot wedge the join behind a dead endpoint
             self._closed = True
             self._queue.put(_STOP)
             self._uploader.join(timeout=30)

@@ -223,7 +223,15 @@ class KafkaProtocolClient:
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # the fd is owned-but-unpublished until self._sock = s
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except BaseException:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                raise
             self._sock = s
         return self._sock
 

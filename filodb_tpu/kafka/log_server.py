@@ -184,13 +184,21 @@ class RemoteLog(ReplayLog):
         if self._sock is None:
             s = socket.create_connection((self.host, self.port),
                                          timeout=self.timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            secret = cluster_secret()
-            if secret is not None:
-                _send_msg(s, ("auth", secret))
-                if _recv_msg(s)[0] != "ok":
+            # the fd is owned-but-unpublished until self._sock = s; any
+            # exception before that (setsockopt, auth) must close it
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                secret = cluster_secret()
+                if secret is not None:
+                    _send_msg(s, ("auth", secret))
+                    if _recv_msg(s)[0] != "ok":
+                        raise ConnectionError("log server auth rejected")
+            except BaseException:
+                try:
                     s.close()
-                    raise ConnectionError("log server auth rejected")
+                except OSError:
+                    pass
+                raise
             self._sock = s
         return self._sock
 

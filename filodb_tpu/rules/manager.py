@@ -77,6 +77,7 @@ from filodb_tpu.query.model import QueryContext
 from filodb_tpu.rules import notify
 from filodb_tpu.rules.model import AlertingRule, RecordingRule, RuleGroup
 from filodb_tpu.utils import governor as governor_mod
+from filodb_tpu.utils import racecheck
 from filodb_tpu.utils.metrics import Counter, Gauge, Histogram, get_gauge
 from filodb_tpu.utils.resilience import FaultInjector
 from filodb_tpu.utils.tracing import traced_operation
@@ -212,7 +213,13 @@ class RuleManager:
         self.max_catchup_steps = max(1, int(max_catchup_steps))
         self.default_labels = dict(default_labels
                                    or {"_ws_": "default", "_ns_": "default"})
-        self._state = {g.name: _GroupState() for g in self.groups}
+        # group states are committed under _lock from the tick thread
+        # and snapshotted from API/recovery threads; the race sanitizer
+        # (when armed) verifies every write actually holds a common lock
+        self._state = racecheck.tracked_dict("RuleManager._state", {
+            g.name: racecheck.register(
+                _GroupState(), f"RuleManager.state[{g.name}]")
+            for g in self.groups})
         # _lock guards group state for brief commits/snapshots only;
         # _eval_lock serializes ticks so queries and sink writes run
         # without blocking state readers

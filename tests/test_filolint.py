@@ -22,7 +22,14 @@ from filodb_tpu.analysis import (
     Finding,
     run_all,
 )
-from filodb_tpu.analysis import cli, hotpath, lockdiscipline, parity
+from filodb_tpu.analysis import (
+    chokepoint,
+    cli,
+    hotpath,
+    lifecycle,
+    lockdiscipline,
+    parity,
+)
 from filodb_tpu.analysis.model import suppressed
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -547,6 +554,289 @@ class TestHotPath:
 
 
 # --------------------------------------------------------------------------
+# RL4xx resource lifecycle
+
+class TestLifecycle:
+    def test_rl401_leak_on_exception_narrow_except(self, tmp_path):
+        # the remote.py postmortem shape: a checked-out socket crossing
+        # raising calls with only a narrow transport-error handler —
+        # any other exception class leaks the fd out of the pool
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            class D:
+                def roundtrip(self, pool, key, msg):
+                    sock = pool.checkout(key)
+                    try:
+                        sock.sendall(msg)
+                        resp = sock.recv(4096)
+                    except (ConnectionError, OSError):
+                        sock.close()
+                        raise
+                    pool.checkin(key, sock)
+                    return resp
+            """})
+        assert codes(out) == ["RL401"]
+        assert "sock" in out[0].detail
+
+    def test_rl401_broad_except_is_protection(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            class D:
+                def roundtrip(self, pool, key, msg):
+                    sock = pool.checkout(key)
+                    try:
+                        sock.sendall(msg)
+                        resp = sock.recv(4096)
+                    except BaseException:
+                        sock.close()
+                        raise
+                    pool.checkin(key, sock)
+                    return resp
+            """})
+        assert out == []
+
+    def test_rl401_finally_is_protection(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import socket
+
+            def fetch(host, msg):
+                s = socket.create_connection((host, 80))
+                try:
+                    s.sendall(msg)
+                    return s.recv(4096)
+                finally:
+                    s.close()
+            """})
+        assert out == []
+
+    def test_rl402_leak_through_helper(self, tmp_path):
+        # the acquisition is hidden in a local helper whose summary
+        # says "returns a fresh socket"; the caller never releases it
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import socket
+
+            class D:
+                def _dial(self):
+                    s = socket.create_connection(("h", 80))
+                    return s
+
+                def ping(self):
+                    sock = self._dial()
+                    sock.sendall(b"ping")
+            """})
+        assert "RL402" in codes(out)
+        assert any("self._dial()" in f.detail for f in out)
+
+    def test_release_through_helper_is_clean(self, tmp_path):
+        # ...and a release hidden in a helper counts as a release
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import socket
+
+            def _close_quietly(sock):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+            def probe(host):
+                s = socket.create_connection((host, 80))
+                try:
+                    s.sendall(b"hi")
+                finally:
+                    _close_quietly(s)
+            """})
+        assert out == []
+
+    def test_ownership_transfer_silences(self, tmp_path):
+        # storing the socket on self transfers ownership out of the
+        # function — constructor caching, not a leak
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import socket
+
+            class Conn:
+                def connect(self, host):
+                    s = socket.create_connection((host, 80))
+                    self._sock = s
+                    return self._sock
+            """})
+        assert out == []
+
+    def test_rl403_thread_not_joined(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import threading
+
+            def fire(work):
+                t = threading.Thread(target=work)
+                t.start()
+            """})
+        assert codes(out) == ["RL403"]
+
+    def test_rl403_daemon_or_joined_clean(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import threading
+
+            def daemonized(work):
+                t = threading.Thread(target=work, daemon=True)
+                t.start()
+
+            def awaited(work):
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+            """})
+        assert out == []
+
+    def test_rl403_self_thread_joined_elsewhere_in_class(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            import threading
+
+            class Worker:
+                def start(self):
+                    self._t = threading.Thread(target=self.run)
+                    self._t.start()
+
+                def stop(self):
+                    self._t.join()
+
+            class Leaky:
+                def start(self):
+                    self._t = threading.Thread(target=self.run)
+                    self._t.start()
+            """})
+        assert codes(out) == ["RL403"]
+        assert out[0].symbol.startswith("Leaky")
+
+    def test_rl404_ack_outside_finally(self, tmp_path):
+        out = run_pass(tmp_path, lifecycle, {"filodb_tpu/m.py": """
+            class W:
+                def drain_bad(self):
+                    item = self._q.get()
+                    self.handle(item)
+                    self._q.task_done()
+
+                def drain_good(self):
+                    item = self._q.get()
+                    try:
+                        self.handle(item)
+                    finally:
+                        self._q.task_done()
+            """})
+        assert codes(out) == ["RL404"]
+        assert out[0].symbol == "W.drain_bad"
+
+
+# --------------------------------------------------------------------------
+# CP5xx choke points
+
+class TestChokepoint:
+    def test_cp501_deadline_dropped_at_new_call_site(self, tmp_path):
+        # a NEW dispatcher subclass that blocks on the network without
+        # consulting any deadline — the invariant PR 1 review restored
+        # by hand
+        out = run_pass(tmp_path, chokepoint, {"filodb_tpu/m.py": """
+            class GoodDispatcher(PlanDispatcher):
+                def dispatch(self, plan, ctx):
+                    ctx.deadline.check()
+                    return self._sock.recv(4096)
+
+            class BadDispatcher(PlanDispatcher):
+                def dispatch(self, plan, ctx):
+                    return self._sock.recv(4096)
+            """})
+        assert codes(out) == ["CP501"]
+        assert out[0].symbol == "BadDispatcher.dispatch"
+
+    def test_cp501_closure_sees_helper_deadline(self, tmp_path):
+        # the deadline reference may live in a self-call helper
+        out = run_pass(tmp_path, chokepoint, {"filodb_tpu/m.py": """
+            class D(PlanDispatcher):
+                def dispatch(self, plan, ctx):
+                    return self._roundtrip(plan, ctx)
+
+                def _roundtrip(self, plan, ctx):
+                    self._sock.settimeout(ctx.deadline.remaining())
+                    return self._sock.recv(4096)
+            """})
+        assert out == []
+
+    def test_cp502_dispatch_outside_admission(self, tmp_path):
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/coordinator/m.py": """
+            class Svc:
+                def run_bad(self, plan, ctx):
+                    return plan.dispatcher.dispatch(plan, ctx)
+
+                def run_good(self, plan, ctx):
+                    with governor().admit(cost=2):
+                        return plan.dispatcher.dispatch(plan, ctx)
+            """})
+        assert codes(out) == ["CP502"]
+        assert out[0].symbol == "Svc.run_bad"
+
+    def test_cp502_plan_tree_internals_exempt(self, tmp_path):
+        # below the gate, dispatch recursion is already admitted
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/query/exec/m.py": """
+            class Node:
+                def execute(self, ctx):
+                    return self.child.dispatcher.dispatch(self.child, ctx)
+            """})
+        assert out == []
+
+    def test_cp503_direct_bookkeeping(self, tmp_path):
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/coordinator/m.py": """
+            def flaky(peer):
+                breaker_for(peer).record_failure()
+            """,
+            "filodb_tpu/utils/resilience.py": """
+            class CircuitBreaker:
+                def ok(self):
+                    self.record_success()
+            """})
+        assert codes(out) == ["CP503"]
+        assert out[0].path == "filodb_tpu/coordinator/m.py"
+
+    def test_cp503_force_open_exempt(self, tmp_path):
+        # a failure-detector verdict, not a call outcome
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/coordinator/m.py": """
+            def member_lost(peer):
+                breaker_for(peer).force_open()
+            """})
+        assert out == []
+
+    def test_cp504_double_outcome_one_path(self, tmp_path):
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/coordinator/m.py": """
+            def call(breaker, req):
+                with breaker.calling() as out:
+                    resp = send(req)
+                    out.success()
+                    out.success()
+                    return resp
+            """})
+        assert codes(out) == ["CP504"]
+
+    def test_cp504_alternative_paths_clean(self, tmp_path):
+        # the remote_exec shape: each handler is its own path, one
+        # outcome per path
+        out = run_pass(tmp_path, chokepoint, {
+            "filodb_tpu/coordinator/m.py": """
+            def call(breaker, req):
+                with breaker.calling() as out:
+                    try:
+                        resp = send(req)
+                    except HTTPError:
+                        out.success()
+                        raise
+                    except DecodeError:
+                        out.failure()
+                        raise
+                    return resp
+            """})
+        assert out == []
+
+
+# --------------------------------------------------------------------------
 # model: suppression, baseline, CLI
 
 class TestModel:
@@ -621,6 +911,91 @@ class TestModel:
                           {"filodb_tpu/bad.py": "def broken(:\n"})
         assert cli.main(["--root", root]) == 2
         capsys.readouterr()
+
+    def test_cli_sarif_output(self, tmp_path, capsys):
+        root = write_tree(tmp_path, {"filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+            """})
+        bl = str(tmp_path / "baseline.json")
+        assert cli.main(["--root", root, "--baseline", bl,
+                         "--format", "sarif"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "filolint"
+        # the minimal tree also trips the parity placeholders (PR202/4)
+        (res,) = [r for r in run["results"] if r["ruleId"] == "LD101"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "filodb_tpu/m.py"
+        assert loc["region"]["startLine"] > 0
+        # line-free key rides along for CI result matching
+        assert res["partialFingerprints"]["filolintKey"].startswith(
+            "LD101:")
+        assert any(r["id"] == "LD101"
+                   for r in run["tool"]["driver"]["rules"])
+
+    def test_cli_changed_only_filters_to_diff_scope(self, tmp_path,
+                                                    capsys):
+        import subprocess
+
+        root = write_tree(tmp_path, {
+            "filodb_tpu/clean.py": "X = 1\n",
+            "filodb_tpu/m.py": """
+            import threading, time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        time.sleep(1)
+            """})
+        bl = str(tmp_path / "baseline.json")
+
+        def git(*a):
+            subprocess.run(["git", *a], cwd=root, check=True,
+                           capture_output=True)
+
+        git("init", "-q")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-q", "--allow-empty", "-m", "seed")
+        git("add", "-A")
+        git("-c", "user.email=t@t", "-c", "user.name=t",
+            "commit", "-q", "-m", "base")
+        # nothing changed vs HEAD -> the LD101 in m.py is out of scope
+        assert cli.main(["--root", root, "--baseline", bl,
+                         "--changed-only"]) == 0
+        capsys.readouterr()
+        # touch m.py -> back in scope
+        with open(os.path.join(root, "filodb_tpu", "m.py"), "a") as f:
+            f.write("\n")
+        assert cli.main(["--root", root, "--baseline", bl,
+                         "--changed-only"]) == 1
+        capsys.readouterr()
+
+    def test_changed_only_dependent_closure(self, tmp_path):
+        # helper.py changed -> caller.py (which imports it) is in scope
+        root = write_tree(tmp_path, {
+            "filodb_tpu/__init__.py": "",
+            "filodb_tpu/helper.py": "def f():\n    return 1\n",
+            "filodb_tpu/caller.py":
+                "from filodb_tpu.helper import f\n",
+            "filodb_tpu/unrelated.py": "Y = 2\n",
+        })
+        ctx = AnalysisContext.build(root)
+        scope = cli._dependent_closure(
+            ctx, {"filodb_tpu/helper.py"})
+        assert "filodb_tpu/caller.py" in scope
+        assert "filodb_tpu/unrelated.py" not in scope
 
 
 # --------------------------------------------------------------------------

@@ -37,7 +37,7 @@ from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
 from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
 from filodb_tpu.kafka.log import InMemoryLog
 from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
-from filodb_tpu.utils import lockcheck
+from filodb_tpu.utils import lockcheck, racecheck
 from filodb_tpu.utils.resilience import FaultInjector
 
 START = 1_600_000_000
@@ -66,22 +66,30 @@ def cluster_env():
     # the teardown assertion makes any order cycle or blocking-under-
     # lock observed during the kill-point matrix a test failure
     with lockcheck.session():
-        cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
-        logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
-        keys = machine_metrics_series(12, ns="App-3")
-        _publish(logs, gauge_stream(keys, 240, start_ms=START * 1000),
-                 NUM_SHARDS)
-        cluster = FilodbCluster()
-        for n in ("node-a", "node-b"):
-            cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
-        config = IngestionConfig("timeseries", NUM_SHARDS, min_num_nodes=2,
-                                 store=StoreConfig(max_chunk_size=60,
-                                                   groups_per_shard=2))
-        cluster.setup_dataset(config, logs)
-        assert cluster.wait_active("timeseries", 10)
-        yield cluster, cs
-        cluster.stop()
+        # ...and the shared-state race sanitizer beside it: shard maps,
+        # migration manifests, and the migration state machines register
+        # themselves, and any write to them that no common lock guards
+        # across the kill-point matrix fails the test at teardown
+        with racecheck.session():
+            cs, meta = InMemoryColumnStore(), InMemoryMetaStore()
+            logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
+            keys = machine_metrics_series(12, ns="App-3")
+            _publish(logs, gauge_stream(keys, 240, start_ms=START * 1000),
+                     NUM_SHARDS)
+            cluster = FilodbCluster()
+            for n in ("node-a", "node-b"):
+                cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
+            config = IngestionConfig("timeseries", NUM_SHARDS,
+                                     min_num_nodes=2,
+                                     store=StoreConfig(max_chunk_size=60,
+                                                       groups_per_shard=2))
+            cluster.setup_dataset(config, logs)
+            assert cluster.wait_active("timeseries", 10)
+            yield cluster, cs
+            cluster.stop()
+            rvs = racecheck.violations()
         vs = lockcheck.violations()
+    assert rvs == [], [v.render() for v in rvs]
     assert vs == [], [v.render() for v in vs]
 
 

@@ -17,7 +17,7 @@ import pytest
 from filodb_tpu.config import ServerConfig
 from filodb_tpu.standalone import FiloServer
 from filodb_tpu.utils import governor as gov
-from filodb_tpu.utils import lockcheck
+from filodb_tpu.utils import lockcheck, racecheck
 from filodb_tpu.utils.resilience import (
     DeadlineExceeded,
     FaultInjector,
@@ -65,10 +65,16 @@ def server(tmp_path):
     # the session, and any order cycle or blocking call made under one
     # of them during the 4x-overload run fails the test at teardown
     with lockcheck.session():
-        srv = FiloServer(cfg).start()
-        yield srv
-        srv.shutdown()
+        # race sanitizer beside it: the server's shard maps and metric
+        # registry are tracked, and an unguarded or mixed-guard write
+        # observed anywhere in the 4x-overload run fails at teardown
+        with racecheck.session():
+            srv = FiloServer(cfg).start()
+            yield srv
+            srv.shutdown()
+            rvs = racecheck.violations()
         vs = lockcheck.violations()
+    assert rvs == [], [v.render() for v in rvs]
     assert vs == [], [v.render() for v in vs]
     FaultInjector.reset()
     gov.reset()

@@ -1,0 +1,244 @@
+"""Runtime shared-state race sanitizer (filodb_tpu/utils/racecheck.py).
+
+Each scenario registers fresh objects INSIDE an installed session (only
+objects registered after install are tracked) and checks what the
+Eraser-style lockset tracker records — and what it does not. Guard
+identity comes from lockcheck's creation-site keys, so every scenario
+runs under both checkers, exactly as the chaos fixtures arm them.
+"""
+
+import threading
+
+import pytest
+
+from filodb_tpu.utils import lockcheck, racecheck
+
+
+@pytest.fixture(autouse=True)
+def _clean_install():
+    racecheck.uninstall()
+    lockcheck.uninstall()
+    yield
+    racecheck.uninstall()
+    lockcheck.uninstall()
+
+
+class Shared:
+    pass
+
+
+def write_from_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class TestLockset:
+    def test_guard_free_write_flagged(self):
+        with racecheck.session():
+            obj = racecheck.register(Shared(), "t.obj")
+            write_from_thread(lambda: setattr(obj, "x", 1))
+            obj.x = 2
+            vs = racecheck.violations()
+        assert [v.kind for v in vs] == ["guard-free"]
+        assert "t.obj.x" in vs[0].detail
+
+    def test_common_guard_clean(self):
+        with racecheck.session():
+            lk = threading.Lock()
+            obj = racecheck.register(Shared(), "t.obj")
+
+            def w():
+                with lk:
+                    obj.x = 1
+
+            write_from_thread(w)
+            with lk:
+                obj.x = 2
+            vs = racecheck.violations()
+        assert vs == []
+
+    def test_mixed_guard_flagged(self):
+        with racecheck.session():
+            la = threading.Lock()
+            lb = threading.Lock()
+            obj = racecheck.register(Shared(), "t.obj")
+
+            def w():
+                with la:
+                    obj.x = 1
+
+            write_from_thread(w)
+            with lb:
+                obj.x = 2
+            vs = racecheck.violations()
+        assert [v.kind for v in vs] == ["mixed-guard"]
+
+    def test_single_thread_needs_no_lock(self):
+        # Eraser's point: single-threaded state is not a race, however
+        # it is written
+        with racecheck.session():
+            obj = racecheck.register(Shared(), "t.obj")
+            obj.x = 1
+            with threading.Lock():
+                obj.x = 2
+            obj.x = 3
+            vs = racecheck.violations()
+        assert vs == []
+
+    def test_one_outer_lock_among_several_clean(self):
+        # writers may hold extra locks as long as ONE stays common
+        with racecheck.session():
+            common = threading.Lock()
+            extra = threading.Lock()
+            obj = racecheck.register(Shared(), "t.obj")
+
+            def w():
+                with common:
+                    with extra:
+                        obj.x = 1
+
+            write_from_thread(w)
+            with common:
+                obj.x = 2
+            vs = racecheck.violations()
+        assert vs == []
+
+    def test_duplicate_shapes_reported_once(self):
+        with racecheck.session():
+            obj = racecheck.register(Shared(), "t.obj")
+            write_from_thread(lambda: setattr(obj, "x", 1))
+            for i in range(5):
+                obj.x = i
+            vs = racecheck.violations()
+        assert len(vs) == 1
+
+    def test_unregistered_object_ignored(self):
+        with racecheck.session():
+            racecheck.register(Shared(), "t.tracked")
+            loose = Shared()   # same class, never registered
+            write_from_thread(lambda: setattr(loose, "x", 1))
+            loose.x = 2
+            vs = racecheck.violations()
+        assert vs == []
+
+    def test_strict_mode_raises(self):
+        with racecheck.session(strict=True):
+            obj = racecheck.register(Shared(), "t.obj")
+            write_from_thread(lambda: setattr(obj, "x", 1))
+            with pytest.raises(racecheck.RaceViolation):
+                obj.x = 2
+
+
+class TestTrackedDict:
+    def test_per_key_guard_free_flagged(self):
+        with racecheck.session():
+            d = racecheck.tracked_dict("t.map")
+            write_from_thread(lambda: d.__setitem__("k", 1))
+            d["k"] = 2
+            vs = racecheck.violations()
+        assert [v.kind for v in vs] == ["guard-free"]
+        assert "t.map" in vs[0].detail
+
+    def test_distinct_keys_are_distinct_cells(self):
+        # two threads each owning their own key is not a race
+        with racecheck.session():
+            d = racecheck.tracked_dict("t.map")
+            write_from_thread(lambda: d.__setitem__("a", 1))
+            d["b"] = 2
+            vs = racecheck.violations()
+        assert vs == []
+
+    def test_stays_a_real_dict(self):
+        with racecheck.session():
+            d = racecheck.tracked_dict("t.map", {"a": 1})
+            assert isinstance(d, dict)
+            assert dict(d) == {"a": 1}
+            d.update(b=2)
+            assert d.pop("a") == 1
+            assert d.setdefault("c", 3) == 3
+            d.clear()
+            assert d == {}
+
+    def test_plain_dict_when_uninstalled(self):
+        d = racecheck.tracked_dict("t.map", {"a": 1})
+        assert type(d) is dict
+
+
+class TestWireCompat:
+    def test_registered_manifest_still_encodes(self):
+        # the tracker patches __setattr__ on the ORIGINAL class — it
+        # must never swap __class__, because wire encode checks exact
+        # class identity and MigrationManifest is wire-registered
+        from filodb_tpu.coordinator import wire
+        from filodb_tpu.coordinator.migration import MigrationManifest
+
+        with racecheck.session():
+            m = MigrationManifest("ds", 3, "a", "b")
+            assert type(m) is MigrationManifest
+            assert wire.decode(wire.encode(m)) == m
+            m.phase = "syncing"   # tracked write keeps working
+            assert wire.decode(wire.encode(m)).phase == "syncing"
+
+
+class TestLifecycle:
+    def test_install_installs_lockcheck_and_uninstall_undoes(self):
+        assert not lockcheck.installed()
+        racecheck.install()
+        assert racecheck.installed()
+        # guard sets come from lockcheck's held stack, so install
+        # piggybacks it...
+        assert lockcheck.installed()
+        racecheck.uninstall()
+        assert not racecheck.installed()
+        # ...and uninstall tears the piggyback down again
+        assert not lockcheck.installed()
+
+    def test_does_not_steal_existing_lockcheck(self):
+        lockcheck.install(strict=False)
+        racecheck.install()
+        racecheck.uninstall()
+        assert lockcheck.installed()
+        lockcheck.uninstall()
+
+    def test_class_patch_removed_on_uninstall(self):
+        racecheck.install()
+        obj = racecheck.register(Shared(), "t.obj")
+        assert "__setattr__" in Shared.__dict__
+        racecheck.uninstall()
+        assert "__setattr__" not in Shared.__dict__
+        obj.x = 1   # plain write, no tracking, no error
+
+    def test_register_is_noop_when_uninstalled(self):
+        obj = Shared()
+        assert racecheck.register(obj, "t.obj") is obj
+        assert "__setattr__" not in Shared.__dict__
+
+    def test_reset_clears_cells_and_violations(self):
+        racecheck.install()
+        obj = racecheck.register(Shared(), "t.obj")
+        write_from_thread(lambda: setattr(obj, "x", 1))
+        obj.x = 2
+        assert racecheck.violations()
+        racecheck.reset()
+        assert racecheck.violations() == []
+        # cells cleared too: the next write pair re-evaluates fresh
+        write_from_thread(lambda: setattr(obj, "x", 3))
+        obj.x = 4
+        assert [v.kind for v in racecheck.violations()] == ["guard-free"]
+        racecheck.uninstall()
+
+    def test_metrics_registry_swapped_and_restored(self):
+        from filodb_tpu.utils import metrics
+        racecheck.install()
+        assert isinstance(metrics._registry, racecheck._TrackedDict)
+        racecheck.uninstall()
+        assert type(metrics._registry) is dict
+
+    def test_env_flag(self, monkeypatch):
+        monkeypatch.delenv("FILODB_RACECHECK", raising=False)
+        assert not racecheck.enabled_by_env()
+        monkeypatch.setenv("FILODB_RACECHECK", "0")
+        assert not racecheck.enabled_by_env()
+        monkeypatch.setenv("FILODB_RACECHECK", "1")
+        assert racecheck.enabled_by_env()

@@ -41,7 +41,7 @@ from filodb_tpu.rules import (
 from filodb_tpu.rules import manager as mgr_mod
 from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
 from filodb_tpu.utils import governor as gov
-from filodb_tpu.utils import lockcheck
+from filodb_tpu.utils import lockcheck, racecheck
 from filodb_tpu.utils.resilience import FaultInjector
 
 NUM_SHARDS = 4
@@ -480,9 +480,16 @@ class TestChaos:
         # lock or acquire locks in conflicting orders
         FaultInjector.reset()
         with lockcheck.session():
-            yield
+            # race sanitizer beside it: every RuleManager built in the
+            # matrix registers its group states, and a commit that no
+            # common lock guards across tick/recovery/API threads fails
+            # the test at teardown
+            with racecheck.session():
+                yield
+                rvs = racecheck.violations()
             vs = lockcheck.violations()
         FaultInjector.reset()
+        assert rvs == [], [v.render() for v in rvs]
         assert vs == [], [v.render() for v in vs]
 
     def two_rule_group(self):
