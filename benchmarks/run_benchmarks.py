@@ -486,6 +486,12 @@ def _bench_tracing_overhead():
     return bench_tracing_overhead()
 
 
+def _bench_selfmon_overhead():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from selfmon_overhead import bench_selfmon_overhead
+    return bench_selfmon_overhead()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -505,6 +511,7 @@ ALL = {
     "migration": _bench_migration,
     "rules": _bench_rules,
     "tracing_overhead": _bench_tracing_overhead,
+    "selfmon_overhead": _bench_selfmon_overhead,
 }
 
 

@@ -17,6 +17,13 @@ hand-maintained:
 PR205 checks every metric name literal against the Prometheus data-model
 charset (``[a-zA-Z_:][a-zA-Z0-9_:]*``).
 
+PR206 hardens the freshness-telemetry families: any metric whose name
+starts with ``filodb_ingest_`` or ``filodb_selfmon_`` must appear in the
+scrape-test lists REGARDLESS of the lazy/GaugeFn exemptions PR203 grants
+— these series are the self-monitoring substrate (``_meta`` dataset,
+default lag alerts), so an unasserted family here means the monitoring
+of the monitor is untested.
+
 Static approximations: the wire walk mirrors ``_build_registry`` by
 reading its two loops from the AST (explicit tuple + subclass-walked
 bases) and closing over AST-declared subclasses; metric creations made
@@ -312,6 +319,26 @@ def _check_metrics(ctx: AnalysisContext, out: list[Finding]) -> None:
                 f"import-time metric {s.name!r} renders family {e!r} "
                 f"which no expected-name list in {ctx.scrape_test} "
                 f"asserts"))
+
+    # PR206: ingest/selfmon freshness families must be breadth-tested no
+    # matter how they register. Lazy registration (shard start) and
+    # GaugeFn conditionality do not exempt them: the scrape fixture boots
+    # shards and drives ingest, so every family here renders, and these
+    # are the series the _meta self-monitoring loop alerts on.
+    seen206: set[tuple[str, str]] = set()
+    for s in sites:
+        if not s.name.startswith(("filodb_ingest_", "filodb_selfmon_")):
+            continue
+        for e in s.exposed:
+            if e in expected or (s.name, e) in seen206:
+                continue
+            seen206.add((s.name, e))
+            out.append(Finding(
+                "PR206", s.path, s.line, s.symbol, e,
+                f"freshness-telemetry metric {s.name!r} renders family "
+                f"{e!r} which no expected-name list in "
+                f"{ctx.scrape_test} asserts (the lazy/GaugeFn "
+                f"exemptions do not apply to ingest/selfmon families)"))
 
     # PR204: asserted name no creation site produces (lazy sites count)
     produced: set[str] = set()

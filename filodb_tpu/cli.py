@@ -65,10 +65,77 @@ def cmd_list(args):
 
 
 def cmd_status(args):
+    import urllib.error
     import urllib.request
     url = f"http://{args.host}/api/v1/cluster/{args.dataset}/status"
     with urllib.request.urlopen(url) as r:
         print(json.dumps(json.load(r), indent=2))
+    # TSDB head/cardinality summary (``/api/v1/status/tsdb``); older
+    # servers without the route still answer the cluster status above
+    try:
+        with urllib.request.urlopen(
+                f"http://{args.host}/api/v1/status/tsdb"
+                f"?dataset={args.dataset}&topk={args.k}") as r:
+            doc = json.load(r)["data"].get(args.dataset)
+    except urllib.error.HTTPError:
+        return
+    if not doc:
+        return
+    head = doc["headStats"]
+    print(f"\nhead: series={head['numSeries']} shards={head['numShards']}")
+    print(f"{'SHARD':>5} {'SERIES':>8} {'INDEX_RAM':>10} {'ENC_BYTES':>10} "
+          f"{'CHUNKS_FLUSHED':>14}")
+    for s in doc["shards"]:
+        print(f"{s['shard']:>5} {s['numSeries']:>8} "
+              f"{s['indexRamBytes']:>10} {s['encodedBytes']:>10} "
+              f"{s['chunksFlushed']:>14}")
+    print("\ntop metrics by active series:")
+    for m in doc["seriesCountByMetricName"]:
+        print(f"  {m['name']:<40} {m['value']:>8}")
+    print("top labels by distinct values:")
+    for m in doc["labelValueCountByLabelName"]:
+        print(f"  {m['name']:<40} {m['value']:>8}")
+
+
+def cmd_lag(args):
+    """Ingest freshness one-pager: per-shard lag vs wall clock, replay-log
+    offset/checkpoint lag, write-behind queue state, and rules watermark
+    lag (``/api/v1/status/ingest``)."""
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{args.host}/api/v1/status/ingest") as r:
+        d = json.load(r)["data"]
+    if args.json:
+        print(json.dumps(d, indent=2))
+        return
+    print(f"{'DATASET':<14} {'SHARD':>5} {'LAG_S':>8} {'OFFSET':>8} "
+          f"{'LOG_LATEST':>10} {'OFF_LAG':>8} {'CKPT_LAG':>8}")
+    for ds, doc in d["datasets"].items():
+        for s in doc["shards"]:
+            lag = s.get("ingestLagSeconds")
+            print(f"{ds:<14} {s['shard']:>5} "
+                  f"{('-' if lag is None else f'{lag:.1f}'):>8} "
+                  f"{s['ingestedOffset']:>8} "
+                  f"{str(s.get('logLatestOffset', '-')):>10} "
+                  f"{str(s.get('offsetLag', '-')):>8} "
+                  f"{str(s.get('checkpointLag', '-')):>8}")
+    ob = d.get("objectstore", {})
+    print(f"\nobjectstore: queue_depth={ob.get('queueDepth')} "
+          f"oldest_task_age_s={ob.get('oldestTaskAgeSeconds', 0):.1f}")
+    if "gatewayQueueDepth" in d:
+        print(f"gateway: queue_depth={d['gatewayQueueDepth']}")
+    for group, lag in sorted(d.get("rulesWatermarkLagSeconds",
+                                   {}).items()):
+        print(f"rules[{group}]: watermark_lag_s={lag:.1f}")
+    slow = d.get("slowIngest", [])
+    if slow:
+        print(f"\nslow ingest operations (newest {len(slow)}):")
+        for e in slow:
+            print(f"  {e.get('kind', '?'):<12} "
+                  f"{e.get('duration_ms', 0):>9.1f}ms "
+                  + " ".join(f"{k}={e[k]}"
+                             for k in ("dataset", "shard", "group", "op")
+                             if e.get(k) is not None))
 
 
 def cmd_shardmap(args):
@@ -421,7 +488,12 @@ def main(argv=None):
     sub.add_parser("init")
     p = sub.add_parser("list")
     p.add_argument("--limit", type=int, default=20)
-    sub.add_parser("status")
+    p = sub.add_parser("status")
+    p.add_argument("-k", type=int, default=10,
+                   help="top-k cardinality entries in the TSDB summary")
+    p = sub.add_parser("lag")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
     sub.add_parser("shardmap")
     sub.add_parser("rules")
     p = sub.add_parser("slowlog")
@@ -458,6 +530,7 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
+            "lag": cmd_lag,
             "shardmap": cmd_shardmap, "rules": cmd_rules,
             "slowlog": cmd_slowlog,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,

@@ -87,6 +87,27 @@ DEFAULTS = {
         "sample_rate": 0.0,           # 0..1 fraction of queries traced
         "slow_query_threshold_ms": 500.0,  # tail capture; 0 disables
         "slowlog_capacity": 128,      # flight-recorder ring size
+        # ingest-side ring: slow gateway drains / shard ingests / flushes /
+        # object-store uploads, served at /api/v1/status/ingest
+        "slow_ingest_threshold_ms": 250.0,
+        "ingest_slowlog_capacity": 128,
+    },
+    # self-monitoring (filodb_tpu/utils/selfmon.py): sample the in-process
+    # metric registry every interval_s and ingest the families as series
+    # into the dedicated "_meta" dataset through the normal ingest path —
+    # PromQL, the result cache and standing rules/alerts all work over the
+    # node's own telemetry. default_alerts ships an ingest-lag +
+    # breaker-open alert group evaluated over _meta.
+    "selfmon": {
+        "enabled": False,
+        "interval_s": 15.0,
+        "num_shards": 1,
+        "include_buckets": False,     # also ingest per-le bucket series
+        "ooo_allowance_ms": 2_000,    # _meta rules horizon allowance
+        "default_alerts": True,
+        "lag_alert_threshold_s": 60.0,
+        "lag_alert_for": "30s",
+        "alert_interval": "5s",       # default alert group eval interval
     },
     # live shard migration / rebalancing (coordinator/migration.py)
     "migration": {
@@ -193,6 +214,7 @@ class ServerConfig:
     migration: dict = field(default_factory=dict)  # live-migration knobs
     rules: dict = field(default_factory=dict)  # standing-query rule groups
     tracing: dict = field(default_factory=dict)  # TracingConfig overrides
+    selfmon: dict = field(default_factory=dict)  # _meta self-monitoring
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -240,7 +262,8 @@ class ServerConfig:
             store=cfg.get("store", {}),
             migration=cfg.get("migration", {}),
             rules=cfg.get("rules", {}),
-            tracing=cfg.get("tracing", {}))
+            tracing=cfg.get("tracing", {}),
+            selfmon=cfg.get("selfmon", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

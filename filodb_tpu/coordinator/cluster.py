@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 
 from filodb_tpu.coordinator.migration import MigrationError, ShardMigration
@@ -37,8 +38,9 @@ from filodb_tpu.core.store.config import IngestionConfig
 from filodb_tpu.kafka.log import ReplayLog
 from filodb_tpu.kafka.log_server import LogOpError
 from filodb_tpu.query.exec.plan import ExecContext, PlanDispatcher
-from filodb_tpu.utils.metrics import get_counter
+from filodb_tpu.utils.metrics import GaugeFn, get_counter
 from filodb_tpu.utils.resilience import FaultInjector, breaker_for
+from filodb_tpu.utils.selfmon import STAMPS
 
 log = logging.getLogger(__name__)
 
@@ -107,9 +109,43 @@ class Node:
         worker = _IngestWorker(self, s, shard_log, start_offset, on_status)
         self._workers[key] = worker
         worker.start()
+        self._register_lag_gauges(dataset, shard, s, shard_log, worker)
         if self._flusher is None:
             self._flusher = _FlushScheduler(self, self.flush_tick_s)
             self._flusher.start()
+
+    def _register_lag_gauges(self, dataset: str, shard: int, s, shard_log,
+                             worker) -> None:
+        """Replay-log freshness gauges for one shard, scrape-time computed
+        and weakly bound — a stopped/migrated shard drops its series
+        instead of freezing at the last value. Registration is idempotent
+        (same name+tags overwrites in the registry), so a shard restart
+        simply rebinds the callbacks to the live objects."""
+        tags = {"dataset": dataset, "shard": str(shard)}
+        # pre-register the lazy error counter so the family scrapes at 0
+        # from boot (the parity gate checks it is in the expected lists)
+        get_counter("filodb_ingest_errors", tags)
+        log_ref = weakref.ref(shard_log)
+        worker_ref = weakref.ref(worker)
+        shard_ref = weakref.ref(s)
+
+        def offset_lag():
+            lg, w = log_ref(), worker_ref()
+            if lg is None or w is None:
+                return None
+            return float(lg.offset_lag(w.offset))
+
+        def checkpoint_lag():
+            lg, sh = log_ref(), shard_ref()
+            if lg is None or sh is None:
+                return None
+            return float(lg.offset_lag(min(sh.group_watermarks,
+                                           default=-1)))
+
+        GaugeFn("filodb_ingest_offset_lag", offset_lag, tags,
+                help="log records appended but not yet ingested")
+        GaugeFn("filodb_ingest_checkpoint_lag", checkpoint_lag, tags,
+                help="log records past the lowest group checkpoint")
 
     def _setup_streaming_downsample(self, dataset: str, shard: int,
                                     raw_shard, ds_cfg: dict) -> None:
@@ -402,6 +438,10 @@ class _IngestWorker(threading.Thread):
                 self.offset = sd.offset
                 progressed = True
                 server_errors = 0
+                # close the e2e freshness loop: any gateway stamps at or
+                # below this offset are now queryable in the shard
+                STAMPS.observe(self.shard.dataset, self.shard.shard_num,
+                               sd.offset)
             if transport_failed:
                 continue
             if not recovered:

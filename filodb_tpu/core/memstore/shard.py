@@ -35,6 +35,8 @@ from filodb_tpu.core.schemas import Schemas
 from filodb_tpu.core.store.api import ColumnStore, MetaStore, PartKeyRecord
 from filodb_tpu.core.store.config import StoreConfig
 from filodb_tpu.utils.metrics import Counter, Gauge, GaugeFn, Histogram
+from filodb_tpu.utils.resilience import FaultInjector
+from filodb_tpu.utils.tracing import traced_operation
 
 log = logging.getLogger(__name__)
 
@@ -141,6 +143,14 @@ class ShardStats:
         GaugeFn("num_ingesting_partitions",
                 fn(lambda s: sum(1 for p in s.partitions
                                  if p is not None and p.unflushed_count)),
+                self.tags)
+        # freshness: wall clock minus the shard's ingest high-water record
+        # timestamp. None (series dropped) until the first ingest — a huge
+        # bogus lag on an idle shard would page someone for nothing.
+        GaugeFn("filodb_ingest_lag_seconds",
+                fn(lambda s: None if s.max_ingested_ts < 0
+                   else max(0.0, _time.time()
+                            - s.max_ingested_ts / 1000.0)),
                 self.tags)
 
 
@@ -375,8 +385,13 @@ class TimeSeriesShard:
     # ---- ingest ----------------------------------------------------------
 
     def ingest(self, data: SomeData) -> int:
-        with self.stats.ingestion_pipeline_latency.time():
-            return self._ingest_timed(data)
+        # stall/error injection point for freshness-alert chaos tests
+        FaultInjector.fire("shard.ingest", dataset=self.dataset,
+                           shard=self.shard_num, offset=data.offset)
+        with traced_operation("ingest", dataset=self.dataset,
+                              shard=self.shard_num):
+            with self.stats.ingestion_pipeline_latency.time():
+                return self._ingest_timed(data)
 
     def _ingest_timed(self, data: SomeData) -> int:
         """Ingest one container at an offset. Returns rows ingested."""
@@ -521,7 +536,13 @@ class TimeSeriesShard:
 
     def flush_group(self, group: int, ingestion_time: int | None = None) -> int:
         """Flush all dirty partitions in a group (reference ``doFlushSteps``).
-        Returns number of chunks written."""
+        Returns number of chunks written. Slow flushes land in the
+        ingest-side flight recorder (``tracing.slow_ingest``)."""
+        with traced_operation("flush", dataset=self.dataset,
+                              shard=self.shard_num, group=group):
+            return self._flush_group(group, ingestion_time)
+
+    def _flush_group(self, group: int, ingestion_time: int | None) -> int:
         if ingestion_time is None:
             ingestion_time = int(_time.time() * 1000)
         written = 0

@@ -50,13 +50,15 @@ import json
 import queue
 import struct
 import threading
+import time
+import weakref
 
 from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.store.api import (ColumnStore, MetaStore, PartKeyRecord)
 from filodb_tpu.core.store.localstore import _pk_blob, _pk_from_blob
 from filodb_tpu.core.store.remotestore import split_of
 from filodb_tpu.memory.chunk import Chunk
-from filodb_tpu.utils.metrics import Counter, Gauge
+from filodb_tpu.utils.metrics import Counter, Gauge, GaugeFn
 from filodb_tpu.utils.resilience import FaultInjector, RetryPolicy
 from filodb_tpu.utils.tracing import span
 
@@ -124,6 +126,31 @@ RETRIES = Counter("filodb_objectstore_retries")
 COMPACTIONS = Counter("filodb_objectstore_compactions")
 CORRUPT = Counter("filodb_objectstore_corrupt")
 QUEUE_DEPTH = Gauge("filodb_objectstore_queue_depth")
+
+# live stores the oldest-task-age gauge aggregates over; weak so a closed
+# or collected store drops out without an unregister hook
+_INSTANCES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _oldest_task_age() -> float:
+    """Age of the oldest queued-or-in-flight write-behind task across live
+    stores. Depth alone hides a wedged uploader (depth 1 forever looks
+    healthy); age turns it into a ramp an alert can threshold."""
+    oldest = None
+    for store in list(_INSTANCES):
+        dq = store._inflight_ts
+        try:
+            t0 = dq[0]
+        except IndexError:
+            continue
+        if oldest is None or t0 < oldest:
+            oldest = t0
+    return 0.0 if oldest is None else max(0.0, time.time() - oldest)
+
+
+OLDEST_TASK_AGE = GaugeFn(
+    "filodb_objectstore_oldest_task_age_seconds", _oldest_task_age,
+    help="age of the oldest queued-or-in-flight write-behind task")
 
 # --------------------------------------------------------------------------
 # segment binary format
@@ -337,6 +364,10 @@ class ObjectStoreColumnStore(ColumnStore):
         # queued behind the failed task is parked so a checkpoint can
         # never overtake the data it covers; flush() raises for them
         self._failed: set[tuple[str, int]] = set()
+        # enqueue wall times of queued + in-flight tasks, FIFO-aligned with
+        # _queue (single consumer): front = oldest, feeds the age gauge
+        self._inflight_ts: collections.deque = collections.deque()
+        _INSTANCES.add(self)
         self._uploader = threading.Thread(target=self._upload_loop,
                                           name="objstore-uploader",
                                           daemon=True)
@@ -438,6 +469,7 @@ class ObjectStoreColumnStore(ColumnStore):
                     task = self._staged.popleft()
                 except IndexError:
                     return
+                self._inflight_ts.append(time.time())
                 self._queue.put(task)      # bounded: blocks = backpressure
                 QUEUE_DEPTH.set(self._queue.qsize())
 
@@ -469,8 +501,8 @@ class ObjectStoreColumnStore(ColumnStore):
                     continue
                 if kind == "segment":
                     seq, key, data = task[3], task[4], task[5]
-                    # slow uploads land in the flight recorder (same tail-
-                    # capture ring as slow queries)
+                    # slow uploads land in the ingest-side flight recorder
+                    # ring (tracing.slow_ingest), not the query ring
                     from filodb_tpu.utils.tracing import traced_operation
                     with traced_operation("objectstore", op="upload",
                                           shard=shard, nbytes=len(data)):
@@ -499,6 +531,13 @@ class ObjectStoreColumnStore(ColumnStore):
                 self._upload_errors.append(f"{task[0]}: {e!r}")
                 self._failed.add((task[1], task[2]))
             finally:
+                if task is not _STOP:
+                    # _STOP is enqueued directly (close() bypasses the
+                    # staging deque), so it carries no timestamp
+                    try:
+                        self._inflight_ts.popleft()
+                    except IndexError:
+                        pass
                 self._queue.task_done()
 
     def _uploader_put(self, key: str, data: bytes) -> None:

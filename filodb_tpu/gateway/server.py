@@ -21,6 +21,8 @@ from filodb_tpu.gateway.influx import InfluxParseError, parse_influx_line
 from filodb_tpu.kafka.log import ReplayLog
 from filodb_tpu.utils import governor as governor_mod
 from filodb_tpu.utils.metrics import Counter, GaugeFn, Histogram
+from filodb_tpu.utils.selfmon import STAMPS
+from filodb_tpu.utils.tracing import traced_operation
 
 log = logging.getLogger(__name__)
 
@@ -45,10 +47,11 @@ class ContainerSink:
 
     def __init__(self, logs: dict[int, ReplayLog], num_shards: int,
                  spread: int = 1, flush_every: int = 512,
-                 max_pending: int = 16384):
+                 max_pending: int = 16384, dataset: str = "prometheus"):
         self.logs = logs
         self.num_shards = num_shards
         self.spread = spread
+        self.dataset = dataset  # keys the sampled e2e freshness stamps
         self.flush_every = flush_every
         self.max_pending = max(max_pending, flush_every)
         self._pending = RecordContainer()
@@ -133,9 +136,15 @@ class ContainerSink:
         persistent connection could strand records indefinitely)."""
         while batch is not None:
             try:
-                for shard, cont in route_container(batch, self.num_shards,
-                                                   self.spread).items():
-                    self.logs[shard].append(cont)
+                # slow drains land in the ingest-side flight recorder;
+                # every Nth appended container is stamped so the shard
+                # workers can close the e2e freshness histogram
+                with traced_operation("gateway", op="drain",
+                                      records=len(batch)):
+                    for shard, cont in route_container(
+                            batch, self.num_shards, self.spread).items():
+                        off = self.logs[shard].append(cont)
+                        STAMPS.maybe_stamp(self.dataset, shard, off)
             finally:
                 with self._cond:
                     self._flushing = False

@@ -224,10 +224,137 @@ class HttpDispatcher:
                 alerts.extend(mgr.alerts_snapshot())
             return self._json(200, {"status": "success",
                                     "data": {"alerts": alerts}})
+        if parts == ["api", "v1", "status", "tsdb"]:
+            return self._status_tsdb(qs)
+        if parts == ["api", "v1", "status", "ingest"]:
+            return self._status_ingest(qs)
         return self._json(404, promjson.error_json("not found", "not_found"))
 
     def _rule_managers(self) -> dict:
         return getattr(self.app, "rule_managers", None) or {}
+
+    # -- status introspection --
+
+    def _status_datasets(self, qs: dict) -> dict:
+        """Services filtered by an optional ``?dataset=`` param."""
+        want = qs.get("dataset", [None])[0]
+        return {name: svc for name, svc in self.app.services.items()
+                if want is None or name == want}
+
+    def _status_tsdb(self, qs: dict):
+        """Prometheus-shaped TSDB status: per-shard head/memory stats plus
+        top-k series cardinality by metric name (from the shard-key
+        cardinality trees) and by label name (distinct values from the
+        part-key indexes)."""
+        try:
+            k = max(1, int(qs.get("topk", ["10"])[0]))
+        except ValueError:
+            k = 10
+        data = {}
+        for name, svc in self._status_datasets(qs).items():
+            by_metric: dict[str, dict] = {}
+            by_label: dict[str, int] = {}
+            shards = []
+            num_series = 0
+            for sh in svc.memstore.shards_for(name):
+                # the cardinality tree root counts every live series,
+                # including ones created inside the native ingest core
+                # that never touch the python key map
+                root = sh.cardinality.cardinality([])
+                num_series += root.active_ts
+                shards.append({
+                    "shard": sh.shard_num,
+                    "numSeries": root.active_ts,
+                    "totalSeries": root.total_ts,
+                    "indexRamBytes": sh.index.ram_bytes,
+                    "encodedBytes": sh.stats.encoded_bytes.value,
+                    "samplesEncoded": sh.stats.samples_encoded.value,
+                    "chunksFlushed": sh.stats.chunks_flushed.value,
+                    "partitionsEvicted":
+                        sh.stats.partitions_evicted.value,
+                })
+                tracker = sh.cardinality
+                # tree walk ws -> ns -> metric; aggregate metric counts
+                # across prefixes and shards, Prometheus-status style
+                for ws in tracker.top_k([], 1000):
+                    for ns in tracker.top_k([ws.name], 1000):
+                        for mc in tracker.top_k([ws.name, ns.name], 1000):
+                            agg = by_metric.setdefault(
+                                mc.name, {"active": 0, "total": 0})
+                            agg["active"] += mc.active_ts
+                            agg["total"] += mc.total_ts
+                for label in sh.label_names():
+                    by_label[label] = max(by_label.get(label, 0),
+                                          len(sh.label_values(label)))
+            top_metrics = sorted(by_metric.items(),
+                                 key=lambda kv: -kv[1]["active"])[:k]
+            top_labels = sorted(by_label.items(),
+                                key=lambda kv: -kv[1])[:k]
+            data[name] = {
+                "headStats": {"numSeries": num_series,
+                              "numShards": len(shards)},
+                "shards": shards,
+                "seriesCountByMetricName": [
+                    {"name": m, "value": v["active"],
+                     "totalValue": v["total"]} for m, v in top_metrics],
+                "labelValueCountByLabelName": [
+                    {"name": label, "value": v} for label, v in top_labels],
+            }
+        return self._json(200, {"status": "success", "data": data})
+
+    def _status_ingest(self, qs: dict):
+        """Per-shard ingest freshness: lag vs wall clock, replay-log
+        offsets, checkpoint watermarks, write-behind queue state, rules
+        watermark lag, and the ingest-side slow-operation ring."""
+        import time as _time
+        from filodb_tpu.core.store import objectstore as objstore
+        from filodb_tpu.utils import metrics as metrics_mod
+        from filodb_tpu.utils.tracing import slow_ingest
+        cluster = getattr(self.app, "cluster", None)
+        now = _time.time()
+        try:
+            limit = int(qs.get("limit", ["20"])[0])
+        except ValueError:
+            limit = 20
+        data = {"datasets": {}}
+        for name, svc in self._status_datasets(qs).items():
+            shards = []
+            for sh in svc.memstore.shards_for(name):
+                lag = (None if sh.max_ingested_ts < 0
+                       else max(0.0, now - sh.max_ingested_ts / 1000.0))
+                entry = {
+                    "shard": sh.shard_num,
+                    "maxIngestedTs": sh.max_ingested_ts,
+                    "ingestLagSeconds": lag,
+                    "ingestedOffset": sh.latest_offset,
+                    "groupWatermarks": list(sh.group_watermarks),
+                }
+                log_ = (cluster.logs.get((name, sh.shard_num))
+                        if cluster is not None else None)
+                if log_ is not None:
+                    entry["logLatestOffset"] = log_.latest_offset
+                    entry["offsetLag"] = log_.offset_lag(sh.latest_offset)
+                    entry["checkpointLag"] = log_.offset_lag(
+                        min(sh.group_watermarks, default=-1))
+                shards.append(entry)
+            data["datasets"][name] = {"shards": shards}
+        data["objectstore"] = {
+            "queueDepth": objstore.QUEUE_DEPTH.value,
+            "oldestTaskAgeSeconds": objstore._oldest_task_age(),
+        }
+        # gauges owned by objects this server can't reach (gateway sink,
+        # rule groups) are read back from the registry by family name
+        with metrics_mod._lock:
+            fams = list(metrics_mod._registry.values())
+        for m in fams:
+            if m.name == "gateway_queue_depth" and m.value is not None:
+                data["gatewayQueueDepth"] = m.value
+            elif m.name == "filodb_rules_watermark_lag_seconds" \
+                    and m.tags.get("group"):  # skip the untagged anchor
+                data.setdefault("rulesWatermarkLagSeconds", {})[
+                    m.tags["group"]] = m.value
+        data["slowIngest"] = slow_ingest(limit)
+        return self._json(200, {"status": "success", "data": data})
 
     # -- Prom API --
 

@@ -30,6 +30,13 @@ class ReplayLog:
     def latest_offset(self) -> int:
         raise NotImplementedError
 
+    def offset_lag(self, consumed: int) -> int:
+        """Records appended but not yet consumed past ``consumed`` (the
+        freshness gauge the coordinator exposes per shard). Clamped at 0:
+        a consumer ahead of a freshly-rolled log is caught up, not
+        negative."""
+        return max(0, self.latest_offset - consumed)
+
     def align_after(self, offset: int) -> None:
         """Ensure the next append is assigned an offset strictly greater
         than ``offset``. Recovery calls this with the max group checkpoint:

@@ -102,6 +102,8 @@ rules_samples_written = Counter("filodb_rules_samples_written")
 rules_eval_seconds = Histogram("filodb_rules_eval_seconds")
 rules_last_eval_ts = Gauge("filodb_rules_last_eval_ts")
 rules_unrecovered_groups = Gauge("filodb_rules_unrecovered_groups")
+# untagged family anchor — runtime series carry {group=...} tags
+rules_watermark_lag = Gauge("filodb_rules_watermark_lag_seconds")
 alerts_firing = Gauge("filodb_alerts_firing")
 alerts_pending = Gauge("filodb_alerts_pending")
 alerts_transitions = Counter("filodb_alerts_transitions")
@@ -230,6 +232,11 @@ class RuleManager:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         rules_groups.set(rules_groups.value + len(self.groups))
+        # pre-register watermark lag at 0 per group so the family scrapes
+        # from boot (the metrics-parity gate lists it)
+        for g in self.groups:
+            get_gauge("filodb_rules_watermark_lag_seconds",
+                      {"group": g.name}).set(0.0)
         # cache-consistency hook: clamp the result cache's immutability
         # horizon to what the rules have verifiably written (module doc)
         svc.rules_horizon_floor = self.horizon_floor
@@ -273,6 +280,11 @@ class RuleManager:
                                                   + 1) * g.interval_ms)
                 else:
                     floor = min(floor, st.visible_step)
+                    # how far the group's evaluation trails the ingest
+                    # clock — the per-group freshness gauge
+                    get_gauge("filodb_rules_watermark_lag_seconds",
+                              {"group": g.name}).set(
+                        max(0.0, (horizon - st.last_step) / 1000.0))
         self._floor = floor
         rules_unrecovered_groups.set(unrecovered)
 

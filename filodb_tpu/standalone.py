@@ -85,6 +85,24 @@ class FiloServer:
         self.http: FiloHttpServer | None = None
         self.gateway: GatewayServer | None = None
         self.executor: PlanExecutorServer | None = None
+        self.selfmon = None
+        self._setup_meta_dataset()
+
+    def _setup_meta_dataset(self) -> None:
+        """Register the ``_meta`` self-monitoring dataset when selfmon is
+        enabled. Appended AFTER the user datasets: the gateway and the
+        rules default-dataset both bind to the FIRST configured dataset,
+        and that must stay the user's."""
+        sm_cfg = self.config.selfmon or {}
+        if not sm_cfg.get("enabled") or "_meta" in self.config.datasets:
+            return
+        from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+        self.config.datasets["_meta"] = IngestionConfig(
+            dataset="_meta",
+            num_shards=int(sm_cfg.get("num_shards", 1)),
+            min_num_nodes=1,
+            store=StoreConfig(groups_per_shard=4))
+        self.config.spreads["_meta"] = 0
 
     def _wal_path(self, dataset: str, shard: int) -> str:
         root = self.config.wal_dir or os.path.join(self.config.data_dir,
@@ -133,6 +151,33 @@ class FiloServer:
                 max_attempts=int(notify_cfg.get("max_attempts", 4)),
                 base_backoff_s=0.1, max_backoff_s=2.0),
             queue_depth=int(notify_cfg.get("queue_depth", 256)))
+
+    @staticmethod
+    def _default_meta_alerts(sm_cfg: dict) -> dict:
+        """The shipped self-monitoring alert group, evaluated over
+        ``_meta`` like any user group: shard ingest lag and an open
+        circuit breaker — the two signals that mean "this node is no
+        longer keeping up / no longer talking to a peer"."""
+        thr = float(sm_cfg.get("lag_alert_threshold_s", 60.0))
+        return {
+            "name": "selfmon_default",
+            "dataset": "_meta",
+            "interval": sm_cfg.get("alert_interval", "5s"),
+            "rules": [
+                {"alert": "FilodbIngestLagHigh",
+                 "expr": f"max(filodb_ingest_lag_seconds) > {thr}",
+                 "for": sm_cfg.get("lag_alert_for", "30s"),
+                 "labels": {"severity": "warning"},
+                 "annotations": {"summary":
+                                 "shard ingest lag above threshold"}},
+                {"alert": "FilodbBreakerOpen",
+                 "expr": "max(filodb_breaker_state) >= 2",
+                 "for": "0s",
+                 "labels": {"severity": "warning"},
+                 "annotations": {"summary":
+                                 "a circuit breaker to a peer is open"}},
+            ],
+        }
 
     # -- control handlers (member side; reference NodeCoordinatorActor) --
 
@@ -358,8 +403,13 @@ class FiloServer:
             self.cluster.start_failure_detector()
             # standing queries: one RuleManager per dataset with groups,
             # writing outputs through the shard WAL (first-class series)
-            rules_cfg = cfg.rules or {}
-            if rules_cfg.get("groups"):
+            rules_cfg = dict(cfg.rules or {})
+            sm_cfg = cfg.selfmon or {}
+            groups_cfg = list(rules_cfg.get("groups") or [])
+            if sm_cfg.get("enabled") and sm_cfg.get("default_alerts", True):
+                groups_cfg.append(self._default_meta_alerts(sm_cfg))
+            rules_cfg["groups"] = groups_cfg
+            if groups_cfg:
                 first_ds = next(iter(cfg.datasets))
                 by_ds: dict[str, list] = {}
                 for grp in load_groups(rules_cfg, first_ds):
@@ -371,12 +421,35 @@ class FiloServer:
                         {s: self._shard_log(ds, s)
                          for s in range(ing.num_shards)},
                         ing.num_shards, cfg.spreads.get(ds, 1))
+                    # _meta carries only selfmon samples stamped at tick
+                    # time: the default 5-minute out-of-order allowance
+                    # would hold alert evaluation that far behind the
+                    # ingest clock for no reason
+                    ooo = (int(sm_cfg.get("ooo_allowance_ms", 2_000))
+                           if ds == "_meta" else None)
                     self.rule_managers[ds] = RuleManager(
                         services[ds], sink, grps,
+                        ooo_allowance_ms=ooo,
                         max_catchup_steps=int(
                             rules_cfg.get("max_catchup_steps", 512)),
                         notifier=self._build_notifier(notify_cfg),
                     ).start(float(rules_cfg.get("tick_s", 1.0)))
+            if sm_cfg.get("enabled"):
+                from filodb_tpu.rules.manager import LogSink as _MetaSink
+                from filodb_tpu.utils.selfmon import MetaMonitor
+                ing = cfg.datasets["_meta"]
+                meta_sink = _MetaSink(
+                    {s: self._shard_log("_meta", s)
+                     for s in range(ing.num_shards)},
+                    ing.num_shards, cfg.spreads.get("_meta", 0))
+                self.selfmon = MetaMonitor(
+                    meta_sink,
+                    interval_s=float(sm_cfg.get("interval_s", 15.0)),
+                    node=cfg.node_name,
+                    instance=f"{cfg.node_name}:{cfg.http_port}",
+                    include_buckets=bool(sm_cfg.get("include_buckets",
+                                                    False)))
+                self.selfmon.start()
         shard_maps = {
             name: (lambda n=name: self.shard_subscribers[n].mapper)
             for name in getattr(self, "shard_subscribers", {})
@@ -398,7 +471,8 @@ class FiloServer:
             sink = ContainerSink(
                 {s: self._shard_log(first.dataset, s)
                  for s in range(first.num_shards)},
-                first.num_shards, cfg.spreads.get(first.dataset, 1))
+                first.num_shards, cfg.spreads.get(first.dataset, 1),
+                dataset=first.dataset)
             self.gateway = GatewayServer(sink, port=cfg.gateway_port).start()
         # memory-pressure watchdog: write-buffer-pool occupancy and result-
         # cache bytes drive the governor's ok → degraded → critical states;
@@ -656,6 +730,8 @@ class FiloServer:
         self.is_coordinator = True
 
     def shutdown(self):
+        if self.selfmon is not None:
+            self.selfmon.stop()  # before the WALs close under its sink
         for mgr in getattr(self, "rule_managers", {}).values():
             mgr.stop()
         if getattr(self, "watchdog", None) is not None:

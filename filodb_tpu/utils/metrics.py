@@ -12,12 +12,19 @@ across gather workers, the write-behind uploader, and rules threads.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict
 
+log = logging.getLogger("filodb.metrics")
+
 _registry: dict[str, "Metric"] = {}
 _lock = threading.Lock()
+
+# GaugeFn callbacks whose first failure has already been logged (keyed by
+# metric key) — one log line per broken callback, not one per scrape
+_scrape_error_logged: set[str] = set()
 
 
 class Metric:
@@ -76,6 +83,15 @@ class GaugeFn(Metric):
             v = self.fn()
             return None if v is None else float(v)
         except Exception:
+            SCRAPE_ERRORS.inc()
+            key = self._key()
+            with _lock:
+                first = key not in _scrape_error_logged
+                if first:
+                    _scrape_error_logged.add(key)
+            if first:
+                log.warning("metric scrape callback failed: %s", key,
+                            exc_info=True)
             return float("nan")
 
 
@@ -118,6 +134,11 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0)
 
 
+# broken scrape callbacks are counted, not silently masked as nan: a
+# dashboard watching this family catches a dead gauge the first scrape
+SCRAPE_ERRORS = Counter("filodb_metric_scrape_errors")
+
+
 def get_counter(name: str, tags: dict[str, str] | None = None,
                 help: str | None = None) -> Counter:
     """Idempotent counter lookup: error-path call sites (flush loops,
@@ -147,6 +168,14 @@ def get_gauge(name: str, tags: dict[str, str] | None = None,
     return Gauge(name, tags, help)
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-exposition label-value escaping: a backslash,
+    double quote, or newline in a tag value would otherwise corrupt the
+    whole scrape body."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def render_prometheus() -> str:
     """Expose all metrics in Prometheus text format, series grouped per
     family under ``# HELP``/``# TYPE`` headers (the help string defaults to
@@ -170,7 +199,8 @@ def render_prometheus() -> str:
         lines.append(f"# HELP {fam} {help_text}")
         lines.append(f"# TYPE {fam} {typ}")
         for m in members:
-            tagstr = ",".join(f'{k}="{v}"' for k, v in sorted(m.tags.items()))
+            tagstr = ",".join(f'{k}="{escape_label_value(v)}"'
+                              for k, v in sorted(m.tags.items()))
             tagstr = f"{{{tagstr}}}" if tagstr else ""
             if isinstance(m, Counter):
                 lines.append(f"{m.name}_total{tagstr} {m.value}")

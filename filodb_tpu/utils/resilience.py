@@ -409,6 +409,8 @@ class FaultInjector:
     - ``promql.remote``     (ctx: endpoint)    — cross-cluster HTTP exec
     - ``store.call``        (ctx: host, port, op) — remote column store
     - ``node.dispatch``     (ctx: node)        — in-cluster node dispatch
+    - ``shard.ingest``      (ctx: dataset, shard, offset) — per-container
+      shard ingest (stall/error injection for freshness-alert tests)
     - ``objectstore.put``   (ctx: key)         — object-store segment upload
     - ``migration.*``       (ctx: dataset, shard, source, dest, phase) —
       live-migration kill-points, one per state transition

@@ -56,6 +56,8 @@ class TracingConfig:
     sample_rate: float = 0.0            # head-sampling fraction [0, 1]
     slow_query_threshold_ms: float = 500.0  # tail capture; 0 disables
     slowlog_capacity: int = 128         # flight-recorder ring size
+    slow_ingest_threshold_ms: float = 250.0  # ingest-ring capture; 0 off
+    ingest_slowlog_capacity: int = 128  # ingest flight-recorder ring size
 
 
 _config = TracingConfig()
@@ -66,6 +68,7 @@ def configure(**overrides) -> TracingConfig:
     global _config
     _config = TracingConfig(**overrides)
     _recorder.resize(_config.slowlog_capacity)
+    _ingest_recorder.resize(_config.ingest_slowlog_capacity)
     return _config
 
 
@@ -255,6 +258,7 @@ del _s
 
 _sampled = get_counter("filodb_queries_sampled")
 _recorded = get_counter("filodb_slow_queries_recorded")
+_ingest_recorded = get_counter("filodb_ingest_slow_recorded")
 
 
 def observe_stage_times(spans: list[Span]) -> None:
@@ -300,14 +304,33 @@ class FlightRecorder:
 
 _recorder = FlightRecorder()
 
+# Separate ring for the ingest pipeline (gateway drain, shard ingest,
+# flush, object-store upload): ingest stalls must stay visible even while
+# a slow-query storm is churning the query ring, and vice versa.
+_ingest_recorder = FlightRecorder()
+
+# traced_operation kinds that belong to the ingest pipeline and therefore
+# record into the ingest ring under slow_ingest_threshold_ms
+_INGEST_KINDS = frozenset({"gateway", "ingest", "flush", "objectstore"})
+
 
 def flight_recorder() -> FlightRecorder:
     return _recorder
 
 
+def ingest_recorder() -> FlightRecorder:
+    return _ingest_recorder
+
+
 def slow_queries(limit: int = 0) -> list[dict]:
     """Flight-recorder entries, newest first."""
     entries = list(reversed(_recorder.snapshot()))
+    return entries[:limit] if limit and limit > 0 else entries
+
+
+def slow_ingest(limit: int = 0) -> list[dict]:
+    """Ingest flight-recorder entries, newest first."""
+    entries = list(reversed(_ingest_recorder.snapshot()))
     return entries[:limit] if limit and limit > 0 else entries
 
 
@@ -415,10 +438,13 @@ def record_slow(kind: str, duration_ms: float, spans: list | None = None,
 
 @contextmanager
 def traced_operation(kind: str, **tags):
-    """Trace a background operation (rules tick, objectstore upload,
-    migration phase). Operations are low-frequency, so they always trace;
-    any run over ``slow_query_threshold_ms`` lands in the flight recorder
-    alongside slow queries — one debug endpoint for every slow path."""
+    """Trace a background operation (rules tick, gateway drain, shard
+    ingest, flush, objectstore upload, migration phase). Operations are
+    low-frequency, so they always trace. Slow runs land in a flight
+    recorder: ingest-pipeline kinds (``_INGEST_KINDS``) over
+    ``slow_ingest_threshold_ms`` go to the ingest ring, everything else
+    over ``slow_query_threshold_ms`` to the query ring — so an ingest
+    stall stays visible through a slow-query storm and vice versa."""
     if getattr(_local, "trace", None) is not None:
         with span(kind, **tags) as s:
             yield s
@@ -429,11 +455,17 @@ def traced_operation(kind: str, **tags):
             yield s
     duration_ms = (time.perf_counter() - t0) * 1000
     cfg = _config
-    if cfg.slow_query_threshold_ms > 0 \
-            and duration_ms > cfg.slow_query_threshold_ms:
+    if kind in _INGEST_KINDS:
+        recorder, threshold, counter = (
+            _ingest_recorder, cfg.slow_ingest_threshold_ms,
+            _ingest_recorded)
+    else:
+        recorder, threshold, counter = (
+            _recorder, cfg.slow_query_threshold_ms, _recorded)
+    if threshold > 0 and duration_ms > threshold:
         entry = {"kind": kind, "when": time.time(),
                  "duration_ms": round(duration_ms, 3), "sampled": True}
         entry.update(tags)
         entry["spans"] = trace.as_dicts()
-        _recorder.record(entry)
-        _recorded.inc()
+        recorder.record(entry)
+        counter.inc()

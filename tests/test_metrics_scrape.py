@@ -159,6 +159,7 @@ TENANT_NAMES = [
 # whether or not) any rule group is configured
 RULES_NAMES = [
     "filodb_rules_groups",
+    "filodb_rules_watermark_lag_seconds",
     "filodb_rules_evals_total",
     "filodb_rules_eval_failures_total",
     "filodb_rules_evals_shed_total",
@@ -207,6 +208,32 @@ OBJECTSTORE_NAMES = [
     "filodb_objectstore_compactions_total",
     "filodb_objectstore_corrupt_total",
     "filodb_objectstore_queue_depth",
+]
+
+
+# ingest-path freshness + self-monitoring (utils/selfmon.py,
+# utils/tracing.py, coordinator/cluster.py, core/memstore/shard.py) —
+# kept in step with the source tree by the filolint PR206 rule, which
+# (unlike PR203) exempts nothing: lag GaugeFns register at shard start
+# and the fixture boots shards + drives ingest, so all families render
+INGEST_OBS_NAMES = [
+    "filodb_metric_scrape_errors_total",
+    "filodb_ingest_slow_recorded_total",
+    "filodb_ingest_lag_seconds",
+    "filodb_ingest_offset_lag",
+    "filodb_ingest_checkpoint_lag",
+    "filodb_ingest_errors_total",
+    "filodb_ingest_e2e_seconds_bucket",
+    "filodb_ingest_e2e_seconds_count",
+    "filodb_ingest_e2e_seconds_sum",
+    "filodb_selfmon_ticks_total",
+    "filodb_selfmon_errors_total",
+    "filodb_selfmon_samples_total",
+    "filodb_selfmon_series",
+    "filodb_selfmon_tick_seconds_bucket",
+    "filodb_selfmon_tick_seconds_count",
+    "filodb_selfmon_tick_seconds_sum",
+    "filodb_objectstore_oldest_task_age_seconds",
 ]
 
 
@@ -326,6 +353,13 @@ class TestMetricsScrape:
         # import time (stage labels are a bounded whitelist)
         missing_tr = [n for n in TRACING_NAMES if n not in names_present]
         assert not missing_tr, f"missing tracing metrics: {missing_tr}"
+
+        # ingest-path freshness + selfmon families: the import-time ones
+        # render unconditionally; the per-shard lag gauges register at
+        # shard start and the lag-seconds GaugeFn emits once the ingest
+        # above has landed
+        missing_io = [n for n in INGEST_OBS_NAMES if n not in names_present]
+        assert not missing_io, f"missing ingest-obs metrics: {missing_io}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])
