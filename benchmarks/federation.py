@@ -1,0 +1,118 @@
+"""Tiered federation benchmark: one query_range spanning memstore, the
+downsample tier, and object-store history — cold (first touch pages cold
+chunks over the object store) vs warm (ODP cache + settled-extent result
+cache), with bytes-downloaded accounting per run.
+
+The headline numbers the tentpole is judged on: warm must be >=3x faster
+than cold and move strictly fewer object-store bytes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+START = 1_600_000_000
+RES = 300_000
+
+
+def _percentile(xs, p):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), p))
+
+
+def bench_federation(n_warm: int = 30):
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.coordinator.tiered_planner import build_tiered_planner
+    from filodb_tpu.core.downsample import (
+        DownsampledTimeSeriesStore,
+        DownsamplerJob,
+    )
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.api import InMemoryMetaStore
+    from filodb_tpu.core.store.objectstore import (
+        BYTES_DOWN,
+        ObjectStoreColumnStore,
+    )
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import counter_series, counter_stream
+    from filodb_tpu.testing.fake_s3 import FakeS3
+
+    num_shards = 2
+    s3 = FakeS3()
+    cs = ObjectStoreColumnStore(s3)
+    ms = TimeSeriesMemStore(cs, InMemoryMetaStore())
+    for s in range(num_shards):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=120,
+                                              groups_per_shard=2))
+    keys = counter_series(16)
+    ingest_routed(ms, "timeseries",
+                  counter_stream(keys, 600, start_ms=START * 1000, seed=11),
+                  num_shards, spread=0)
+    ms.flush_all("timeseries")
+    cs.flush()
+    DownsamplerJob(cs, "timeseries", num_shards,
+                   resolutions_ms=(RES,)).run(0, 2**62)
+    ds_store = DownsampledTimeSeriesStore(cs, "timeseries", RES, num_shards)
+
+    now = (START + 6000) * 1000
+    raw_planner = SingleClusterPlanner("timeseries", num_shards, spread=0)
+    ds_planner = SingleClusterPlanner("timeseries", num_shards, spread=0,
+                                      store=ds_store)
+    planner = build_tiered_planner(
+        raw_planner, cs, "timeseries", num_shards,
+        mem_retention_ms=now - (START + 4000) * 1000,
+        raw_retention_ms=now - (START + 2000) * 1000,
+        ds_planner=ds_planner, now_ms=lambda: now)
+    q = ("sum(rate(http_requests_total[15m]))",
+         START + 1200, 300, START + 5400)
+
+    # compile the per-tier and per-extent kernel shapes once through a
+    # throwaway caching service, then drop every federation cache: "cold"
+    # measures tier paging + stitch, not one-time jit compilation
+    pre = QueryService(ms, "timeseries", num_shards, spread=0,
+                       result_cache={"enabled": True})
+    pre.planner = planner
+    pre.query_range(*q)
+    planner.cold_planner.store.clear_caches()
+
+    svc = QueryService(ms, "timeseries", num_shards, spread=0,
+                       result_cache={"enabled": True})
+    svc.planner = planner
+
+    # cold: empty ODP cache, empty result cache — pages every cold chunk
+    b0, g0 = BYTES_DOWN.value, s3.op_counts.get("get", 0)
+    t0 = time.perf_counter()
+    svc.query_range(*q)
+    cold_ms = (time.perf_counter() - t0) * 1000.0
+    cold_bytes = BYTES_DOWN.value - b0
+    cold_gets = s3.op_counts.get("get", 0) - g0
+
+    # warm: settled extents in the result cache, chunks in the ODP cache
+    b1, g1 = BYTES_DOWN.value, s3.op_counts.get("get", 0)
+    lat = []
+    for _ in range(n_warm):
+        t0 = time.perf_counter()
+        svc.query_range(*q)
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    warm_bytes = (BYTES_DOWN.value - b1) / n_warm
+    warm_gets = (s3.op_counts.get("get", 0) - g1) / n_warm
+    warm_p50, warm_p99 = _percentile(lat, 50), _percentile(lat, 99)
+
+    return {"metric": "federation_cold_vs_warm",
+            "cold_ms": round(cold_ms, 2),
+            "warm_p50_ms": round(warm_p50, 3),
+            "warm_p99_ms": round(warm_p99, 3),
+            "speedup_p50": round(cold_ms / warm_p50, 1),
+            "cold_objectstore_bytes": int(cold_bytes),
+            "warm_objectstore_bytes_per_query": round(warm_bytes, 1),
+            "cold_gets": int(cold_gets),
+            "warm_gets_per_query": round(warm_gets, 2),
+            "tiers": 3, "unit": "ms"}
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(bench_federation()))

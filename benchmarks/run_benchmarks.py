@@ -492,6 +492,12 @@ def _bench_selfmon_overhead():
     return bench_selfmon_overhead()
 
 
+def _bench_federation():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from federation import bench_federation
+    return bench_federation()
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -512,6 +518,7 @@ ALL = {
     "rules": _bench_rules,
     "tracing_overhead": _bench_tracing_overhead,
     "selfmon_overhead": _bench_selfmon_overhead,
+    "federation": _bench_federation,
 }
 
 

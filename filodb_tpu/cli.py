@@ -97,6 +97,36 @@ def cmd_status(args):
         print(f"  {m['name']:<40} {m['value']:>8}")
 
 
+def cmd_tiers(args):
+    """Retention-tier map for a dataset (``/api/v1/status/tiers``): which
+    tiers answer queries (memstore / downsample / objectstore), their time
+    floors, and per-tier series/bytes."""
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{args.host}/api/v1/status/tiers"
+            f"?dataset={args.dataset}") as r:
+        d = json.load(r)["data"]
+    doc = d.get(args.dataset)
+    if doc is None:
+        print(f"unknown dataset {args.dataset}")
+        return 1
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return 0
+    print(f"dataset={args.dataset} federated={doc['federated']}")
+    for k in ("memFloorMs", "rawFloorMs"):
+        if doc.get(k) is not None:
+            print(f"{k}: {doc[k]}")
+    print(f"\n{'TIER':<12} {'SERIES':>9} {'BYTES':>12} {'DETAIL'}")
+    for t in doc["tiers"]:
+        extra = " ".join(
+            f"{k}={t[k]}" for k in ("segments", "resolutionMs")
+            if t.get(k) is not None)
+        print(f"{t['tier']:<12} {str(t.get('series', '-')):>9} "
+              f"{str(t.get('bytes', '-')):>12} {extra}")
+    return 0
+
+
 def cmd_lag(args):
     """Ingest freshness one-pager: per-shard lag vs wall clock, replay-log
     offset/checkpoint lag, write-behind queue state, and rules watermark
@@ -494,6 +524,9 @@ def main(argv=None):
     p = sub.add_parser("lag")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
+    p = sub.add_parser("tiers")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
     sub.add_parser("shardmap")
     sub.add_parser("rules")
     p = sub.add_parser("slowlog")
@@ -530,7 +563,7 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
-            "lag": cmd_lag,
+            "lag": cmd_lag, "tiers": cmd_tiers,
             "shardmap": cmd_shardmap, "rules": cmd_rules,
             "slowlog": cmd_slowlog,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,

@@ -141,6 +141,19 @@ DEFAULTS = {
             "queue_depth": 256,       # pending batches before dropping
         },
     },
+    # tiered query federation (query/federation.py + coordinator/
+    # tiered_planner.py): one query_range transparently spans the raw
+    # memstore, the downsample tier and object-store history. Sub-ranges
+    # older than memstore retention page chunks from the column store via
+    # per-shard ODP caches and are stitched with the hot result.
+    "federation": {
+        "enabled": True,
+        # memstore data floor; None = derive from the dataset's
+        # store.retention_ms at boot
+        "mem_retention_ms": None,
+        "odp_max_chunks": 10_000,     # per cold shard ODP cache capacity
+        "refresh_s": 60.0,            # cold part-key index staleness bound
+    },
     # durable-store backend selection. "local" = sqlite-per-shard on
     # data_dir (default); "object" = S3-compatible object-store tier
     # (core/store/objectstore.py): write-behind segment upload, CRC32C
@@ -215,6 +228,7 @@ class ServerConfig:
     rules: dict = field(default_factory=dict)  # standing-query rule groups
     tracing: dict = field(default_factory=dict)  # TracingConfig overrides
     selfmon: dict = field(default_factory=dict)  # _meta self-monitoring
+    federation: dict = field(default_factory=dict)  # tiered-query routing
 
     @staticmethod
     def load(path: str | None = None) -> "ServerConfig":
@@ -263,7 +277,8 @@ class ServerConfig:
             migration=cfg.get("migration", {}),
             rules=cfg.get("rules", {}),
             tracing=cfg.get("tracing", {}),
-            selfmon=cfg.get("selfmon", {}))
+            selfmon=cfg.get("selfmon", {}),
+            federation=cfg.get("federation", {}))
 
 
 def _deep_merge(base: dict, over: dict) -> None:

@@ -667,12 +667,17 @@ class FilodbCluster:
 
     def query_service(self, dataset: str, spread: int = 0,
                       engine: str = "exec",
-                      result_cache=None) -> QueryService:
+                      result_cache=None,
+                      federation=None) -> QueryService:
         """Planner whose leaves dispatch to the shard-owning nodes.
         ``engine="mesh"`` additionally lowers supported aggregations onto
         the device mesh when all shards are local (single-node).
         ``result_cache`` is a ``result_cache`` config block (dict) enabling
-        the extent result cache; it self-bypasses when shards are remote."""
+        the extent result cache; it self-bypasses when shards are remote.
+        ``federation`` is a federation config block (dict with at least
+        ``mem_retention_ms``): ranges older than memstore retention are
+        routed to the shared column store through a cold-tier planner and
+        stitched with the hot result (see query/federation.py)."""
         sm = self.shard_managers[dataset]
         cluster = self
 
@@ -700,6 +705,18 @@ class FilodbCluster:
         svc.planner = SingleClusterPlanner(
             dataset, self.configs[dataset].num_shards, spread,
             dispatcher_for_shard=dispatcher_for_shard)
+        if federation and federation.get("enabled", True) \
+                and federation.get("mem_retention_ms"):
+            from filodb_tpu.coordinator.tiered_planner import (
+                build_tiered_planner)
+            svc.planner = build_tiered_planner(
+                svc.planner, self._migration_store(), dataset,
+                self.configs[dataset].num_shards, spread,
+                mem_retention_ms=int(federation["mem_retention_ms"]),
+                raw_retention_ms=federation.get("raw_retention_ms"),
+                odp_max_chunks=int(federation.get("odp_max_chunks",
+                                                  10_000)),
+                refresh_s=float(federation.get("refresh_s", 60.0)))
         svc.shard_status_fn = lambda: [
             (s, sm.mapper.statuses[s].name.lower())
             for s in range(sm.num_shards)

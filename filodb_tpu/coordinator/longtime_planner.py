@@ -95,6 +95,23 @@ class LongTimeRangePlanner(QueryPlanner):
     raw_retention_ms: int
     now_ms: "callable" = lambda: int(time.time() * 1000)
 
+    def mem_only(self, plan: lp.LogicalPlan) -> bool:
+        """True when the whole range (incl. lookback) is served from raw
+        memstore data — the mesh engine may bypass tier routing only then."""
+        times = _plan_times(plan)
+        if times is None:
+            return True
+        start, _step, _end, lookback = times
+        return start - lookback >= self.now_ms() - self.raw_retention_ms
+
+    def cost_hint(self, plan: lp.LogicalPlan):
+        """Governor cost class: touching the downsample tier pages chunks
+        from the column store, so class it EXPENSIVE regardless of shape."""
+        if self.mem_only(plan):
+            return None
+        from filodb_tpu.utils.governor import EXPENSIVE
+        return EXPENSIVE
+
     def materialize(self, plan: lp.LogicalPlan,
                     qcontext: QueryContext | None = None) -> ExecPlan:
         qcontext = qcontext or QueryContext()

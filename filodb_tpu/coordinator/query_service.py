@@ -232,7 +232,14 @@ class QueryService:
         from filodb_tpu.query.model import QueryStats
         stats_list = {i: QueryStats() for i in pending}
         mesh_results = {i: None for i in pending}
-        if pending and self.mesh_engine is not None and self._mesh_eligible():
+        # The mesh executes against the raw memstore only; a federated
+        # planner may route part of a straddling range to colder tiers, so
+        # only plans the planner proves memstore-resident may take the
+        # mesh shortcut — the rest fall to the exec path (tier routing).
+        meshable = [i for i in pending
+                    if self._planner_mem_only(plans[i])]
+        if meshable and self.mesh_engine is not None \
+                and self._mesh_eligible():
             # one device program per shared plan signature (micro-batched
             # step grids); unsupported plans fall through to the exec path.
             # The whole batch takes ONE admission slot: it runs as one
@@ -240,13 +247,13 @@ class QueryService:
             try:
                 with governor().admit(cost=EXPENSIVE):
                     mr = self.mesh_engine.execute_many(
-                        [plans[i] for i in pending], self.memstore,
-                        self.dataset, [stats_list[i] for i in pending])
+                        [plans[i] for i in meshable], self.memstore,
+                        self.dataset, [stats_list[i] for i in meshable])
             except Exception as e:  # noqa: BLE001
                 if not return_errors:
                     raise
-                mr = [None] * len(pending)  # per-item exec fallback below
-            for j, i in enumerate(pending):
+                mr = [None] * len(meshable)  # per-item exec fallback below
+            for j, i in enumerate(meshable):
                 mesh_results[i] = mr[j]
 
         deferred = set()
@@ -391,13 +398,22 @@ class QueryService:
         # wait bounded by the deadline, then shed with QueryRejected (503).
         # Standing-query evaluations (QueryContext.origin == "rules")
         # admit as their own lowest-priority class.
-        cost = RULES if qcontext.origin == "rules" \
-            else _admission_cost(plan)
+        # tiered planners (longtime/tiered_planner) can force a cost
+        # class: any query touching a cold tier is EXPENSIVE no matter
+        # its shape — paging object-store segments sheds before CHEAP
+        # memstore traffic when the governor is CRITICAL
+        if qcontext.origin == "rules":
+            cost = RULES
+        else:
+            hint = getattr(self.planner, "cost_hint", None)
+            cost = (hint(plan) if hint is not None else None) \
+                or _admission_cost(plan)
         t_admit = time.perf_counter()
         with governor().admit(deadline=deadline, cost=cost,
                               tenant=plan_tenant(plan)):
             admission_wait_s = time.perf_counter() - t_admit
             if self.mesh_engine is not None and self._mesh_eligible() \
+                    and self._planner_mem_only(plan) \
                     and self.mesh_engine.supports(plan):
                 from filodb_tpu.query.model import QueryStats
                 from filodb_tpu.utils.tracing import span
@@ -474,6 +490,14 @@ class QueryService:
             if w not in result.warnings:
                 result.warnings.append(w)
         return result
+
+    def _planner_mem_only(self, plan) -> bool:
+        """True when the planner certifies the plan reads only memstore-
+        resident data (incl. lookback). Planners without tiering (plain
+        SingleClusterPlanner) have no ``mem_only`` and are all-raw by
+        construction."""
+        f = getattr(self.planner, "mem_only", None)
+        return True if f is None else bool(f(plan))
 
     def _mesh_eligible(self) -> bool:
         """The mesh fans ALL series into one device program, so every shard

@@ -1,0 +1,187 @@
+"""TieredPlanner: route one query_range across memstore, object-store
+history, and the downsample tier.
+
+Generalizes :class:`LongTimeRangePlanner` (raw vs downsample, two tiers)
+to the three-tier retention layout (ROADMAP open item 3):
+
+- ``memstore``   — raw data resident in memory, newest.
+- ``objectstore``— raw data older than memory retention but inside raw
+  retention: served by a :class:`ColdTierStore` facade whose chunks page
+  in through ranged GETs into the ODP cache.
+- ``downsample`` — rollups older than raw retention, with the
+  ``LongTimeRangePlanner`` column rewrites reused verbatim.
+
+Each tier's sub-plan is wrapped in a :class:`TierExec` (per-tier
+QueryStats attribution) and the parts are stitched with
+``StitchRvsExec`` — the same seam semantics as the two-tier planner:
+``route_tiers`` assigns every step to exactly one tier and satisfies
+lookback windows across seams, so nothing is double-counted or dropped.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from filodb_tpu.coordinator.longtime_planner import (
+    _plan_times,
+    rewrite_for_downsample,
+)
+from filodb_tpu.coordinator.planner import (
+    QueryPlanner,
+    SingleClusterPlanner,
+    _retime,
+)
+from filodb_tpu.query import logical as lp
+from filodb_tpu.query.exec.plan import ExecPlan, StitchRvsExec
+from filodb_tpu.query.federation import (
+    DOWNSAMPLE,
+    MEMSTORE,
+    OBJECTSTORE,
+    ColdTierStore,
+    TierExec,
+    fed_queries,
+    route_tiers,
+)
+from filodb_tpu.query.model import QueryContext
+from filodb_tpu.utils.governor import EXPENSIVE
+
+
+@dataclass
+class TieredPlanner(QueryPlanner):
+    """Retention-tier router; drop-in for ``LongTimeRangePlanner``."""
+
+    raw_planner: SingleClusterPlanner
+    cold_planner: SingleClusterPlanner
+    ds_planner: "SingleClusterPlanner | None" = None
+    # data floors, as retention relative to now_ms(): memory keeps
+    # mem_retention_ms of raw data; the durable store keeps
+    # raw_retention_ms of raw data (older exists only downsampled).
+    mem_retention_ms: int = 0
+    raw_retention_ms: "int | None" = None
+    now_ms: "callable" = field(
+        default=lambda: int(time.time() * 1000))
+
+    def _floors(self) -> tuple[int, "int | None"]:
+        now = self.now_ms()
+        raw_floor = None if self.raw_retention_ms is None \
+            or self.ds_planner is None else now - self.raw_retention_ms
+        return now - self.mem_retention_ms, raw_floor
+
+    # -- admission hooks (coordinator/query_service.py) -------------------
+
+    def mem_only(self, plan: lp.LogicalPlan) -> bool:
+        """True when the memstore tier alone serves the whole plan —
+        the mesh engine may bypass tier routing only then."""
+        times = _plan_times(plan)
+        if times is None:
+            return True
+        start, _, _, lookback = times
+        mem_floor, _ = self._floors()
+        return start - lookback >= mem_floor
+
+    def cost_hint(self, plan: lp.LogicalPlan) -> "str | None":
+        """Cold-tier sub-queries are EXPENSIVE for the governor no
+        matter their shape: even an instant query that pages object
+        store segments sheds before CHEAP memstore traffic."""
+        return None if self.mem_only(plan) else EXPENSIVE
+
+    def version_token(self) -> int:
+        """Cache-key token folded into the result cache's plan
+        signature: bumps when the cold/ds part-key indexes grow, so
+        settled extents don't outlive tier membership changes."""
+        tok = 0
+        for planner in (self.cold_planner, self.ds_planner):
+            store = getattr(planner, "store", None)
+            if store is None:
+                continue
+            for s in store.shards_for(store.dataset):
+                tok += s.data_version
+        return tok
+
+    # -- status introspection ---------------------------------------------
+
+    def tier_detail(self) -> dict:
+        mem_floor, raw_floor = self._floors()
+        tiers = []
+        cold_store = getattr(self.cold_planner, "store", None)
+        if isinstance(cold_store, ColdTierStore):
+            tiers.append({"tier": OBJECTSTORE, "floorMs": raw_floor,
+                          "ceilMs": mem_floor, **cold_store.tier_stats()})
+        ds_store = getattr(self.ds_planner, "store", None) \
+            if self.ds_planner is not None else None
+        if ds_store is not None:
+            shards = ds_store.shards_for(ds_store.dataset)
+            for s in shards:  # index bootstraps lazily; a status probe
+                if not getattr(s, "_refreshed", True):  # should see data
+                    s.refresh_index()
+            series = sum(getattr(s, "num_partitions", 0) for s in shards)
+            entry = {"tier": DOWNSAMPLE, "series": series, "bytes": None,
+                     "floorMs": None, "ceilMs": raw_floor,
+                     "resolutionMs": getattr(ds_store, "resolution_ms",
+                                             None)}
+            stats_fn = getattr(ds_store.column_store, "dataset_stats", None)
+            if stats_fn is not None:
+                entry["bytes"] = stats_fn(
+                    getattr(ds_store, "ds_dataset",
+                            ds_store.dataset)).get("bytes")
+            tiers.append(entry)
+        return {"memFloorMs": mem_floor, "rawFloorMs": raw_floor,
+                "tiers": tiers}
+
+    # -- materialization --------------------------------------------------
+
+    def materialize(self, plan: lp.LogicalPlan,
+                    qcontext: QueryContext | None = None) -> ExecPlan:
+        qcontext = qcontext or QueryContext()
+        times = _plan_times(plan)
+        if times is None:  # metadata plans: fan out over the raw tier
+            return self.raw_planner.materialize(plan, qcontext)
+        start, step, end, lookback = times
+        mem_floor, raw_floor = self._floors()
+        ranges = route_tiers(start, step, end, lookback, mem_floor,
+                             raw_floor)
+        if len(ranges) == 1 and ranges[0].tier == MEMSTORE:
+            # hot path untouched: no retime, no TierExec indirection
+            return self.raw_planner.materialize(plan, qcontext)
+        fed_queries.inc()
+        parts: list[ExecPlan] = []
+        for r in ranges:
+            sub = plan if (r.start == start and r.end == end) \
+                else _retime(plan, r.start, step, r.end)
+            if r.tier == MEMSTORE:
+                ep = self.raw_planner.materialize(sub, qcontext)
+            elif r.tier == OBJECTSTORE:
+                ep = self.cold_planner.materialize(sub, qcontext)
+            else:
+                ep = self.ds_planner.materialize(
+                    rewrite_for_downsample(sub), qcontext)
+            parts.append(TierExec(tier=r.tier, children_plans=[ep]))
+        if len(parts) == 1:
+            return parts[0]
+        return StitchRvsExec(children_plans=parts)
+
+
+def build_tiered_planner(raw_planner: SingleClusterPlanner,
+                         column_store, dataset: str, num_shards: int,
+                         spread: int = 0, *,
+                         mem_retention_ms: int,
+                         raw_retention_ms: "int | None" = None,
+                         ds_planner: "SingleClusterPlanner | None" = None,
+                         odp_max_chunks: int = 10_000,
+                         refresh_s: float = 60.0,
+                         schemas=None,
+                         now_ms=None) -> TieredPlanner:
+    """Wire the cold (object-store history) tier and return the planner.
+    ``ds_planner`` is the downsample tier from the existing wiring; pass
+    None for a two-tier memstore/objectstore layout."""
+    cold_store = ColdTierStore(column_store, dataset, num_shards,
+                               schemas=schemas,
+                               odp_max_chunks=odp_max_chunks,
+                               refresh_s=refresh_s)
+    cold_planner = SingleClusterPlanner(dataset, num_shards, spread,
+                                        store=cold_store)
+    kw = {} if now_ms is None else {"now_ms": now_ms}
+    return TieredPlanner(raw_planner, cold_planner, ds_planner,
+                         mem_retention_ms=mem_retention_ms,
+                         raw_retention_ms=raw_retention_ms, **kw)

@@ -32,11 +32,15 @@ class PagedReadablePartition:
         self._cs = column_store
         self._dataset = dataset
         self._shard = shard
+        # chunk accounting for QueryStats: duck-typed partitions have no
+        # chunks_in_range, so leaf scans fold this count in after decode
+        self.chunks_read = 0
 
     def read_samples(self, start, end, col=None, extra_chunks=None):
         from filodb_tpu.core.memstore.partition import TimeSeriesPartition
         chunks = self._cs.read_chunks(self._dataset, self._shard,
                                       self.part_key, start, end)
+        self.chunks_read = len(chunks)
         tmp = TimeSeriesPartition(self.part_id, self.part_key, self.schema)
         tmp.chunks = chunks
         return tmp.read_samples(start, end, col)

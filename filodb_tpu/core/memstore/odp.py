@@ -6,21 +6,37 @@ what's resident in memory (flushed-then-evicted chunks, or partitions
 restored index-only after recovery), the missing chunk range is read from the
 column store and attached to the partition as transient paged chunks (bounded
 LRU per shard).
+
+The cold federation tier (``query/federation.py``) routes every read of
+object-store-resident history through this cache, so it additionally keeps a
+per-partition *range coverage* memo: once ``[start, end]`` was fully paged
+for a partition with nothing resident in memory, a repeat request inside
+that range serves straight from the LRU — no column-store read, and on an
+object-store backend no ranged GET — until any of the partition's chunks is
+evicted. Cache hits (both paths) refresh the LRU position.
 """
 
 from __future__ import annotations
 
 import logging
+import weakref
 from collections import OrderedDict
 
 from filodb_tpu.core.memstore.partition import TimeSeriesPartition
 from filodb_tpu.core.memstore.shard import TimeSeriesShard
-from filodb_tpu.utils.metrics import Counter
+from filodb_tpu.utils.metrics import Counter, GaugeFn
 
 log = logging.getLogger(__name__)
 
 odp_chunks_paged = Counter("odp_chunks_paged")
 odp_requests = Counter("odp_requests")
+odp_range_hits = Counter("odp_range_hits")
+
+# chunks currently held across every live ODP cache (all shards, raw and
+# cold-tier); scrape-time callback so no update path is needed
+_CACHES: "weakref.WeakSet[DemandPagedChunkCache]" = weakref.WeakSet()
+odp_cache_chunks = GaugeFn("filodb_odp_cache_chunks",
+                           lambda: sum(len(c) for c in _CACHES))
 
 
 class DemandPagedChunkCache:
@@ -29,16 +45,58 @@ class DemandPagedChunkCache:
     def __init__(self, max_chunks: int = 10_000):
         self.max_chunks = max_chunks
         self._lru: OrderedDict[tuple[int, int], object] = OrderedDict()
+        # coverage memo: part_id -> [(start, end), ...] ranges known to be
+        # fully cached, and part_id -> cached chunk ids. Coverage is only
+        # recorded for partitions with NO resident chunks (cold-tier
+        # partitions): a resident set can shrink later, which would make
+        # a remembered range silently incomplete.
+        self._covered: dict[int, list[tuple[int, int]]] = {}
+        self._part_chunks: dict[int, set[int]] = {}
+        _CACHES.add(self)
+
+    def __len__(self) -> int:
+        return len(self._lru)
 
     def clear(self) -> None:
         """Drop all cached chunks (benchmarks use this to force cold reads)."""
         self._lru.clear()
+        self._covered.clear()
+        self._part_chunks.clear()
+
+    def _covers(self, part_id: int, start: int, end: int) -> bool:
+        return any(cs <= start and end <= ce
+                   for cs, ce in self._covered.get(part_id, ()))
+
+    def _evict_one(self) -> None:
+        (pid, cid), _ = self._lru.popitem(last=False)
+        ids = self._part_chunks.get(pid)
+        if ids is not None:
+            ids.discard(cid)
+            if not ids:
+                del self._part_chunks[pid]
+        # any remembered range for this partition may now be incomplete
+        self._covered.pop(pid, None)
 
     def get_or_load(self, shard: TimeSeriesShard, part: TimeSeriesPartition,
                     start: int, end: int) -> list:
         """Chunks from the column store overlapping [start, end] that are not
         resident in memory."""
         odp_requests.inc()
+        pid = part.part_id
+        if self._covers(pid, start, end):
+            # covered repeat: serve from the LRU without touching the
+            # store; hits refresh LRU position so hot cold-tier chunks
+            # survive eviction pressure. Chunks outside [start, end] are
+            # harmless — partition reads slice by timestamp anyway.
+            odp_range_hits.inc()
+            out = []
+            for cid in list(self._part_chunks.get(pid, ())):
+                key = (pid, cid)
+                ch = self._lru.get(key)
+                if ch is not None:
+                    self._lru.move_to_end(key)
+                    out.append(ch)
+            return out
         resident = {c.id for c in part.chunks}
         disk_chunks = shard.column_store.read_chunks(
             shard.dataset, shard.shard_num, part.part_key, start, end)
@@ -46,7 +104,7 @@ class DemandPagedChunkCache:
         for ch in disk_chunks:
             if ch.id in resident:
                 continue
-            key = (part.part_id, ch.id)
+            key = (pid, ch.id)
             cached = self._lru.get(key)
             if cached is None:
                 self._lru[key] = ch
@@ -55,9 +113,15 @@ class DemandPagedChunkCache:
                 cached = ch
             else:
                 self._lru.move_to_end(key)
+            self._part_chunks.setdefault(pid, set()).add(ch.id)
             out.append(cached)
+        if not resident:
+            ranges = self._covered.setdefault(pid, [])
+            ranges.append((start, end))
+            if len(ranges) > 16:
+                del ranges[0]
         while len(self._lru) > self.max_chunks:
-            self._lru.popitem(last=False)
+            self._evict_one()
         return out
 
 

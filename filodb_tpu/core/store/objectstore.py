@@ -888,6 +888,23 @@ class ObjectStoreColumnStore(ColumnStore):
             return [PartKeyRecord(pk, v[0], v[1])
                     for pk, v in st.parts.items() if v[2] > pk_token]
 
+    def dataset_stats(self, dataset):
+        """{series, bytes, segments} across this dataset's loaded shards —
+        the tier-size introspection behind ``/api/v1/status/tiers``.
+        Counts uploaded segment objects plus sealed-but-pending bytes
+        (write-behind), so the number tracks what a cold read could
+        touch."""
+        series = bytes_ = segments = 0
+        with self._lock:
+            for (ds, _shard), st in self._states.items():
+                if ds != dataset:
+                    continue
+                series += len(st.parts)
+                for seg in st.segments.values():
+                    bytes_ += seg.size
+                    segments += 1
+        return {"series": series, "bytes": bytes_, "segments": segments}
+
     def scan_chunks_by_ingestion_time(self, dataset, shard, start, end):
         yield from self.scan_chunks_by_ingestion_time_split(
             dataset, shard, start, end, 0, 1)

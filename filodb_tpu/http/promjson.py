@@ -67,6 +67,12 @@ def _stats_json(result: QueryResult, full: bool = False) -> dict:
             "decodeMs": round(s.decode_s * 1000.0, 3),
             "reduceMs": round(s.reduce_s * 1000.0, 3),
         })
+        if s.tiers:
+            # federated query: per-tier attribution (query/federation.py)
+            out["tiers"] = {
+                tier: {k: (round(v, 3) if isinstance(v, float) else v)
+                       for k, v in bucket.items()}
+                for tier, bucket in s.tiers.items()}
     return out
 
 

@@ -32,6 +32,10 @@ from urllib.parse import parse_qs, unquote, urlparse
 from filodb_tpu.coordinator.query_service import QueryService
 from filodb_tpu.http import promjson
 from filodb_tpu.promql.parser import ParseError, TimeStepParams, parse_query
+# imported for the side effect of registering the federation + ODP metric
+# families at boot, so /metrics exposes them even before the first
+# federated query (scrape-breadth test relies on this)
+from filodb_tpu.query import federation as _federation  # noqa: F401
 from filodb_tpu.query.model import QueryLimitExceeded
 from filodb_tpu.utils.governor import QueryRejected
 from filodb_tpu.utils.metrics import render_prometheus
@@ -228,6 +232,8 @@ class HttpDispatcher:
             return self._status_tsdb(qs)
         if parts == ["api", "v1", "status", "ingest"]:
             return self._status_ingest(qs)
+        if parts == ["api", "v1", "status", "tiers"]:
+            return self._status_tiers(qs)
         return self._json(404, promjson.error_json("not found", "not_found"))
 
     def _rule_managers(self) -> dict:
@@ -300,6 +306,15 @@ class HttpDispatcher:
                 "labelValueCountByLabelName": [
                     {"name": label, "value": v} for label, v in top_labels],
             }
+        return self._json(200, {"status": "success", "data": data})
+
+    def _status_tiers(self, qs: dict):
+        """Per-dataset retention-tier map: which tiers exist (memstore /
+        downsample / objectstore), their time floors, and per-tier
+        series/bytes — the introspection face of query federation."""
+        from filodb_tpu.query import federation
+        data = {name: federation.tier_status(name, svc)
+                for name, svc in self._status_datasets(qs).items()}
         return self._json(200, {"status": "success", "data": data})
 
     def _status_ingest(self, qs: dict):

@@ -266,6 +266,12 @@ class SelectRawPartitionsExec(ExecPlan):
                                             self.chunk_end, col,
                                             extra_chunks=extra_chunks)
                 ctx.stats.decode_s += time.perf_counter() - t0
+                # duck-typed partitions (downsample PagedReadablePartition,
+                # cold-tier ColdPartition) count the chunks each read
+                # instead — per-tier attribution needs real chunk counts
+                ctx.stats.chunks_touched += sum(
+                    getattr(p, "chunks_read", 0) for p in sparts
+                    if not hasattr(p, "chunks_in_range"))
                 keys = [p.part_key.range_vector_key for p in sparts]
                 is_counter = schema.data.columns[col].is_counter
                 if len(shard.batch_cache) >= shard.batch_cache_cap:

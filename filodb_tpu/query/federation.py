@@ -1,0 +1,392 @@
+"""Tiered federation: one PromQL query across memstore, downsample tier,
+and object-store history.
+
+Counterpart of the reference deployment posture where a raw cluster, a
+downsample cluster and a long-term store answer one query (reference
+``LongTimeRangePlanner.scala`` generalized to three tiers; ROADMAP open
+item 3). The pieces composed here all pre-exist:
+
+- ``route_tiers`` decomposes a query grid into per-tier step ranges at
+  step boundaries, honoring the max lookback window so no tier is asked
+  for steps whose window reaches below its data floor (seam semantics:
+  every step lands in exactly ONE tier — the newest tier whose floor
+  covers the step's full lookback window).
+- ``ColdTierStore`` is a memstore-shaped facade over the RAW dataset's
+  persisted chunks (the object-store history tier): the part-key index
+  bootstraps from ``scan_part_keys`` and chunk payloads page in through
+  the per-shard :class:`DemandPagedChunkCache` — on an
+  ``ObjectStoreColumnStore`` backend that is a CRC-verified coalesced
+  ranged GET per segment run.
+- ``TierExec`` wraps each tier's exec subtree and attributes
+  chunks/bytes/decode to ``QueryStats.tiers[tier]`` (PR 10 machinery:
+  a ``tier=...`` span per sub-query), so a federated query's time
+  budget is provable from ``?stats=all``.
+
+The planner that glues these together is
+:class:`filodb_tpu.coordinator.tiered_planner.TieredPlanner`; settled
+per-extent results of federated queries land in the PR 2 result cache
+keyed by the tier-invariant plan signature (the cache splits the grid
+BEFORE tier routing, so a repeat dashboard query over old data hits warm
+without touching the object store).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+from filodb_tpu.core.memstore.index import PartKeyIndex
+from filodb_tpu.core.memstore.odp import DemandPagedChunkCache
+from filodb_tpu.core.schemas import DEFAULT_SCHEMAS, Schemas
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.query.exec.plan import ExecContext, NonLeafExecPlan
+from filodb_tpu.query.model import QueryStats, StepMatrix
+from filodb_tpu.utils.metrics import Counter
+from filodb_tpu.utils.tracing import span, tag
+
+MEMSTORE = "memstore"
+OBJECTSTORE = "objectstore"
+DOWNSAMPLE = "downsample"
+
+# federated (multi-tier) query + per-tier sub-query counters; the scrape
+# breadth test asserts these families (tests/test_metrics_scrape.py)
+fed_queries = Counter("filodb_federation_queries")
+fed_sub_memstore = Counter("filodb_federation_subqueries",
+                           {"tier": MEMSTORE})
+fed_sub_objectstore = Counter("filodb_federation_subqueries",
+                              {"tier": OBJECTSTORE})
+fed_sub_downsample = Counter("filodb_federation_subqueries",
+                             {"tier": DOWNSAMPLE})
+_SUB_COUNTERS = {MEMSTORE: fed_sub_memstore,
+                 OBJECTSTORE: fed_sub_objectstore,
+                 DOWNSAMPLE: fed_sub_downsample}
+
+
+# ---------------------------------------------------------------------------
+# tier routing
+
+@dataclass(frozen=True)
+class TierRange:
+    """One tier's slice of a query grid: step instants
+    ``start, start+step, ..., end`` (both inclusive, ms)."""
+
+    tier: str
+    start: int
+    end: int
+
+
+def _first_covered_step(start: int, step: int, end: int, lookback: int,
+                        floor: int) -> int:
+    """First grid instant whose full lookback window sits at/above
+    ``floor`` (>= semantics: a step at exactly ``floor + lookback`` is
+    covered). Returns ``end + step`` when no grid instant qualifies."""
+    b = start
+    while b - lookback < floor and b <= end:
+        b += step
+    return b
+
+
+def route_tiers(start: int, step: int, end: int, lookback: int,
+                mem_floor: int, raw_floor: int | None) -> list[TierRange]:
+    """Decompose a query grid into per-tier step ranges, oldest tier
+    first.
+
+    Seam semantics: each step goes to the NEWEST tier whose data floor
+    covers the step's full lookback window ``[t - lookback, t]``; the
+    returned ranges are disjoint, adjacent, and cover every grid step —
+    no double-counted or dropped steps at tier seams. ``raw_floor`` is
+    the earliest raw (object-store) data; ``None`` means there is no
+    downsample tier and the object-store tier extends to the range
+    start. ``mem_floor`` below ``raw_floor`` is clamped (memory never
+    retains more than the durable store)."""
+    step = max(step, 1)
+    if raw_floor is not None and mem_floor < raw_floor:
+        mem_floor = raw_floor
+    b_mem = _first_covered_step(start, step, end, lookback, mem_floor)
+    b_os = start if raw_floor is None else \
+        _first_covered_step(start, step, end, lookback, raw_floor)
+    out = []
+    if b_os > start:
+        out.append(TierRange(DOWNSAMPLE, start, b_os - step))
+    if b_mem > b_os:
+        out.append(TierRange(OBJECTSTORE, b_os, b_mem - step))
+    if b_mem <= end:
+        out.append(TierRange(MEMSTORE, b_mem, end))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cold tier: object-store-resident raw history
+
+class _TierCounter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class _ColdShardStats:
+    """Duck-types the ShardStats surface the ODP cache touches."""
+
+    def __init__(self):
+        self.chunks_paged_in = _TierCounter()
+        self.partitions_paged_in = _TierCounter()
+
+
+class ColdPartition:
+    """Read-only partition over object-store-resident raw chunks.
+
+    Nothing is resident (``chunks`` is empty) — every read pages through
+    the shard's :class:`DemandPagedChunkCache`, which on a covered
+    repeat serves from the LRU without touching the store."""
+
+    chunks = ()  # resident set for the ODP cache: always empty
+
+    def __init__(self, part_id, part_key, schema, shard):
+        self.part_id = part_id
+        self.part_key = part_key
+        self.schema = schema
+        self._shard = shard
+        # chunk accounting for QueryStats (leaf scans fold this in —
+        # duck-typed partitions have no chunks_in_range)
+        self.chunks_read = 0
+
+    def read_samples(self, start, end, col=None, extra_chunks=None):
+        from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        chunks = self._shard.odp_cache.get_or_load(self._shard, self,
+                                                   start, end)
+        self.chunks_read = len(chunks)
+        tmp = TimeSeriesPartition(self.part_id, self.part_key, self.schema)
+        tmp.chunks = list(chunks)
+        return tmp.read_samples(start, end, col)
+
+
+class ColdTierShard:
+    """Shard facade over the RAW dataset's persisted part keys + chunks
+    (compare ``DownsampledTimeSeriesShard``, which does the same for the
+    ds dataset but without demand paging)."""
+
+    def __init__(self, dataset: str, shard: int, column_store,
+                 schemas: Schemas, odp_max_chunks: int = 10_000,
+                 refresh_s: float = 60.0):
+        self.dataset = dataset
+        self.shard_num = shard
+        self.column_store = column_store
+        self.schemas = schemas
+        self.index = PartKeyIndex()
+        self.config = StoreConfig(demand_paging_enabled=False)
+        self.odp_cache = DemandPagedChunkCache(max_chunks=odp_max_chunks)
+        self.stats = _ColdShardStats()
+        # leaf-exec batch cache protocol (see TimeSeriesShard.batch_cache)
+        self.batch_cache: dict = {}
+        self.batch_cache_cap = 64
+        self.refresh_s = refresh_s
+        self._known: dict = {}
+        self._parts: dict = {}
+        self._refreshed_at = float("-inf")
+
+    @property
+    def data_version(self) -> int:
+        return len(self._known)
+
+    def refresh_index(self) -> int:
+        """Bootstrap/refresh the index from the raw dataset's persisted
+        part keys; periodic re-refresh picks up newly flushed series."""
+        n = 0
+        for rec in self.column_store.scan_part_keys(self.dataset,
+                                                    self.shard_num):
+            if rec.part_key in self._known:
+                pid = self._known[rec.part_key]
+                self.index.update_end_time(pid, rec.end_time)
+                continue
+            pid = len(self._known)
+            self._known[rec.part_key] = pid
+            self.index.add_part_key(pid, rec.part_key, rec.start_time,
+                                    rec.end_time)
+            self._parts[pid] = ColdPartition(
+                pid, rec.part_key, self.schemas[rec.part_key.schema], self)
+            n += 1
+        self._refreshed_at = time.monotonic()
+        return n
+
+    def _maybe_refresh(self) -> None:
+        if time.monotonic() - self._refreshed_at > self.refresh_s:
+            self.refresh_index()
+
+    def lookup_partitions(self, filters, start, end):
+        self._maybe_refresh()
+        return self.index.part_ids_from_filters(filters, start, end)
+
+    def partition(self, pid):
+        return self._parts.get(pid)
+
+    def label_values(self, label, filters=None, start=0, end=2**62):
+        self._maybe_refresh()
+        return self.index.label_values(label, filters, start, end)
+
+    def label_names(self):
+        self._maybe_refresh()
+        return self.index.label_names()
+
+    @property
+    def num_partitions(self):
+        return len(self._known)
+
+
+class ColdTierStore:
+    """Memstore-shaped facade over object-store-resident raw history for
+    the exec layer: leaves read it via the ``store`` override exactly
+    like the downsample store."""
+
+    def __init__(self, column_store, dataset: str, num_shards: int,
+                 schemas: Schemas | None = None,
+                 odp_max_chunks: int = 10_000, refresh_s: float = 60.0):
+        self.column_store = column_store
+        self.dataset = dataset
+        self.schemas = schemas or DEFAULT_SCHEMAS
+        self._shards = {
+            s: ColdTierShard(dataset, s, column_store, self.schemas,
+                             odp_max_chunks=odp_max_chunks,
+                             refresh_s=refresh_s)
+            for s in range(num_shards)}
+
+    def get_shard(self, dataset: str, shard: int):
+        return self._shards[shard]
+
+    def shards_for(self, dataset: str):
+        return [self._shards[s] for s in sorted(self._shards)]
+
+    def cache_chunks(self) -> int:
+        return sum(len(s.odp_cache) for s in self._shards.values())
+
+    def clear_caches(self) -> None:
+        """Drop ODP + batch caches (benchmarks force cold reads)."""
+        for s in self._shards.values():
+            s.odp_cache.clear()
+            s.batch_cache.clear()
+
+    def tier_stats(self) -> dict:
+        """{series, bytes, segments} for the status route; bytes/segments
+        come from the backend when it can introspect them
+        (``ObjectStoreColumnStore.dataset_stats``)."""
+        for s in self._shards.values():
+            s._maybe_refresh()
+        series = sum(s.num_partitions for s in self._shards.values())
+        out = {"series": series, "bytes": None, "segments": None}
+        ds_stats = getattr(self.column_store, "dataset_stats", None)
+        if ds_stats is not None:
+            st = ds_stats(self.dataset)
+            out["bytes"] = st.get("bytes")
+            out["segments"] = st.get("segments")
+        return out
+
+    def label_values(self, dataset, label, filters=None, start=0, end=2**62):
+        out = set()
+        for s in self.shards_for(dataset):
+            out.update(s.label_values(label, filters, start, end))
+        return sorted(out)
+
+    def label_names(self, dataset):
+        out = set()
+        for s in self.shards_for(dataset):
+            out.update(s.label_names())
+        return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# per-tier execution + attribution
+
+def _tier_bucket() -> dict:
+    return {"subqueries": 0, "series": 0, "samples": 0, "chunks": 0,
+            "bytes": 0, "decodeMs": 0.0, "wallMs": 0.0}
+
+
+@dataclass
+class TierExec(NonLeafExecPlan):
+    """Wrap one tier's exec subtree: executes the child under a
+    ``tier=...`` span with a FRESH stats object, then folds the counts
+    into the query's stats twice — once merged (totals stay correct)
+    and once into the per-tier attribution bucket
+    ``QueryStats.tiers[tier]``.
+
+    Execution goes through the standard ``gather`` (single child), so
+    tier sub-query dispatch stays inside the exec machinery that the
+    governor ``admit()`` gate at ``_execute_uncached`` covers — filolint
+    CP502 proves no federation path dispatches outside it. A cold tier
+    lost to a transport fault re-raises from here and is tolerated by
+    the stitching parent as a partial result, never wrong data."""
+
+    tier: str = ""
+
+    def do_execute(self, ctx: ExecContext) -> StepMatrix:
+        from filodb_tpu.core.store.objectstore import BYTES_DOWN
+        sub = ExecContext(ctx.memstore, ctx.dataset, ctx.qcontext,
+                          stats=QueryStats(), deadline=ctx.deadline,
+                          budget=ctx.budget)
+        _SUB_COUNTERS.get(self.tier, fed_queries).inc()
+        bytes0 = BYTES_DOWN.value
+        t0 = time.perf_counter()
+        with span("tier", tier=self.tier):
+            mats = self.gather(sub)
+            tag("series", sub.stats.series_scanned)
+            tag("chunks", sub.stats.chunks_touched)
+        wall_s = time.perf_counter() - t0
+        ctx.partial = ctx.partial or sub.partial
+        for w in sub.warnings:
+            if w not in ctx.warnings:
+                ctx.warnings.append(w)
+        ctx.stats.merge_counts(sub.stats)
+        b = ctx.stats.tiers.setdefault(self.tier, _tier_bucket())
+        b["subqueries"] += 1
+        b["series"] += sub.stats.series_scanned
+        b["samples"] += sub.stats.samples_scanned
+        b["chunks"] += sub.stats.chunks_touched
+        # bytes moved for this tier: object-store ranged-GET payloads
+        # (single-process counter delta — concurrent queries can only
+        # over-attribute, never lose bytes) plus remote-child wire bytes
+        b["bytes"] += max(0, BYTES_DOWN.value - bytes0) \
+            + sub.stats.wire_bytes
+        b["decodeMs"] += sub.stats.decode_s * 1000.0
+        b["wallMs"] += wall_s * 1000.0
+        if not mats:
+            return StepMatrix.empty()
+        return mats[0]
+
+    def __repr__(self):
+        return f"TierExec({self.tier})"
+
+
+# ---------------------------------------------------------------------------
+# status introspection (shared by both HTTP fronts + filo-cli tiers)
+
+def tier_status(name: str, svc) -> dict:
+    """Per-dataset tier snapshot: retention boundaries and per-tier
+    series/bytes. Works for any service — non-federated datasets report
+    the memstore tier only."""
+    tiers = []
+    mem_series = 0
+    mem_bytes = 0
+    for sh in svc.memstore.shards_for(name):
+        card = getattr(sh, "cardinality", None)
+        if card is not None:
+            mem_series += card.cardinality([]).active_ts
+        st = getattr(sh, "stats", None)
+        if st is not None and hasattr(st, "encoded_bytes"):
+            mem_bytes += st.encoded_bytes.value
+    mem_tier = {"tier": MEMSTORE, "series": mem_series,
+                "bytes": mem_bytes, "floorMs": None, "ceilMs": None}
+    out = {"federated": False, "tiers": tiers}
+    planner = getattr(svc, "planner", None)
+    detail = getattr(planner, "tier_detail", None)
+    if detail is not None:
+        d = detail()
+        out["federated"] = True
+        out["memFloorMs"] = d["memFloorMs"]
+        out["rawFloorMs"] = d["rawFloorMs"]
+        mem_tier["floorMs"] = d["memFloorMs"]
+        tiers.extend(d["tiers"])
+    tiers.append(mem_tier)
+    return out

@@ -204,6 +204,11 @@ class QueryStats:
     admission_wait_s: float = 0.0
     decode_s: float = 0.0
     reduce_s: float = 0.0
+    # tiered federation (query/federation.py): per-tier attribution of a
+    # federated query — {tier: {subqueries, series, samples, chunks,
+    # bytes, decodeMs, wallMs}} recorded by TierExec at the routing root;
+    # empty for non-federated queries
+    tiers: dict = field(default_factory=dict)
 
     def merge_counts(self, other: "QueryStats") -> None:
         """Fold a remote child's stats into this one (count/duration
@@ -219,6 +224,10 @@ class QueryStats:
         self.admission_wait_s += other.admission_wait_s
         self.decode_s += other.decode_s
         self.reduce_s += other.reduce_s
+        for tier, bucket in other.tiers.items():
+            mine = self.tiers.setdefault(tier, {})
+            for k, v in bucket.items():
+                mine[k] = mine.get(k, 0) + v
 
 
 @dataclass

@@ -329,6 +329,16 @@ class ResultCache:
         if floor is not None:
             horizon = min(horizon, floor() if callable(floor) else floor)
         sig = plan_signature(plan)
+        # tiered federation: the signature stays tier-INVARIANT (the grid
+        # splits before tier routing, so a repeat query hits the same key
+        # no matter which tier serves an extent), but tier MEMBERSHIP is
+        # part of it — a TieredPlanner folds its cold/ds index versions
+        # in, so settled extents don't outlive part-key index growth in
+        # the colder tiers (e.g. the downsampler publishing a window that
+        # was queried before it landed).
+        tok = getattr(svc.planner, "version_token", None)
+        if tok is not None:
+            sig = (sig, tok())
 
         extent_ms = self.config.extent_steps * step
         t0 = time.perf_counter()
@@ -367,8 +377,9 @@ class ResultCache:
                         return svc._execute_uncached(plan, qcontext)
                     self._put(key, stamp, r.result)
                     m = r.result
-                    stats.series_scanned += r.stats.series_scanned
-                    stats.samples_scanned += r.stats.samples_scanned
+                    # fold the full expanded counters (incl. per-tier
+                    # federation buckets), not just the scan totals
+                    stats.merge_counts(r.stats)
                 parts.append((es, ee, _slice_steps(m, fs, step, es, ee)))
             cache_hits.inc(hits)
             cache_misses.inc(misses)
@@ -384,6 +395,8 @@ class ResultCache:
             return svc._execute_uncached(plan, qcontext)
         from filodb_tpu.query.exec.plan import ExecPlan
         ExecPlan._enforce_limits(merged, qcontext)
+        stats.cache_hits += hits
+        stats.cache_misses += misses
         stats.result_series = merged.num_series
         stats.wall_time_s = time.perf_counter() - t0
         return QueryResult(merged, stats, qcontext.query_id)

@@ -546,6 +546,11 @@ class FiloServer:
             self._setup_failover()
         if cfg.downsample and not cfg.seeds:
             self._setup_downsampling(services)
+        if not cfg.seeds:
+            # tier federation wraps whatever planner the dataset ended up
+            # with (raw-only or raw+downsample) — must run AFTER the
+            # downsample plane so it can absorb the ds planner as a tier
+            self._setup_federation(services)
         log.info("FiloServer up: http=%d executor=%d role=%s", self.http.port,
                  self.executor.port, "member" if cfg.seeds else "coordinator")
         return self
@@ -619,6 +624,49 @@ class FiloServer:
                         cfg.spreads.get(dataset, 1), store=ds_store)
                 svc.planner = LongTimeRangePlanner(
                     raw_planner, ds_planner, raw_retention)
+
+    # -- tier federation (query/federation.py): one query_range across
+    #    memstore, the downsample tier and object-store history ------------
+
+    def _setup_federation(self, services: dict):
+        fed = dict(self.config.federation or {})
+        # opt-in: routing the hot tier by configured memory retention is
+        # only safe when the operator asserts data past that horizon is
+        # durably uploaded; without an explicit horizon the memstore (or
+        # the downsample wiring's LongTimeRangePlanner) serves everything
+        if not fed.get("enabled", True) or not fed.get("mem_retention_ms"):
+            return
+        from filodb_tpu.coordinator.longtime_planner import (
+            LongTimeRangePlanner,
+        )
+        from filodb_tpu.coordinator.tiered_planner import (
+            build_tiered_planner,
+        )
+        cfg = self.config
+        for dataset, svc in services.items():
+            if dataset.startswith("_"):
+                continue  # _meta self-monitoring stays memstore-only
+            ing = cfg.datasets.get(dataset)
+            if ing is None:
+                continue
+            mem_retention = fed["mem_retention_ms"]
+            raw_planner, ds_planner, raw_retention = svc.planner, None, None
+            if isinstance(svc.planner, LongTimeRangePlanner):
+                raw_planner = svc.planner.raw_planner
+                ds_planner = svc.planner.ds_planner
+                raw_retention = svc.planner.raw_retention_ms
+            svc.planner = build_tiered_planner(
+                raw_planner, self.column_store, dataset, ing.num_shards,
+                cfg.spreads.get(dataset, 1),
+                mem_retention_ms=int(mem_retention),
+                raw_retention_ms=raw_retention,
+                ds_planner=ds_planner,
+                odp_max_chunks=int(fed.get("odp_max_chunks", 10_000)),
+                refresh_s=float(fed.get("refresh_s", 60.0)))
+            log.info("federation: %s routed across memstore%s/objectstore "
+                     "(mem floor %dms)", dataset,
+                     "/downsample" if ds_planner is not None else "",
+                     mem_retention)
 
     # -- singleton failover (reference ClusterSingletonFailoverSpec) --------
 

@@ -165,6 +165,85 @@ class TestLongTimeRangePlanner:
         assert np.isfinite(r.values[:, 0]).any()
         assert np.isfinite(r.values[:, -1]).any()
 
+    def test_exact_boundary_stays_all_raw(self):
+        """start − lookback landing EXACTLY on earliest_raw_time is
+        all-raw (``>=`` boundary) — the off-by-one a strict ``>`` would
+        push into a needless, lossier stitched plan."""
+        from filodb_tpu.query.exec.plan import StitchRvsExec
+        ms, planner = self._setup()
+        # earliest_raw = START+3000s; [10m] lookback = 600s
+        r, ep = self._run(ms, planner, "max_over_time(heap_usage[10m])",
+                          START + 3600, 300, START + 5000)
+        assert not isinstance(ep, StitchRvsExec)
+        assert r.num_series == 6
+        assert np.isfinite(r.values[:, 0]).any()
+
+    def test_one_step_before_boundary_stitches(self):
+        """One grid step earlier the first window dips below raw
+        retention: exactly that one step routes to the ds tier, and the
+        stitched grid has no dropped or duplicated steps at the seam."""
+        from filodb_tpu.query.exec.plan import StitchRvsExec
+        ms, planner = self._setup()
+        r, ep = self._run(ms, planner, "max_over_time(heap_usage[10m])",
+                          START + 3300, 300, START + 5000)
+        assert isinstance(ep, StitchRvsExec)
+        expected = np.arange((START + 3300) * 1000,
+                             (START + 5000) * 1000 + 1, 300 * 1000)
+        np.testing.assert_array_equal(r.steps_ms, expected)
+        assert np.isfinite(r.values[:, 0]).any()  # ds-served first step
+        assert np.isfinite(r.values[:, -1]).any()
+
+    def test_avg_rewrite_nested_under_aggregate(self):
+        """The Σsum/Σcount avg rewrite fires on windows nested under an
+        aggregate — the whole subtree is rewritten, not just top-level
+        windowing nodes — and the result matches the raw average."""
+        from filodb_tpu.query import logical as lp
+        rewrite = rewrite_for_downsample_import()
+        plan = parse_query("sum(avg_over_time(heap_usage[10m]))",
+                           TimeStepParams(START + 900, 300, START + 2400))
+        rw = rewrite(plan)
+        assert isinstance(rw, lp.Aggregate) and rw.op == "sum"
+        j = rw.vector
+        assert isinstance(j, lp.BinaryJoin) and j.op == "/"
+        assert j.lhs.function == "sum_over_time"
+        assert j.lhs.raw.column == "sum"
+        assert j.rhs.raw.column == "count"
+        # correctness: all-ds range through the tiered planner vs raw
+        ms, planner = self._setup()
+        r, ep = self._run(ms, planner, "sum(avg_over_time(heap_usage[10m]))",
+                          START + 900, 300, START + 2400)
+        assert r.num_series == 1
+        from filodb_tpu.coordinator.query_service import QueryService
+        raw = QueryService(ms, "timeseries", 1, spread=0).query_range(
+            "sum(avg_over_time(heap_usage[10m]))",
+            START + 900, 300, START + 2400).result
+        m = np.isfinite(r.values) & np.isfinite(raw.values)
+        assert m.any()
+        # rollup boundary effect: a raw sample exactly on the left window
+        # edge belongs to the period but not the left-exclusive window
+        np.testing.assert_allclose(r.values[m], raw.values[m], rtol=5e-2)
+
+    def test_avg_rewrite_nested_under_binary_join(self):
+        """Both sides of a binary join are rewritten independently;
+        avg/avg over the ds tier is identically 1 wherever defined."""
+        from filodb_tpu.query import logical as lp
+        rewrite = rewrite_for_downsample_import()
+        q = ("avg_over_time(heap_usage[10m])"
+             " / avg_over_time(heap_usage[10m])")
+        plan = parse_query(q, TimeStepParams(START + 900, 300, START + 2400))
+        rw = rewrite(plan)
+        assert isinstance(rw, lp.BinaryJoin)
+        for side in (rw.lhs, rw.rhs):
+            assert isinstance(side, lp.BinaryJoin) and side.op == "/"
+            assert side.lhs.raw.column == "sum"
+            assert side.rhs.raw.column == "count"
+        ms, planner = self._setup()
+        r, ep = self._run(ms, planner, q, START + 900, 300, START + 2400)
+        assert r.num_series == 6
+        vals = r.values[np.isfinite(r.values)]
+        assert len(vals)
+        np.testing.assert_allclose(vals, 1.0, rtol=1e-12)
+
 
 class TestStreamingDownsampler:
     def test_on_flush_publishes(self):

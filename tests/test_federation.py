@@ -1,0 +1,615 @@
+"""Tiered federation tests: one PromQL query across memstore, the
+downsample tier, and object-store history.
+
+Covers the ``route_tiers`` seam semantics (every step in exactly one
+tier, lookback satisfied across seams), the ``ColdTierStore`` ODP read
+path over a real ``ObjectStoreColumnStore``, federated-vs-all-raw
+equivalence with both seams in range, chaos (object-store latency and
+fault injection → partial + warning, never wrong data), per-tier
+``QueryStats`` attribution, governor cost classing, result-cache warm
+behavior, and the ``/api/v1/status/tiers`` route on both HTTP fronts.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.tiered_planner import (
+    TieredPlanner,
+    build_tiered_planner,
+)
+from filodb_tpu.core.downsample import (
+    DownsampledTimeSeriesStore,
+    DownsamplerJob,
+)
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.core.store.objectstore import ObjectStoreColumnStore
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.query.exec.plan import ExecContext, StitchRvsExec
+from filodb_tpu.query.federation import (
+    DOWNSAMPLE,
+    MEMSTORE,
+    OBJECTSTORE,
+    ColdTierStore,
+    TierRange,
+    route_tiers,
+)
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    machine_metrics_series,
+)
+from filodb_tpu.testing.fake_s3 import FakeS3, S3TransientError
+from filodb_tpu.utils.resilience import RetryPolicy
+
+START = 1_600_000_000
+RES = 300_000  # 5m
+
+# fixture timeline (seconds past START): data covers [0, +6000); the
+# memstore tier floor sits at +4000 and the raw (object-store) floor at
+# +2000 — queries over [+900, +5400] cross BOTH seams
+NOW = (START + 6000) * 1000
+MEM_FLOOR = (START + 4000) * 1000
+RAW_FLOOR = (START + 2000) * 1000
+
+
+def _grid(start, step, end):
+    return list(range(start, end + 1, step))
+
+
+def _steps(ranges, step):
+    out = []
+    for r in ranges:
+        out.extend(_grid(r.start, step, r.end))
+    return out
+
+
+class TestRouteTiers:
+    def test_all_memstore(self):
+        assert route_tiers(100, 10, 200, 30, mem_floor=50,
+                           raw_floor=0) == [TierRange(MEMSTORE, 100, 200)]
+
+    def test_all_objectstore(self):
+        assert route_tiers(100, 10, 200, 30, mem_floor=10_000,
+                           raw_floor=0) == [TierRange(OBJECTSTORE, 100, 200)]
+
+    def test_all_downsample(self):
+        assert route_tiers(100, 10, 200, 30, mem_floor=10_000,
+                           raw_floor=5_000) == [TierRange(DOWNSAMPLE,
+                                                          100, 200)]
+
+    def test_three_way_split(self):
+        rs = route_tiers(0, 10, 100, 5, mem_floor=50, raw_floor=20)
+        assert rs == [TierRange(DOWNSAMPLE, 0, 20),
+                      TierRange(OBJECTSTORE, 30, 50),
+                      TierRange(MEMSTORE, 60, 100)]
+
+    def test_coverage_disjoint_exhaustive(self):
+        """Every grid step lands in exactly one tier for a sweep of
+        floor/lookback/step alignments (the seam property)."""
+        start, end = 1000, 2000
+        for step in (7, 10, 100):
+            for lookback in (0, 3, step, 250):
+                for mem_floor in (900, 1203, 1500, 2500):
+                    for raw_floor in (None, 800, 1100, 1490):
+                        rs = route_tiers(start, step, end, lookback,
+                                         mem_floor, raw_floor)
+                        got = _steps(rs, step)
+                        assert got == _grid(start, step, end), (
+                            step, lookback, mem_floor, raw_floor, rs)
+                        # tiers appear oldest-first, at most once each
+                        order = [r.tier for r in rs]
+                        assert order == sorted(
+                            order, key=[DOWNSAMPLE, OBJECTSTORE,
+                                        MEMSTORE].index)
+                        assert len(set(order)) == len(order)
+
+    def test_exact_boundary_step_goes_to_newer_tier(self):
+        """A step whose window starts EXACTLY on the tier floor is
+        covered by that tier (>= semantics) — the off-by-one a naive
+        ``>`` comparison would get wrong."""
+        # step 100 at t=500 with lookback 200 → window [300, 500]
+        rs = route_tiers(300, 100, 700, 200, mem_floor=300, raw_floor=0)
+        assert rs == [TierRange(MEMSTORE, 500, 700)] or rs[-1].start == 500
+        # one ms deeper floor pushes the boundary one full step newer
+        rs2 = route_tiers(300, 100, 700, 200, mem_floor=301, raw_floor=0)
+        assert rs2[-1] == TierRange(MEMSTORE, 600, 700)
+        assert rs2[0] == TierRange(OBJECTSTORE, 300, 500)
+
+    def test_lookback_satisfied_across_seams(self):
+        """No tier is asked for a step whose lookback window reaches
+        below that tier's data floor."""
+        rs = route_tiers(0, 10, 1000, 35, mem_floor=500, raw_floor=100)
+        for r in rs:
+            floor = {MEMSTORE: 500, OBJECTSTORE: 100,
+                     DOWNSAMPLE: -(2**62)}[r.tier]
+            assert r.start - 35 >= floor
+
+    def test_mem_floor_clamped_to_raw_floor(self):
+        """Misconfiguration (memory retention longer than durable raw
+        retention) must not double-route steps to ds AND memstore."""
+        rs = route_tiers(0, 10, 100, 0, mem_floor=20, raw_floor=50)
+        assert _steps(rs, 10) == _grid(0, 10, 100)
+        assert [r.tier for r in rs] == [DOWNSAMPLE, MEMSTORE]
+
+    def test_no_ds_tier_when_raw_floor_none(self):
+        rs = route_tiers(0, 10, 100, 0, mem_floor=50, raw_floor=None)
+        assert [r.tier for r in rs] == [OBJECTSTORE, MEMSTORE]
+
+
+def build_env(cs=None, num_shards=2, n_samples=600, counter=False,
+              read_cs=None):
+    """Memstore + flushed column store + series keys. ``read_cs`` (for
+    object-store backends) is a separate store instance over the same
+    bucket, so cold-tier reads exercise real ranged GETs instead of the
+    writer's in-memory buffers."""
+    cs = cs if cs is not None else InMemoryColumnStore()
+    ms = TimeSeriesMemStore(cs, InMemoryMetaStore())
+    for s in range(num_shards):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=120,
+                                              groups_per_shard=2))
+    if counter:
+        keys = counter_series(4)
+        stream = counter_stream(keys, n_samples, start_ms=START * 1000,
+                                seed=7)
+    else:
+        keys = machine_metrics_series(6)
+        stream = gauge_stream(keys, n_samples, start_ms=START * 1000)
+    ingest_routed(ms, "timeseries", stream, num_shards, spread=0)
+    ms.flush_all("timeseries")
+    flush = getattr(cs, "flush", None)
+    if flush is not None:
+        flush()
+    return ms, cs, keys
+
+
+def build_planner(ms, cs, num_shards=2, with_ds=True, read_cs=None,
+                  **kw):
+    raw_planner = SingleClusterPlanner("timeseries", num_shards, spread=0)
+    ds_planner = None
+    raw_retention = None
+    if with_ds:
+        DownsamplerJob(cs, "timeseries", num_shards,
+                       resolutions_ms=(RES,)).run(0, 2**62)
+        ds_store = DownsampledTimeSeriesStore(cs, "timeseries", RES,
+                                              num_shards)
+        ds_planner = SingleClusterPlanner("timeseries", num_shards,
+                                          spread=0, store=ds_store)
+        raw_retention = NOW - RAW_FLOOR
+    return build_tiered_planner(
+        raw_planner, read_cs if read_cs is not None else cs, "timeseries",
+        num_shards, mem_retention_ms=NOW - MEM_FLOOR,
+        raw_retention_ms=raw_retention, ds_planner=ds_planner,
+        now_ms=lambda: NOW, **kw)
+
+
+def run(ms, planner, promql, start, step, end, ctx=None):
+    plan = parse_query(promql, TimeStepParams(start, step, end))
+    ep = planner.materialize(plan)
+    ctx = ctx or ExecContext(ms, "timeseries")
+    return ep.dispatcher.dispatch(ep, ctx), ep, ctx
+
+
+class TestColdTierStore:
+    def test_reads_match_memstore(self):
+        """The cold facade pages the SAME raw chunks the memstore holds —
+        per-partition samples must match exactly."""
+        ms, cs, keys = build_env(num_shards=1)
+        cold = ColdTierStore(cs, "timeseries", 1)
+        sh = cold.get_shard("timeseries", 0)
+        hot = ms.get_shard("timeseries", 0)
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        f = [ColumnFilter("_metric_", Equals("heap_usage"))]
+        pids = sh.lookup_partitions(f, 0, 2**62)
+        assert len(pids) == 6
+        hot_pids = hot.lookup_partitions(f, 0, 2**62)
+        hot_by_key = {hot.partition(p).part_key: p for p in hot_pids}
+        for pid in pids:
+            part = sh.partition(pid)
+            ts, vals = part.read_samples(0, 2**62)
+            hts, hvals = hot.partition(
+                hot_by_key[part.part_key]).read_samples(0, 2**62)
+            np.testing.assert_array_equal(ts, hts)
+            np.testing.assert_array_equal(vals, hvals)
+            assert part.chunks_read > 0
+
+    def test_odp_cache_serves_covered_repeat(self):
+        ms, cs, keys = build_env(num_shards=1)
+        cold = ColdTierStore(cs, "timeseries", 1)
+        sh = cold.get_shard("timeseries", 0)
+        pid = sh.lookup_partitions([], 0, 2**62)[0]
+        part = sh.partition(pid)
+        part.read_samples(0, 2**62)
+        paged = sh.stats.chunks_paged_in.value
+        assert paged > 0 and len(sh.odp_cache) == paged
+        part.read_samples(0, 2**62)  # covered repeat: no new paging
+        assert sh.stats.chunks_paged_in.value == paged
+        cold.clear_caches()
+        assert cold.cache_chunks() == 0
+
+
+class TestFederatedEquivalence:
+    def test_two_tier_exact_match(self):
+        """memstore + objectstore read IDENTICAL raw chunks → federated
+        result must equal the all-raw control bit-for-bit."""
+        ms, cs, keys = build_env()
+        planner = build_planner(ms, cs, with_ds=False)
+        raw = SingleClusterPlanner("timeseries", 2, spread=0)
+        q = "max_over_time(heap_usage[10m])"
+        r, ep, ctx = run(ms, planner, q, START + 900, 300, START + 5400)
+        assert isinstance(ep, StitchRvsExec)
+        ctl, _, _ = run(ms, raw, q, START + 900, 300, START + 5400)
+        assert r.result.num_series == ctl.result.num_series == 6
+        np.testing.assert_array_equal(r.result.steps_ms,
+                                      ctl.result.steps_ms)
+        ctl_vals = ctl.result.values[_row_order(ctl.result, r.result)]
+        np.testing.assert_allclose(r.result.values, ctl_vals,
+                                   equal_nan=True)
+
+    def test_three_tier_sum_rate_within_tolerance(self):
+        """sum(rate(counter[15m])) spanning all three tiers matches the
+        all-raw control: exact on the raw tiers, rollup tolerance on the
+        downsample portion, and no dropped/duplicated steps at either
+        seam."""
+        ms, cs, keys = build_env(counter=True)
+        planner = build_planner(ms, cs, with_ds=True)
+        raw = SingleClusterPlanner("timeseries", 2, spread=0)
+        q = "sum(rate(http_requests_total[15m]))"
+        start, step, end = START + 1200, 300, START + 5400
+        r, ep, ctx = run(ms, planner, q, start, step, end)
+        ctl, _, _ = run(ms, raw, q, start, step, end)
+        fed, control = r.result, ctl.result
+        assert fed.num_series == control.num_series == 1
+        # seam integrity: the full grid, strictly increasing, no dupes
+        expected = np.arange(start * 1000, end * 1000 + 1, step * 1000)
+        np.testing.assert_array_equal(fed.steps_ms, expected)
+        assert (np.diff(fed.steps_ms) > 0).all()
+        # every step the control answers, the federated result answers
+        m = np.isfinite(control.values)
+        assert np.isfinite(fed.values[m]).all()
+        # raw-backed steps (objectstore + memstore tiers) agree exactly
+        raw_steps = fed.steps_ms >= RAW_FLOOR + 15 * 60 * 1000
+        np.testing.assert_allclose(fed.values[:, raw_steps],
+                                   control.values[:, raw_steps],
+                                   rtol=1e-9, equal_nan=True)
+        # downsampled steps agree within the repo-wide rollup tolerance
+        mm = m & np.isfinite(fed.values)
+        ratio = fed.values[mm] / control.values[mm]
+        assert 0.5 < np.median(ratio) < 2.0
+        assert set(ctx.stats.tiers) == {MEMSTORE, OBJECTSTORE, DOWNSAMPLE}
+
+    def test_hot_path_untouched(self):
+        """A query fully inside memstore retention materializes through
+        the raw planner directly — no TierExec, no stitch."""
+        ms, cs, keys = build_env()
+        planner = build_planner(ms, cs, with_ds=False)
+        q = "max_over_time(heap_usage[5m])"
+        r, ep, ctx = run(ms, planner, q, START + 4500, 300, START + 5400)
+        assert not isinstance(ep, StitchRvsExec)
+        assert "TierExec" not in repr(ep)
+        assert r.result.num_series == 6
+        assert not ctx.stats.tiers
+
+
+def _row_order(a, b):
+    """Index array reordering ``a``'s rows to ``b``'s key order."""
+    pos = {k: i for i, k in enumerate(a.keys)}
+    return np.array([pos[k] for k in b.keys], dtype=np.int64)
+
+
+def _objectstore_env(tmp_path, **kw):
+    """Writer + independent reader over one FakeS3 root: cold-tier reads
+    go through real ranged GETs, not the writer's write-behind buffers."""
+    s3root = str(tmp_path / "s3")
+    s3 = FakeS3(root=s3root)
+    cs = ObjectStoreColumnStore(s3)
+    ms, _, keys = build_env(cs=cs)
+    read_s3 = FakeS3(root=s3root)
+    read_cs = ObjectStoreColumnStore(
+        read_s3, read_retry_policy=RetryPolicy(max_attempts=2,
+                                               base_backoff_s=0.01,
+                                               max_backoff_s=0.05))
+    planner = build_planner(ms, cs, with_ds=False, read_cs=read_cs, **kw)
+    return ms, planner, read_s3, read_cs
+
+
+Q_SPAN = ("max_over_time(heap_usage[10m])", START + 900, 300, START + 5400)
+
+
+class TestChaos:
+    def test_objectstore_latency_slow_but_correct(self, tmp_path):
+        ms, planner, s3, _ = _objectstore_env(tmp_path)
+        s3.latency_s = 0.01
+        r, ep, ctx = run(ms, planner, *Q_SPAN)
+        ctl, _, _ = run(ms, SingleClusterPlanner("timeseries", 2, spread=0),
+                        *Q_SPAN)
+        assert not r.partial
+        ctl_vals = ctl.result.values[_row_order(ctl.result, r.result)]
+        np.testing.assert_allclose(r.result.values, ctl_vals,
+                                   equal_nan=True)
+
+    def test_objectstore_fault_partial_plus_warning(self, tmp_path):
+        """A cold tier lost to transport faults degrades to partial +
+        warning; the steps that ARE answered match the control — never
+        wrong data."""
+        ms, planner, s3, _ = _objectstore_env(tmp_path)
+        s3.inject("get", times=100,
+                  exc=S3TransientError("injected outage"))
+        r, ep, ctx = run(ms, planner, *Q_SPAN)
+        assert r.partial
+        assert any("lost" in w for w in r.warnings)
+        ctl, _, _ = run(ms, SingleClusterPlanner("timeseries", 2, spread=0),
+                        *Q_SPAN)
+        fed, control = r.result, ctl.result
+        # the lost cold tier's steps are absent; the surviving steps are
+        # a suffix of the control grid and must match it exactly
+        assert fed.num_steps > 0  # memstore tier still answered
+        cols = np.searchsorted(control.steps_ms, fed.steps_ms)
+        np.testing.assert_array_equal(control.steps_ms[cols], fed.steps_ms)
+        ctl_vals = control.values[_row_order(control, fed)][:, cols]
+        both = np.isfinite(fed.values) & np.isfinite(ctl_vals)
+        assert both.any()
+        np.testing.assert_allclose(fed.values[both], ctl_vals[both])
+
+    def test_corrupt_segment_errors_never_wrong_data(self, tmp_path):
+        from filodb_tpu.core.store.objectstore import CorruptSegmentError
+        ms, planner, s3, read_cs = _objectstore_env(tmp_path)
+        for key in s3.list_objects(""):
+            if key.endswith(".seg"):
+                s3.corrupt(key,
+                           offset=len(s3.get_object(key)) // 2)
+        with pytest.raises(CorruptSegmentError):
+            run(ms, planner, *Q_SPAN)
+
+
+class TestPerTierStats:
+    def test_stats_all_reports_per_tier_buckets(self, tmp_path):
+        ms, planner, s3, _ = _objectstore_env(tmp_path)
+        svc = QueryService(ms, "timeseries", 2, spread=0)
+        svc.planner = planner
+        qr = svc.query_range(*Q_SPAN)
+        tiers = qr.stats.tiers
+        assert set(tiers) == {MEMSTORE, OBJECTSTORE}
+        for t, b in tiers.items():
+            assert b["subqueries"] == 1
+            assert b["series"] > 0 and b["chunks"] > 0
+            assert b["wallMs"] > 0
+        # cold bytes moved over the (fake) wire; hot tier read memory
+        assert tiers[OBJECTSTORE]["bytes"] > 0
+        assert tiers[MEMSTORE]["bytes"] == 0
+        assert tiers[OBJECTSTORE]["decodeMs"] >= 0
+        # ?stats=all JSON face
+        from filodb_tpu.http.promjson import _stats_json
+        doc = _stats_json(qr, full=True)
+        assert set(doc["tiers"]) == {MEMSTORE, OBJECTSTORE}
+        assert doc["tiers"][OBJECTSTORE]["bytes"] > 0
+        json.dumps(doc)  # serializable as-is
+
+    def test_federation_counters_move(self):
+        from filodb_tpu.query.federation import fed_queries, fed_sub_memstore
+        ms, cs, keys = build_env()
+        planner = build_planner(ms, cs, with_ds=False)
+        q0, s0 = fed_queries.value, fed_sub_memstore.value
+        run(ms, planner, *Q_SPAN)
+        assert fed_queries.value == q0 + 1
+        assert fed_sub_memstore.value == s0 + 1
+
+
+class TestGovernorClassing:
+    def test_cold_queries_classed_expensive(self):
+        from filodb_tpu.utils.governor import EXPENSIVE
+        ms, cs, keys = build_env()
+        planner = build_planner(ms, cs, with_ds=False)
+        cold_plan = parse_query("heap_usage",
+                                TimeStepParams(START + 900, 300,
+                                               START + 5400))
+        hot_plan = parse_query("heap_usage",
+                               TimeStepParams(START + 4500, 60,
+                                              START + 4500))
+        assert planner.cost_hint(cold_plan) == EXPENSIVE
+        assert planner.cost_hint(hot_plan) is None
+        assert not planner.mem_only(cold_plan)
+        assert planner.mem_only(hot_plan)
+
+    def test_query_service_uses_cost_hint_and_mem_only(self):
+        """The service consults the planner for admission cost AND mesh
+        eligibility, so straddling queries never serve raw-only data
+        through the mesh bypass."""
+        ms, cs, keys = build_env()
+        svc = QueryService(ms, "timeseries", 2, spread=0)
+        svc.planner = build_planner(ms, cs, with_ds=False)
+        cold_plan = parse_query("heap_usage",
+                                TimeStepParams(START + 900, 300,
+                                               START + 5400))
+        assert not svc._planner_mem_only(cold_plan)
+        qr = svc.query_range("max_over_time(heap_usage[10m])",
+                             START + 900, 300, START + 5400)
+        assert set(qr.stats.tiers) == {MEMSTORE, OBJECTSTORE}
+
+    def test_longtime_planner_hooks(self):
+        from filodb_tpu.coordinator.longtime_planner import (
+            LongTimeRangePlanner,
+        )
+        from filodb_tpu.utils.governor import EXPENSIVE
+        p = LongTimeRangePlanner(
+            SingleClusterPlanner("timeseries", 1, spread=0),
+            SingleClusterPlanner("timeseries", 1, spread=0),
+            raw_retention_ms=NOW - RAW_FLOOR, now_ms=lambda: NOW)
+        cold = parse_query("heap_usage", TimeStepParams(START + 900, 300,
+                                                        START + 5400))
+        hot = parse_query("heap_usage", TimeStepParams(START + 4500, 60,
+                                                       START + 4500))
+        assert not p.mem_only(cold) and p.mem_only(hot)
+        assert p.cost_hint(cold) == EXPENSIVE and p.cost_hint(hot) is None
+
+
+class TestResultCacheComposition:
+    def test_warm_repeat_reads_no_objectstore_bytes(self, tmp_path):
+        """Second identical federated query settles from the extent
+        cache: strictly fewer object-store GETs (zero) than the cold
+        run, identical answer."""
+        ms, planner, s3, _ = _objectstore_env(tmp_path)
+        svc = QueryService(ms, "timeseries", 2, spread=0,
+                           result_cache={"enabled": True,
+                                         "extent_steps": 8})
+        svc.planner = planner
+        r1 = svc.query_range(*Q_SPAN)
+        gets_cold = s3.op_counts.get("get", 0)
+        assert gets_cold > 0
+        r2 = svc.query_range(*Q_SPAN)
+        gets_warm = s3.op_counts.get("get", 0) - gets_cold
+        assert gets_warm == 0
+        np.testing.assert_allclose(
+            r2.result.values,
+            r1.result.values[_row_order(r1.result, r2.result)],
+            equal_nan=True)
+        # the caching wrapper must not flatten the expanded stats: the
+        # per-tier buckets and hit/miss counters survive extent assembly
+        assert OBJECTSTORE in r1.stats.tiers
+        assert r1.stats.cache_misses > 0
+        assert r2.stats.cache_hits > 0
+
+    def test_version_token_invalidates_on_tier_growth(self):
+        ms, cs, keys = build_env()
+        planner = build_planner(ms, cs, with_ds=False)
+        t0 = planner.version_token()
+        # cold index bootstraps lazily: a refresh discovers the flushed
+        # part keys and bumps the token → cached extents re-key
+        for sh in planner.cold_planner.store.shards_for("timeseries"):
+            sh.refresh_index()
+        assert planner.version_token() > t0
+
+
+@pytest.fixture(scope="module", params=["threaded", "fast"])
+def fed_server(request):
+    """Federated dataset behind BOTH HTTP fronts."""
+    ms, cs, keys = build_env()
+    svc = QueryService(ms, "timeseries", 2, spread=0)
+    svc.planner = build_planner(ms, cs, with_ds=True)
+    from filodb_tpu.http.server import FiloHttpServer
+    if request.param == "fast":
+        from filodb_tpu.http.fastserver import FastHttpServer
+        srv = FastHttpServer({"timeseries": svc}, port=0).start()
+    else:
+        srv = FiloHttpServer({"timeseries": svc}, port=0).start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path, **params):
+    qs = urllib.parse.urlencode(params, doseq=True)
+    url = f"http://127.0.0.1:{srv.port}{path}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestStatusTiersRoute:
+    def test_tiers_route_both_fronts(self, fed_server):
+        status, body = _get(fed_server, "/api/v1/status/tiers",
+                            dataset="timeseries")
+        assert status == 200 and body["status"] == "success"
+        doc = body["data"]["timeseries"]
+        assert doc["federated"] is True
+        assert doc["memFloorMs"] == MEM_FLOOR
+        assert doc["rawFloorMs"] == RAW_FLOOR
+        by_tier = {t["tier"]: t for t in doc["tiers"]}
+        assert set(by_tier) == {MEMSTORE, OBJECTSTORE, DOWNSAMPLE}
+        assert by_tier[MEMSTORE]["series"] == 6
+        assert by_tier[OBJECTSTORE]["series"] == 6
+        assert by_tier[DOWNSAMPLE]["series"] == 6
+        assert by_tier[DOWNSAMPLE]["resolutionMs"] == RES
+        assert by_tier[OBJECTSTORE]["ceilMs"] == MEM_FLOOR
+        assert by_tier[OBJECTSTORE]["floorMs"] == RAW_FLOOR
+
+    def test_stats_all_over_http(self, fed_server):
+        status, body = _get(
+            fed_server, "/promql/timeseries/api/v1/query_range",
+            query="max_over_time(heap_usage[10m])", start=START + 900,
+            step=300, end=START + 5400, stats="all")
+        assert status == 200
+        tiers = body["queryStats"]["tiers"]
+        assert set(tiers) == {MEMSTORE, OBJECTSTORE, DOWNSAMPLE}
+        for b in tiers.values():
+            assert b["subqueries"] >= 1
+
+    def test_cli_tiers(self, fed_server, capsys):
+        from filodb_tpu.cli import main
+        rc = main(["--host", f"127.0.0.1:{fed_server.port}",
+                   "--dataset", "timeseries", "tiers"])
+        assert not rc
+        out = capsys.readouterr().out
+        assert "federated=True" in out
+        for tier in (MEMSTORE, OBJECTSTORE, DOWNSAMPLE):
+            assert tier in out
+
+    def test_cli_tiers_json(self, fed_server, capsys):
+        from filodb_tpu.cli import main
+        rc = main(["--host", f"127.0.0.1:{fed_server.port}",
+                   "--dataset", "timeseries", "tiers", "--json"])
+        assert not rc
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["federated"] is True
+
+
+class TestTieredPlannerUnit:
+    def test_timeless_plans_route_raw(self):
+        """Plans with no periodic grid (raw chunk export) bypass tier
+        routing entirely and go to the raw planner."""
+        ms, cs, keys = build_env(num_shards=1)
+        planner = build_planner(ms, cs, num_shards=1, with_ds=False)
+        from filodb_tpu.core.filters import ColumnFilter, Equals
+        from filodb_tpu.query import logical as lp
+        raw = lp.RawSeries(
+            (ColumnFilter("_metric_", Equals("heap_usage")),),
+            START * 1000, (START + 6000) * 1000)
+        ep = planner.materialize(raw)
+        assert "TierExec" not in repr(ep)  # no times → raw fan-out
+
+    def test_standalone_wires_tiered_planner_on_optin(self, tmp_path):
+        """FiloServer swaps in a TieredPlanner only when the operator
+        sets an explicit memstore horizon; without one the planner stays
+        untouched (synthetic-old-timestamp data would otherwise route to
+        a cold tier that has not been uploaded yet)."""
+        from filodb_tpu.config import ServerConfig
+        from filodb_tpu.standalone import FiloServer
+        base = {"node_name": "fed-node", "http_port": 0, "gateway_port": 0,
+                "datasets": {"timeseries": {
+                    "num_shards": 1,
+                    "store": {"max_chunk_size": 50}}}}
+        cfg = dict(base, data_dir=str(tmp_path / "a"),
+                   federation={"mem_retention_ms": 10**15})
+        p = tmp_path / "fed.json"
+        p.write_text(json.dumps(cfg))
+        srv = FiloServer(ServerConfig.load(str(p))).start()
+        try:
+            assert isinstance(srv.http.services["timeseries"].planner,
+                              TieredPlanner)
+        finally:
+            srv.shutdown()
+        cfg2 = dict(base, data_dir=str(tmp_path / "b"))
+        p2 = tmp_path / "nofed.json"
+        p2.write_text(json.dumps(cfg2))
+        srv2 = FiloServer(ServerConfig.load(str(p2))).start()
+        try:
+            assert not isinstance(srv2.http.services["timeseries"].planner,
+                                  TieredPlanner)
+        finally:
+            srv2.shutdown()
+
+    def test_single_cold_range_skips_stitch(self):
+        ms, cs, keys = build_env(num_shards=1)
+        planner = build_planner(ms, cs, num_shards=1, with_ds=False)
+        q = "max_over_time(heap_usage[10m])"
+        r, ep, ctx = run(ms, planner, q, START + 900, 300, START + 2400)
+        assert "TierExec" in repr(ep) and not isinstance(ep, StitchRvsExec)
+        assert r.result.num_series == 6
+        assert set(ctx.stats.tiers) == {OBJECTSTORE}

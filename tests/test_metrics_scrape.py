@@ -237,6 +237,17 @@ INGEST_OBS_NAMES = [
 ]
 
 
+# tiered query federation (query/federation.py, core/memstore/odp.py) —
+# counters registered when the HTTP front imports federation at boot; the
+# ODP cache-size GaugeFn renders 0 before any cache instance exists
+FEDERATION_NAMES = [
+    "filodb_federation_queries_total",
+    "filodb_federation_subqueries_total",
+    "filodb_odp_cache_chunks",
+    "odp_range_hits_total",
+]
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -360,6 +371,12 @@ class TestMetricsScrape:
         # above has landed
         missing_io = [n for n in INGEST_OBS_NAMES if n not in names_present]
         assert not missing_io, f"missing ingest-obs metrics: {missing_io}"
+
+        # tier-federation + ODP cache families render before any
+        # federated query (http front imports federation at boot)
+        missing_fed = [n for n in FEDERATION_NAMES
+                       if n not in names_present]
+        assert not missing_fed, f"missing federation metrics: {missing_fed}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])
