@@ -65,27 +65,86 @@ def _probe_once(timeout_s: int):
         return None, rec
 
 
+PROBE_CACHE_PATH = os.environ.get("FILODB_PROBE_CACHE",
+                                  "/tmp/filodb_probe_cache.json")
+PROBE_CACHE_TTL_S = int(os.environ.get("FILODB_PROBE_CACHE_TTL", "3600"))
+# total wall-clock allowed for probing (attempts + backoffs): BENCH_r05
+# burned ~16 minutes on 4 consecutive 120-300s tunnel timeouts before the
+# CPU fallback even started. The budget caps the worst case at one long
+# attempt plus maybe a short retry; the outcome cache makes every later
+# bench invocation (e.g. a --devices sweep's subprocesses) start instantly.
+PROBE_BUDGET_S = float(os.environ.get("FILODB_BENCH_PROBE_BUDGET_S", "150"))
+
+
+def _probe_cache_read(path: str = None, ttl_s: int = None):
+    """Last probe outcome, or None when absent/stale/unreadable."""
+    path = PROBE_CACHE_PATH if path is None else path
+    ttl_s = PROBE_CACHE_TTL_S if ttl_s is None else ttl_s
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if time.time() - float(rec["ts"]) > ttl_s:
+            return None
+        return rec
+    except Exception:
+        return None
+
+
+def _probe_cache_write(platform, path: str = None) -> None:
+    path = PROBE_CACHE_PATH if path is None else path
+    try:
+        with open(path, "w") as f:
+            json.dump({"platform": platform, "ts": time.time()}, f)
+    except OSError:
+        pass
+
+
 def _ensure_backend():
-    """Probe with retries + backoff; fall back to CPU only after all
-    attempts fail, so the bench always reports a number and the JSON shows
-    exactly when and how each probe attempt failed."""
+    """Probe with retries + backoff under a total time budget; fall back
+    to CPU once the budget is spent, so a CPU-only box starts in seconds
+    instead of minutes. The first decisive outcome (success or fallback)
+    is cached on disk with a TTL, so repeated bench runs skip the probe
+    entirely; the JSON probe log still records every attempt (or the cache
+    hit) so a CPU fallback stays auditable."""
     if os.environ.get("FILODB_BENCH_CPU"):
         _force_cpu()
         return "cpu", [{"outcome": "skipped", "detail": "FILODB_BENCH_CPU"}]
+    cached = _probe_cache_read()
+    if cached is not None:
+        plat = cached.get("platform")
+        if plat is None or plat == "cpu":
+            _force_cpu()
+            return "cpu", [{"outcome": "cached", "platform": "cpu",
+                            "detail": PROBE_CACHE_PATH}]
+        return plat, [{"outcome": "cached", "platform": plat,
+                       "detail": PROBE_CACHE_PATH}]
     attempts = int(os.environ.get("FILODB_BENCH_PROBE_ATTEMPTS", "4"))
     timeouts = [120, 240, 300, 300] + [300] * max(0, attempts - 4)
     backoffs = [20, 45, 90] + [120] * max(0, attempts - 4)
+    deadline = time.time() + PROBE_BUDGET_S
     log = []
     for i in range(attempts):
-        plat, rec = _probe_once(timeouts[i])
+        remaining = deadline - time.time()
+        if remaining <= 1:
+            log.append({"outcome": "budget_exhausted",
+                        "detail": f"{PROBE_BUDGET_S:.0f}s probe budget"})
+            break
+        plat, rec = _probe_once(min(timeouts[i], int(remaining)))
         log.append(rec)
         if plat is not None:
+            _probe_cache_write(plat)
             return plat, log
         sys.stderr.write(f"accelerator probe attempt {i + 1}/{attempts} "
                          f"failed ({rec['outcome']})\n")
         if i + 1 < attempts:
-            time.sleep(backoffs[min(i, len(backoffs) - 1)])
+            backoff = backoffs[min(i, len(backoffs) - 1)]
+            if time.time() + backoff >= deadline:
+                log.append({"outcome": "budget_exhausted",
+                            "detail": f"{PROBE_BUDGET_S:.0f}s probe budget"})
+                break
+            time.sleep(backoff)
     _force_cpu()
+    _probe_cache_write("cpu")
     return "cpu", log
 
 

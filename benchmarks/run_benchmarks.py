@@ -498,6 +498,12 @@ def _bench_federation():
     return bench_federation()
 
 
+def _bench_mesh_scaling(devices=None):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from mesh_scaling import DEFAULT_DEVICES, run_sweep
+    return run_sweep(tuple(devices) if devices else DEFAULT_DEVICES)
+
+
 ALL = {
     "ingestion": bench_ingestion,
     "hist_ingest": bench_hist_ingest,
@@ -519,6 +525,7 @@ ALL = {
     "tracing_overhead": _bench_tracing_overhead,
     "selfmon_overhead": _bench_selfmon_overhead,
     "federation": _bench_federation,
+    "mesh_scaling": _bench_mesh_scaling,
 }
 
 
@@ -526,9 +533,19 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--devices", default=None,
+                    help="comma-separated mesh widths; runs ONLY the "
+                         "mesh_scaling sweep at those sizes (each width in "
+                         "a child process, so --cpu is implied there)")
     args = ap.parse_args(argv)
     if args.cpu:
         _force_cpu()
+    if args.devices:
+        widths = [int(x) for x in args.devices.split(",") if x.strip()]
+        out = _bench_mesh_scaling(widths)
+        out["benchmark"] = "mesh_scaling"
+        print(json.dumps(out), flush=True)
+        return
     for name, fn in ALL.items():
         if args.only and name != args.only:
             continue

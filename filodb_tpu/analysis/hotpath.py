@@ -17,8 +17,14 @@ and frozen into the kernel, an outright correctness bug.
   ``np.random.*`` calls.
 
 A function counts as a kernel when decorated ``@jax.jit`` / ``@jit`` /
-``@partial(jax.jit, ...)``, when passed to ``pl.pallas_call``, or when
+``@partial(jax.jit, ...)``, when passed to ``pl.pallas_call``, when
+wrapped in call form (``jit(fn)`` / ``jax.jit(fn)`` or
+``shard_map(fn, ...)`` / ``_shard_map(fn, ...)`` — the factory idiom
+``parallel/dist_query.py`` builds its SPMD programs with), or when
 lexically nested inside a kernel.
+
+The pass covers ``query/engine/`` and ``parallel/`` — the two places
+jitted kernels live.
 """
 
 from __future__ import annotations
@@ -28,7 +34,7 @@ import ast
 from filodb_tpu.analysis.model import Finding
 from filodb_tpu.analysis.runner import AnalysisContext
 
-ENGINE_PREFIX = "filodb_tpu/query/engine/"
+ENGINE_PREFIXES = ("filodb_tpu/query/engine/", "filodb_tpu/parallel/")
 
 _SYNC_METHODS = {"item", "tolist", "block_until_ready"}
 _NP_SYNC_FUNCS = {"asarray", "array", "frombuffer"}
@@ -75,6 +81,35 @@ def _pallas_kernel_names(tree: ast.Module) -> set[str]:
             continue
         cands = list(node.args[:1]) + [kw.value for kw in node.keywords
                                        if kw.arg == "kernel"]
+        for c in cands:
+            if isinstance(c, ast.Name):
+                names.add(c.id)
+            elif isinstance(c, ast.Call):  # partial(kernel_fn, ...)
+                for a in c.args:
+                    if isinstance(a, ast.Name):
+                        names.add(a.id)
+    return names
+
+
+def _wrapped_kernel_names(tree: ast.Module) -> set[str]:
+    """Function names made kernels by call-form wrapping: the callee of
+    ``shard_map(f, ...)`` / ``_shard_map(f, ...)`` and call-form
+    ``jit(f)`` / ``jax.jit(f)`` (the ``parallel/dist_query.py`` factory
+    idiom, which the decorator check cannot see)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname in ("shard_map", "_shard_map"):
+            cands = list(node.args[:1]) + [kw.value for kw in node.keywords
+                                           if kw.arg == "f"]
+        elif fname == "jit":
+            cands = list(node.args[:1])
+        else:
+            continue
         for c in cands:
             if isinstance(c, ast.Name):
                 names.add(c.id)
@@ -143,9 +178,10 @@ class _KernelWalker(ast.NodeVisitor):
 def run(ctx: AnalysisContext) -> list[Finding]:
     out: list[Finding] = []
     for mi in ctx.modules:
-        if not mi.path.startswith(ENGINE_PREFIX):
+        if not mi.path.startswith(ENGINE_PREFIXES):
             continue
-        pallas = _pallas_kernel_names(mi.tree)
+        pallas = _pallas_kernel_names(mi.tree) | _wrapped_kernel_names(
+            mi.tree)
 
         def scan(fdef: ast.FunctionDef, symbol: str) -> None:
             w = _KernelWalker(mi.path, symbol, out)

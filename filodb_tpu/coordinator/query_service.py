@@ -250,6 +250,8 @@ class QueryService:
                         [plans[i] for i in meshable], self.memstore,
                         self.dataset, [stats_list[i] for i in meshable])
             except Exception as e:  # noqa: BLE001
+                from filodb_tpu.parallel.mesh_engine import _M_FALLBACK
+                _M_FALLBACK["error"].inc(len(meshable))
                 if not return_errors:
                     raise
                 mr = [None] * len(meshable)  # per-item exec fallback below
@@ -422,6 +424,11 @@ class QueryService:
                 with query_latency.time(), span("mesh-execute"):
                     data = self.mesh_engine.execute(self.memstore,
                                                     self.dataset, plan, stats)
+                if data is None:
+                    # recognized plan the kernels declined at execution
+                    # time (e.g. histogram batch under a non-sum agg)
+                    from filodb_tpu.parallel.mesh_engine import _M_FALLBACK
+                    _M_FALLBACK["declined"].inc()
                 if data is not None:  # None = shape the kernels don't cover
                     # materialize first so deferred compaction applies, then
                     # the same resource guard as the exec path (real count)
@@ -504,7 +511,11 @@ class QueryService:
         of the dataset must be resident in this process's memstore; a
         coordinator facade over remote members sees partial data and must
         use the scatter-gather path."""
-        return len(self.memstore.shards_for(self.dataset)) >= self.num_shards
+        ok = len(self.memstore.shards_for(self.dataset)) >= self.num_shards
+        if not ok and self.mesh_engine is not None:
+            from filodb_tpu.parallel.mesh_engine import _M_FALLBACK
+            _M_FALLBACK["shards"].inc()
+        return ok
 
     # ---- metadata -------------------------------------------------------
 

@@ -1,4 +1,5 @@
-"""Adaptive two-lane query engine: device mesh + host lane, cost-routed.
+"""Adaptive multi-lane query engine: sharded mesh, single-device, and
+host lanes, cost-routed.
 
 The serving problem this solves: a query's end-to-end latency on an
 accelerator is ``sync_floor + device_work``, where ``sync_floor`` is the
@@ -27,6 +28,14 @@ Routing mechanics (all measurement, no configuration):
   track workload drift, ingest churn, and tunnel weather without a single
   client ever paying the slow lane's latency (a bs=1 device probe through
   the tunnel would put the whole sync floor into that client's p99).
+
+Third lane — "single": when the default mesh spans multiple devices, a
+one-device mesh engine over the same backend. The sharded SPMD form pays
+per-call collective/dispatch overhead that a small batch never amortizes,
+while a big scan wants every device; which batch size flips between them
+is a property of the deployment (device count, interconnect, core count),
+so it is measured per batch-size bucket exactly like device-vs-host, not
+configured.
 """
 
 from __future__ import annotations
@@ -36,7 +45,9 @@ import queue
 import threading
 import time
 
-from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
+import numpy as np
+
+from filodb_tpu.parallel.mesh_engine import MeshQueryEngine, _M_ROUTED
 
 log = logging.getLogger(__name__)
 
@@ -100,11 +111,13 @@ class AdaptiveQueryEngine:
         self.device_engine = MeshQueryEngine(mesh=mesh, variant=variant)
         self._host_engine = None
         self._host_checked = False
+        self._single_engine = None
+        self._single_checked = False
         self._cost: dict[tuple, _LaneCost] = {}
         self._calls = 0
         self.sync_floor_s: float | None = None
-        self.routed = {"device": 0, "host": 0}
-        self.shadowed = {"device": 0, "host": 0}
+        self.routed = {"device": 0, "single": 0, "host": 0}
+        self.shadowed = {"device": 0, "single": 0, "host": 0}
         self._shadow_q: "queue.Queue|None" = None
         self._shadow_thread = None
 
@@ -150,7 +163,39 @@ class AdaptiveQueryEngine:
             self._host_engine = None
         return self._host_engine
 
+    def _single(self):
+        """Build the single-device lane lazily: a mesh engine pinned to a
+        1×1 mesh on the default backend, only meaningful when the sharded
+        mesh actually spans more than one device."""
+        if self._single_checked:
+            return self._single_engine
+        self._single_checked = True
+        try:
+            from filodb_tpu.parallel.mesh_engine import make_query_mesh
+
+            mesh = self.device_engine._ensure_mesh()
+            if int(np.prod(list(mesh.shape.values()))) > 1:
+                self._single_engine = MeshQueryEngine(
+                    mesh=make_query_mesh(n_devices=1, time_axis=1))
+                log.info("adaptive engine: single-device lane up")
+        except Exception:  # pragma: no cover — device init failure
+            log.exception("single-device lane unavailable")
+            self._single_engine = None
+        return self._single_engine
+
     # -- routing --
+
+    def _lanes(self) -> list:
+        lanes = ["device"]
+        if self._single() is not None:
+            lanes.append("single")
+        if self._host() is not None:
+            lanes.append("host")
+        return lanes
+
+    def _engine_for(self, lane: str):
+        return {"device": self.device_engine, "single": self._single_engine,
+                "host": self._host_engine}[lane]
 
     def _cost_of(self, lane: str, b: int) -> "_LaneCost":
         key = (lane, b)
@@ -160,20 +205,20 @@ class AdaptiveQueryEngine:
         return c
 
     def _route(self, n_queries: int) -> str:
-        if self._host() is None:
+        lanes = self._lanes()
+        if len(lanes) == 1:
             return "device"
         b = _bucket(n_queries)
         self._calls += 1
-        dev = self._cost_of("device", b).est
-        hst = self._cost_of("host", b).est
-        if hst is None:
-            # cold start: the host lane answers (it cannot be worse than
-            # one tunnel sync by much, and a shadow probe prices the
-            # device lane without any client waiting)
-            return "host"
-        if dev is None:
-            return "host"
-        return "device" if dev <= hst else "host"
+        ests = {la: self._cost_of(la, b).est for la in lanes}
+        known = {la: e for la, e in ests.items() if e is not None}
+        if not known:
+            # cold start: the cheapest-dispatch lane answers (host behind
+            # a tunnel, else the single-device lane — neither pays the
+            # sharded form's collective overhead) and shadow probes price
+            # the others without any client waiting
+            return "host" if "host" in lanes else "single"
+        return min(known, key=known.get)
 
     def _record(self, lane: str, n_queries: int, secs: float) -> None:
         self._cost_of(lane, _bucket(n_queries)).record(
@@ -189,8 +234,7 @@ class AdaptiveQueryEngine:
                 while True:
                     lane, lows, memstore, dataset = self._shadow_q.get()
                     try:
-                        eng = self.device_engine if lane == "device" \
-                            else self._host_engine
+                        eng = self._engine_for(lane)
                         t0 = time.perf_counter()
                         outs = eng.execute_lowered_many(lows, memstore,
                                                         dataset)
@@ -209,16 +253,21 @@ class AdaptiveQueryEngine:
 
     def _maybe_shadow(self, served_lane: str, plans: list, memstore,
                       dataset: str) -> None:
-        """Duplicate this batch onto the OTHER lane off the serving path
-        when its estimate is missing or stale-by-schedule. Never blocks;
-        drops the probe if one is already in flight."""
-        other = "host" if served_lane == "device" else "device"
-        if other == "host" and self._host_engine is None:
+        """Duplicate this batch onto ANOTHER lane off the serving path
+        when its estimate is missing or stale-by-schedule (rotating through
+        the others on schedule). Never blocks; drops the probe if one is
+        already in flight."""
+        others = [la for la in self._lanes() if la != served_lane]
+        if not others:
             return
         b = _bucket(len(plans))
-        due = self._cost_of(other, b).est is None \
-            or self._calls % self.SHADOW_EVERY == 0
-        if not due:
+        missing = [la for la in others
+                   if self._cost_of(la, b).est is None]
+        if missing:
+            other = missing[0]
+        elif self._calls % self.SHADOW_EVERY == 0:
+            other = others[(self._calls // self.SHADOW_EVERY) % len(others)]
+        else:
             return
         lows = [self.device_engine._lower(p) for p in plans]
         lows = [lo for lo in lows if lo is not None]
@@ -234,7 +283,7 @@ class AdaptiveQueryEngine:
 
     def execute(self, memstore, dataset: str, plan, stats=None):
         lane = self._route(1)
-        eng = self.device_engine if lane == "device" else self._host_engine
+        eng = self._engine_for(lane)
         t0 = time.perf_counter()
         out = eng.execute(memstore, dataset, plan, stats)
         if out is not None:
@@ -242,13 +291,14 @@ class AdaptiveQueryEngine:
             out.materialize()
             self._record(lane, 1, time.perf_counter() - t0)
             self.routed[lane] += 1
+            _M_ROUTED[lane].inc()
             self._maybe_shadow(lane, [plan], memstore, dataset)
         return out
 
     def execute_many(self, plans: list, memstore, dataset: str,
                      stats_list: list | None = None) -> list:
         lane = self._route(len(plans))
-        eng = self.device_engine if lane == "device" else self._host_engine
+        eng = self._engine_for(lane)
         t0 = time.perf_counter()
         outs = eng.execute_many(plans, memstore, dataset, stats_list)
         done = [o for o in outs if o is not None]
@@ -257,5 +307,6 @@ class AdaptiveQueryEngine:
                 o.materialize()
             self._record(lane, len(done), time.perf_counter() - t0)
             self.routed[lane] += 1
+            _M_ROUTED[lane].inc()
             self._maybe_shadow(lane, plans, memstore, dataset)
         return outs

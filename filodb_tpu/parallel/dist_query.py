@@ -49,38 +49,36 @@ def _window_bounds(ts, steps, window):
     return jax.vmap(bounds)(ts)
 
 
-def _local_rate_partials(ts, vals, counts_mask, steps, window,
-                         counter: bool = True, raw=None):
-    """Per-device window partials for the local (P_l, S_l) time block.
+def _counter_correct(v, valid):
+    """Block-local counter-reset correction (monotonized values): the
+    cumulative sum of every dropped previous value is added back, exactly
+    like ``kernels.range_eval`` / ``SeriesBatch.delta_host``. ``v`` must
+    already be masked (invalid positions zeroed)."""
+    prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
+    both = valid & jnp.concatenate(
+        [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
+    dropped = (v < prev) & both
+    corr = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
+    return v + corr
 
-    Returns [P_l, K, 7]: n, t_first, v_first, t_last, v_last, internal
-    (counter-corrected when ``counter``) increase, v_first_raw. Missing
-    => n=0 and sentinels.
 
-    ``raw`` [P_l, S_l] is the uncorrected value tensor when ``vals`` ride
-    the pre-corrected/rebased f32-precision lane (``SeriesBatch
-    .delta_host``); it feeds ONLY the ``v_first_raw`` field, whose sole
-    consumer is Prometheus' extrapolate-to-zero heuristic. The boundary
-    combine keeps using the rebased first/last (a large base would not
-    cancel exactly in f32 there).
+def _rate_partials_from_bounds(ts, vals, counts_mask, lo, hi, cv=None,
+                               raw=None):
+    """[P_l, K, 7] rate partials given precomputed window bounds.
+
+    ``cv`` is the (optionally counter-corrected) value tensor; when None
+    the masked values are used directly (delta / non-counter semantics).
+    Shared by the fused kernels (bounds computed in-kernel) and the split
+    prepare/bounds/step pipeline (bounds and correction cached across
+    queries) so both forms run the identical float ops.
     """
     dt = fdtype()
     valid = counts_mask
     v = jnp.where(valid, vals, 0.0).astype(dt)
-
-    lo, hi = _window_bounds(ts, steps, window)
+    if cv is None:
+        cv = v
     n = (hi - lo).astype(jnp.int32)
     has = hi > lo
-
-    if counter:
-        prev = jnp.concatenate([v[:, :1], v[:, :-1]], axis=1)
-        both = valid & jnp.concatenate(
-            [jnp.zeros_like(valid[:, :1]), valid[:, :-1]], axis=1)
-        dropped = (v < prev) & both
-        corr = jnp.cumsum(jnp.where(dropped, prev, 0.0), axis=1)
-        cv = v + corr
-    else:
-        cv = v
 
     def g(x, idx):
         return jnp.take_along_axis(x, idx, axis=1)
@@ -99,6 +97,30 @@ def _local_rate_partials(ts, vals, counts_mask, steps, window,
         v_first_raw = jnp.where(has, g(rawm, i_first), 0.0)
     return jnp.stack([n.astype(dt), t_first, v_first, t_last, v_last, inc,
                       v_first_raw], axis=-1)
+
+
+def _local_rate_partials(ts, vals, counts_mask, steps, window,
+                         counter: bool = True, raw=None):
+    """Per-device window partials for the local (P_l, S_l) time block.
+
+    Returns [P_l, K, 7]: n, t_first, v_first, t_last, v_last, internal
+    (counter-corrected when ``counter``) increase, v_first_raw. Missing
+    => n=0 and sentinels.
+
+    ``raw`` [P_l, S_l] is the uncorrected value tensor when ``vals`` ride
+    the pre-corrected/rebased f32-precision lane (``SeriesBatch
+    .delta_host``); it feeds ONLY the ``v_first_raw`` field, whose sole
+    consumer is Prometheus' extrapolate-to-zero heuristic. The boundary
+    combine keeps using the rebased first/last (a large base would not
+    cancel exactly in f32 there).
+    """
+    dt = fdtype()
+    valid = counts_mask
+    v = jnp.where(valid, vals, 0.0).astype(dt)
+    lo, hi = _window_bounds(ts, steps, window)
+    cv = _counter_correct(v, valid) if counter else None
+    return _rate_partials_from_bounds(ts, vals, counts_mask, lo, hi, cv=cv,
+                                      raw=raw)
 
 
 def _combine_time_partials(parts, steps, window, mode: str = "rate",
@@ -145,7 +167,10 @@ def _combine_time_partials(parts, steps, window, mode: str = "rate",
     avg_dur = sampled / jnp.maximum(n_tot - 1.0, 1.0)
     dur_start = t_first_s - range_start
     dur_end = range_end - t_last_s
-    if counter:
+    if counter and mode != "delta":
+        # Prometheus applies the extrapolate-to-zero heuristic only to
+        # rate/increase — delta on a counter schema gets the reset
+        # correction but never the clamp (kernels.range_eval agrees)
         dur_to_zero = jnp.where(
             total_inc > 0,
             sampled * v_first_g / jnp.maximum(total_inc, 1e-30), jnp.inf)
@@ -162,41 +187,62 @@ def _combine_time_partials(parts, steps, window, mode: str = "rate",
     return jnp.where(n_tot >= 2, out, jnp.nan)
 
 
-def _local_simple_partials(ts, vals, counts_mask, steps, window):
-    """Per-device partials for associative over-time functions:
-    [P_l, K, 7] = sum, count, min, max, last, t_last, sumsq
-    (+inf/-inf/0 sentinels)."""
+def _simple_prefixes(vals, counts_mask):
+    """Exclusive prefix sums (value, count, value²) [P_l, S_l+1] — the
+    per-batch state that makes every window sum an O(1) pair of gathers."""
     dt = fdtype()
     valid = counts_mask
     v = jnp.where(valid, vals, 0.0).astype(dt)
-
-    lo, hi = _window_bounds(ts, steps, window)
-
-    def g(x, idx):
-        return jnp.take_along_axis(x, idx, axis=1)
 
     def eprefix(x):
         return jnp.concatenate(
             [jnp.zeros(x.shape[:-1] + (1,), x.dtype), jnp.cumsum(x, -1)], -1)
 
-    csum = eprefix(v)
-    csum2 = eprefix(v * v)
-    cnt = eprefix(valid.astype(dt))
+    return eprefix(v), eprefix(valid.astype(dt)), eprefix(v * v)
+
+
+def _simple_partials_from_bounds(ts, vals, counts_mask, csum, cnt, csum2,
+                                 lo, hi, with_minmax: bool = True):
+    """[P_l, K, 7] simple-fn partials given precomputed prefixes + bounds:
+    sum, count, min, max, last, t_last, sumsq. ``with_minmax=False`` fills
+    the min/max fields with sentinels — window min/max have no prefix form
+    (the split pipeline excludes those fns and keeps the fused kernel)."""
+    dt = fdtype()
+    valid = counts_mask
+    v = jnp.where(valid, vals, 0.0).astype(dt)
+
+    def g(x, idx):
+        return jnp.take_along_axis(x, idx, axis=1)
+
     s = g(csum, hi) - g(csum, lo)
     s2 = g(csum2, hi) - g(csum2, lo)
     n = g(cnt, hi) - g(cnt, lo)
-    # blocked masked min/max (local S is small per device)
-    S = ts.shape[1]
-    sidx = jnp.arange(S)[None, None, :]
-    in_win = (sidx >= lo[:, :, None]) & (sidx < hi[:, :, None]) \
-        & valid[:, None, :]
-    mn = jnp.min(jnp.where(in_win, vals[:, None, :], jnp.inf), axis=2)
-    mx = jnp.max(jnp.where(in_win, vals[:, None, :], -jnp.inf), axis=2)
+    if with_minmax:
+        # blocked masked min/max (local S is small per device)
+        S = ts.shape[1]
+        sidx = jnp.arange(S)[None, None, :]
+        in_win = (sidx >= lo[:, :, None]) & (sidx < hi[:, :, None]) \
+            & valid[:, None, :]
+        mn = jnp.min(jnp.where(in_win, vals[:, None, :], jnp.inf), axis=2)
+        mx = jnp.max(jnp.where(in_win, vals[:, None, :], -jnp.inf), axis=2)
+    else:
+        mn = jnp.full_like(s, jnp.inf)
+        mx = jnp.full_like(s, -jnp.inf)
     has = n > 0
     last = jnp.where(has, g(v, jnp.maximum(hi - 1, 0)), 0.0)
     t_last = jnp.where(has, g(ts, jnp.maximum(hi - 1, 0)),
                        jnp.int32(-(2**31 - 1))).astype(dt)
     return jnp.stack([s, n, mn, mx, last, t_last, s2], axis=-1)
+
+
+def _local_simple_partials(ts, vals, counts_mask, steps, window):
+    """Per-device partials for associative over-time functions:
+    [P_l, K, 7] = sum, count, min, max, last, t_last, sumsq
+    (+inf/-inf/0 sentinels)."""
+    lo, hi = _window_bounds(ts, steps, window)
+    csum, cnt, csum2 = _simple_prefixes(vals, counts_mask)
+    return _simple_partials_from_bounds(ts, vals, counts_mask, csum, cnt,
+                                        csum2, lo, hi)
 
 
 def _sc_var(p):
@@ -331,6 +377,181 @@ def make_distributed_range_agg(mesh: Mesh, fn: str, num_groups: int,
             out_specs=P("shard", None) if agg is None else P(None, None),
             check_vma=False,
         )(*args)
+
+    return jax.jit(step)
+
+
+# ---- split pipeline: prepare / bounds / step --------------------------------
+#
+# The fused kernels above recompute two batch-level passes on EVERY query:
+# the counter-correction cumsum over [P, S] and the vmapped searchsorted
+# window bounds — together ~90% of a warm big-scan query's device time,
+# even though neither depends on anything but (batch version, step grid,
+# window). The split pipeline hoists both into separately-jitted sharded
+# programs whose outputs stay resident on device and are cached by the
+# mesh engine, so a warm query runs only the tiny step program (a handful
+# of gathers, the time-axis all_gather of [dt, P_l, K, 7] partials, and
+# the segment_sum + psum group reduce). All three programs are
+# shard_map-wrapped over the same (shard, time) mesh and reuse the exact
+# helper functions of the fused path, so results are identical.
+#
+# Window min/max are excluded: they have no prefix-summable form (the
+# fused kernel's blocked masked scan stays the per-query cost there).
+SPLIT_FNS = ("rate", "increase", "delta", "sum_over_time",
+             "count_over_time", "avg_over_time", "last_over_time",
+             "present_over_time", "stddev_over_time", "stdvar_over_time")
+_SIMPLE_SPLIT_FNS = tuple(f for f in SPLIT_FNS if f not in COUNTER_FNS)
+
+
+def make_mesh_prepare(mesh: Mesh, kind: str):
+    """Per-batch-version prepare program, sharded like the batch itself.
+
+    ``kind="counter"``: (vals, valid) → counter-corrected values [P, S]
+    (block-local cumsum, identical to the fused kernels' in-kernel
+    correction — cross-block resets are still handled by the combine's
+    boundary terms). This is the device-side replacement for the host
+    ``SeriesBatch.delta_host`` pre-pass when the value magnitudes make
+    direct f32 arithmetic safe (see mesh_engine._device_correction_ok).
+
+    ``kind="prefix"``: (vals, valid) → (csum, cnt, csum2) exclusive
+    prefixes, globally [P, S + dt] sharded (shard, time) — each time block
+    holds its local [P_l, S_l+1] prefix.
+    """
+
+    def prep(vals, valid):
+        def kernel(vals_l, valid_l):
+            dt = fdtype()
+            if kind == "counter":
+                v = jnp.where(valid_l, vals_l, 0.0).astype(dt)
+                return _counter_correct(v, valid_l)
+            return _simple_prefixes(vals_l, valid_l)
+
+        out_specs = P("shard", "time") if kind == "counter" \
+            else (P("shard", "time"),) * 3
+        return _shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P("shard", "time"), P("shard", "time")),
+            out_specs=out_specs, check_vma=False,
+        )(vals, valid)
+
+    return jax.jit(prep)
+
+
+def make_mesh_bounds(mesh: Mesh):
+    """Window-bounds program: (ts, steps, window) → (lo, hi) int32, each
+    time block's bounds local to its own [P_l, S_l] slice. Globally
+    [P, dt·K] sharded (shard, time); only ever consumed by step programs
+    with the same sharding, so the global layout is never materialized.
+    The vmapped double searchsorted here is the single most expensive op
+    of the whole query (~200 ms at P=8192, S=2048, K=256 on one CPU
+    device) — caching its output per (batch version, grid, window) is
+    what the split pipeline exists for."""
+
+    def bounds(ts, steps, window):
+        def kernel(ts_l, steps_r, window_r):
+            lo, hi = _window_bounds(ts_l, steps_r, window_r)
+            return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+        return _shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P("shard", "time"), P(None), P()),
+            out_specs=(P("shard", "time"), P("shard", "time")),
+            check_vma=False,
+        )(ts, steps, window)
+
+    return jax.jit(bounds)
+
+
+def make_mesh_eval_delta(mesh: Mesh, fn: str, counter: bool | None = None):
+    """Per-(batch version, grid, window) series evaluation for rate/
+    increase/delta given cached correction + bounds: gathers →
+    [P_l, K, 7] partials → all_gather over ``time`` → associative combine
+    with Prometheus extrapolation. Output [P, K] per-series values,
+    sharded on ``shard`` and replicated over ``time``.
+
+    The boundary gathers are the dominant remaining cost once bounds are
+    cached (XLA's gather is per-element on CPU: ~280 ms for the 7 gathers
+    at P=8192, K=256) and depend only on (data version, step grid,
+    window) — never on the query's grouping — so the engine caches THIS
+    stage's output and re-runs only the group reduce per query. ``cv``
+    (counter-corrected values) rides along for counter fns; ``raw``
+    accompanies the host-corrected lane exactly as in the fused kernels.
+
+    ``counter`` overrides the per-fn default: delta on a COUNTER schema
+    is reset-corrected like rate/increase (mirroring the exec
+    transformers), while delta on a gauge keeps raw differences."""
+    mode, default_counter = COUNTER_FNS[fn]
+    counter = default_counter if counter is None else counter
+
+    def ev(ts, vals, valid, lo, hi, steps, window, cv=None, raw=None):
+        def kernel(ts_l, vals_l, valid_l, lo_l, hi_l, steps_r,
+                   window_r, *rest):
+            cv_l = rest[0] if cv is not None else None
+            raw_l = rest[-1] if raw is not None else None
+            parts = _rate_partials_from_bounds(ts_l, vals_l, valid_l,
+                                               lo_l, hi_l, cv=cv_l,
+                                               raw=raw_l)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
+            return _combine_time_partials(gathered, steps_r, window_r,
+                                          mode=mode, counter=counter)
+
+        in_specs = (P("shard", "time"),) * 5 + (P(None), P())
+        args = (ts, vals, valid, lo, hi, steps, window)
+        for extra in (cv, raw):
+            if extra is not None:
+                in_specs += (P("shard", "time"),)
+                args += (extra,)
+        return _shard_map(
+            kernel, mesh=mesh, in_specs=in_specs,
+            out_specs=P("shard", None), check_vma=False,
+        )(*args)
+
+    return jax.jit(ev)
+
+
+def make_mesh_eval_simple(mesh: Mesh, fn: str):
+    """Per-(batch version, grid, window) series evaluation for the
+    prefix-summable over-time fns given cached prefixes + bounds (window
+    min/max have no prefix form and stay on the fused kernel). Output
+    [P, K] sharded on ``shard``, replicated over ``time`` — cached by the
+    engine like the delta-family eval."""
+    if fn not in _SIMPLE_SPLIT_FNS:
+        raise ValueError(f"{fn} has no split (prefix) form")
+    combine = _SIMPLE_COMBINE[fn]
+
+    def ev(ts, vals, valid, csum, cnt, csum2, lo, hi, steps, window):
+        def kernel(ts_l, vals_l, valid_l, cs_l, cn_l, cs2_l, lo_l, hi_l,
+                   steps_r, window_r):
+            parts = _simple_partials_from_bounds(
+                ts_l, vals_l, valid_l, cs_l, cn_l, cs2_l, lo_l, hi_l,
+                with_minmax=False)
+            gathered = lax.all_gather(parts, "time")  # [dt, P_l, K, 7]
+            return combine(gathered)
+
+        in_specs = (P("shard", "time"),) * 8 + (P(None), P())
+        return _shard_map(
+            kernel, mesh=mesh, in_specs=in_specs,
+            out_specs=P("shard", None), check_vma=False,
+        )(ts, vals, valid, csum, cnt, csum2, lo, hi, steps, window)
+
+    return jax.jit(ev)
+
+
+def make_mesh_group_reduce(mesh: Mesh, num_groups: int, agg: str):
+    """The per-query step of the split pipeline: cached per-series values
+    [P, K] → [G, K] grouped aggregate — one segment reduce plus one psum
+    over ``shard``, orders of magnitude less work than re-evaluating the
+    windows. This is ALL a warm repeat query runs on device."""
+
+    def step(series_vals, group_ids):
+        def kernel(res_l, gid_l):
+            return _group_reduce(res_l, gid_l, num_groups, agg)
+
+        return _shard_map(
+            kernel, mesh=mesh,
+            in_specs=(P("shard", None), P("shard")),
+            out_specs=P(None, None), check_vma=False,
+        )(series_vals, group_ids)
 
     return jax.jit(step)
 

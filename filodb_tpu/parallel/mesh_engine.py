@@ -31,15 +31,87 @@ scatter-gather exec tree for every other plan shape.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from filodb_tpu.parallel.dist_query import MESH_AGG_OPS
+from filodb_tpu.parallel.dist_query import (
+    MESH_AGG_OPS,
+    SPLIT_FNS,
+    make_mesh_bounds,
+    make_mesh_eval_delta,
+    make_mesh_eval_simple,
+    make_mesh_group_reduce,
+    make_mesh_prepare,
+)
 from filodb_tpu.query import logical as lp
 from filodb_tpu.query.model import QueryStats, RangeVectorKey, StepMatrix
+from filodb_tpu.utils.metrics import GaugeFn, get_counter
 
 log = logging.getLogger(__name__)
+
+# mesh-engine observability: plan recognition, dispatch form, cache
+# behavior, and adaptive lane routing (tests/test_metrics_scrape.py pins
+# these families). Registered eagerly so a scrape sees the families even
+# before the first mesh query.
+_M_SUPPORTED = get_counter(
+    "filodb_mesh_supported", help="plans recognized for mesh execution")
+_M_UNSUPPORTED = get_counter(
+    "filodb_mesh_unsupported", help="plans that fell back to the exec path "
+    "at recognition time")
+_M_DISPATCH = {f: get_counter("filodb_mesh_dispatch", {"form": f},
+                              help="mesh batch dispatches by kernel form "
+                              "(split pipeline vs fused one-shot)")
+               for f in ("split", "fused")}
+_M_COMPILE = {e: get_counter("filodb_mesh_compile_cache", {"event": e},
+                             help="compiled mesh program cache hits/misses")
+              for e in ("hit", "miss")}
+_M_BATCH = {e: get_counter("filodb_mesh_batch_cache", {"event": e},
+                           help="decoded+placed batch cache hits/misses")
+            for e in ("hit", "miss")}
+_M_BOUNDS = {e: get_counter("filodb_mesh_bounds_cache", {"event": e},
+                            help="cached window-bounds (searchsorted) "
+                            "hits/misses on the split pipeline")
+             for e in ("hit", "miss")}
+_M_EVAL = {e: get_counter("filodb_mesh_eval_cache", {"event": e},
+                          help="cached per-series window evaluation "
+                          "hits/misses on the split pipeline")
+           for e in ("hit", "miss")}
+_M_FALLBACK = {r: get_counter("filodb_mesh_fallback", {"reason": r},
+                              help="mesh dispatches that fell back to the "
+                              "exec path after recognition")
+               for r in ("declined", "error", "shards")}
+_M_ROUTED = {la: get_counter("filodb_mesh_routed", {"lane": la},
+                             help="adaptive engine lane routing decisions")
+             for la in ("device", "single", "host")}
+GaugeFn("filodb_mesh_hit_rate",
+        lambda: _M_SUPPORTED.value / t
+        if (t := _M_SUPPORTED.value + _M_UNSUPPORTED.value) else 0.0,
+        help="fraction of inspected plans the mesh engine recognized")
+
+# f32 device arithmetic keeps ≥4 fractional bits of absolute precision for
+# values below 2^20 (ulp ≤ 2^-4 = 0.0625); above that, counter deltas and
+# gauge cancellation degrade and the f64 host pre-correction lane
+# (SeriesBatch.delta_host) takes over. Well under the 2^24 integer-exact
+# limit, so integral counters are bit-exact either way.
+F32_SAFE_MAX = float(1 << 20)
+
+
+def _device_correction_ok(vals: np.ndarray) -> bool:
+    """May counter-reset correction / delta cancellation run directly on
+    the device value dtype? Always under x64; under f32, only when every
+    finite value is small enough that window-scale differences keep
+    absolute precision (see ``F32_SAFE_MAX``). One host pass per decoded
+    batch — amortized across every query the cached batch serves."""
+    import jax.numpy as jnp
+
+    from filodb_tpu.query.engine.kernels import fdtype
+
+    if fdtype() == jnp.float64:
+        return True
+    finite = vals[np.isfinite(vals)]
+    return finite.size == 0 or float(np.abs(finite).max()) < F32_SAFE_MAX
 
 # range functions with associative mesh combines (dist_query kernels)
 MESH_FNS = ("rate", "increase", "delta", "sum_over_time", "count_over_time",
@@ -135,6 +207,20 @@ class MeshQueryEngine:
     # transfer per chunk — ~one tunnel RTT each on the axon backend)
     _grid_cache: dict = field(default_factory=dict)
     _grid_cache_cap: int = 64
+    # split-pipeline device caches: prepared per-batch-version arrays
+    # (counter correction / prefix sums), window bounds per (batch
+    # version, grid, window), and per-series evaluated windows per (batch
+    # version, grid, window, fn) — the passes that otherwise dominate a
+    # warm query's device time (see dist_query "split pipeline" section).
+    # Caps are deliberately small: entries scale with the batch (bounds
+    # ~2·P·K int32, eval ~P·K float), so a handful of distinct dashboards
+    # already costs hundreds of MB at big-scan sizes.
+    _prep_cache: dict = field(default_factory=dict)
+    _prep_cache_cap: int = 4
+    _bounds_cache: dict = field(default_factory=dict)
+    _bounds_cache_cap: int = 16
+    _eval_cache: dict = field(default_factory=dict)
+    _eval_cache_cap: int = 32
     # mesh-hit accounting (VERDICT r2 #4: logged mesh-hit rate)
     hits: int = 0
     misses: int = 0
@@ -152,11 +238,16 @@ class MeshQueryEngine:
 
     def supports(self, plan) -> bool:
         ok = self._lower(plan) is not None
+        self._note(ok)
+        return ok
+
+    def _note(self, ok: bool) -> None:
         if ok:
             self.hits += 1
+            _M_SUPPORTED.inc()
         else:
             self.misses += 1
-        return ok
+            _M_UNSUPPORTED.inc()
 
     @property
     def hit_rate(self) -> float:
@@ -268,11 +359,9 @@ class MeshQueryEngine:
         results: list = [None] * len(plans)
         groups: dict[tuple, list[int]] = {}
         for i, low in enumerate(lows):
+            self._note(low is not None)
             if low is not None:
-                self.hits += 1
                 groups.setdefault(low.signature, []).append(i)
-            else:
-                self.misses += 1
         for idxs in groups.values():
             outs = self.execute_lowered_many(
                 [lows[i] for i in idxs], memstore, dataset,
@@ -311,11 +400,26 @@ class MeshQueryEngine:
 
         shards = memstore.shards_for(dataset)
         version = sum(s.data_version for s in shards)
+        # split pipeline (prepare/bounds/step, dist_query.py): correction
+        # and window bounds are cached on device across queries instead of
+        # recomputed per call. Window min/max have no prefix form and the
+        # ring variant is a fused-only memory optimization — both keep the
+        # fused kernels. FILODB_MESH_SPLIT=0 is the safety valve (also how
+        # benchmarks measure the pre-split baseline).
+        use_split = (self.variant != "ring" and fn in SPLIT_FNS
+                     and os.environ.get("FILODB_MESH_SPLIT", "1") != "0")
         # delta-family fns place the pre-corrected/rebased f64→f32 value
         # lane (SeriesBatch.delta_host) instead of raw values, so the lane
         # kind is part of the cache key ("corrected" also implies counter
-        # reset correction; "rebased" is shift-only, for delta on gauges)
-        lane = ("corrected" if fn in ("rate", "increase")
+        # reset correction; "rebased" is shift-only, for delta on gauges —
+        # delta on a COUNTER schema is reset-corrected too, mirroring the
+        # exec transformers, decided once the matched schema is known).
+        # On the split pipeline ("split" lane) the correction instead runs
+        # ON DEVICE over the raw placed values whenever the batch's
+        # magnitudes make that safe (_device_correction_ok) — the host
+        # pre-pass survives only as the big-magnitude fallback.
+        lane = ("split" if use_split and fn in ("rate", "increase", "delta")
+                else "corrected" if fn in ("rate", "increase")
                 else "rebased" if fn == "delta" else "raw")
         # the agg NAME is part of the key (not just agg-vs-none): a
         # histogram batch cached under sum must not satisfy a later
@@ -323,9 +427,15 @@ class MeshQueryEngine:
         # exec path, and the cache-hit branch must re-make that decision
         ckey = (dataset, str(low0.filters), chunk_start, chunk_end,
                 low0.by, low0.without, low0.agg, lane)
+        # split-pipeline device caches (prepare/bounds/eval) consume only
+        # the data tensors, never the grouping — keyed WITHOUT agg/by so
+        # e.g. sum() and avg() over the same rate() share one evaluation
+        dkey = (dataset, str(low0.filters), chunk_start, chunk_end, lane)
         cached = self._batch_cache.get(ckey)
+        _M_BATCH["hit" if cached is not None and cached[0] == version
+                 else "miss"].inc()
         if cached is not None and cached[0] == version:
-            _, batch, keys, gids, out_keys, placed = cached
+            _, batch, keys, gids, out_keys, placed, is_counter = cached
             if batch is None:
                 return [StepMatrix.empty(steps_array(lo.start, lo.step,
                                                      lo.end))
@@ -361,12 +471,17 @@ class MeshQueryEngine:
                                 extra_by_obj[id(p)] = ec
                 parts.extend(sparts)
             if not parts:
-                self._cache_put(ckey, (version, None, [], None, [], None))
+                self._cache_put(ckey, (version, None, [], None, [], None,
+                                       False))
                 return [StepMatrix.empty(steps_array(lo.start, lo.step,
                                                      lo.end))
                         for lo in lows]
             batch = build_batch(parts, chunk_start, chunk_end,
                                 extra_by_obj=extra_by_obj or None)
+            # counter-ness of the scanned value column (same source the
+            # exec path reads): decides delta's reset-correction semantics
+            sdata = parts[0].schema.data
+            is_counter = bool(sdata.columns[sdata.value_column].is_counter)
             if batch.is_histogram and low0.agg not in (None, "sum"):
                 # bucket-wise semantics only defined for sum (and raw)
                 return [None] * len(lows)
@@ -394,6 +509,9 @@ class MeshQueryEngine:
         # output un-flattens to [rows, K, B].
         B = batch.vals.shape[2] \
             if (batch is not None and batch.is_histogram) else 1
+        # delta mirrors the exec kernels: reset-corrected on counter
+        # schemas, raw differences on gauges (rate/increase always correct)
+        delta_counter = fn == "delta" and is_counter
         G = len(out_keys)
         Gp = _pow2(max(G * B, 1))
 
@@ -418,11 +536,18 @@ class MeshQueryEngine:
             raw_vals = None
             if lane == "raw":
                 mesh_vals = batch.vals
+            elif lane == "split" and _device_correction_ok(batch.vals):
+                # raw values go straight to the device; the counter
+                # correction is fused into the cached prepare program
+                # (make_mesh_prepare), so no host pre-pass runs at all
+                mesh_vals = batch.vals
             else:
-                mesh_vals = batch.delta_host(counter=(lane == "corrected"))
-                if lane == "corrected":
+                counter = fn in ("rate", "increase") or delta_counter
+                mesh_vals = batch.delta_host(counter=counter)
+                if fn in ("rate", "increase"):
                     # rate/increase also need the raw values for the
-                    # extrapolate-to-zero clamp (heuristic-only reference)
+                    # extrapolate-to-zero clamp (heuristic-only reference;
+                    # delta never clamps, even when reset-corrected)
                     raw_vals = batch.vals
             bt_ts, bt_counts = batch.ts, batch.counts
             if B > 1:
@@ -446,17 +571,24 @@ class MeshQueryEngine:
             placed = shard_batch_arrays(mesh, ts_p, vals_p, valid, gid_p,
                                         raw_p)
             self._cache_put(ckey, (version, batch, keys, gids, out_keys,
-                                   placed))
+                                   placed, is_counter))
 
         agg = low0.agg
-        key = (fn, agg, Gp if agg else None, self.variant)
-        step_fn = self._fns.get(key)
-        if step_fn is None:
-            if self.variant == "ring" and fn == "rate" and agg == "sum":
-                step_fn = make_distributed_sum_rate_ring(mesh, Gp)
-            else:
-                step_fn = make_distributed_range_agg(mesh, fn, Gp, agg)
-            self._fns[key] = step_fn
+        if use_split:
+            # per-query work is ONLY the group reduce; window evaluation
+            # is served from the eval cache (see the chunk loop below)
+            step_fn = None if agg is None else self._get_fn(
+                ("split-reduce", agg, Gp),
+                lambda: make_mesh_group_reduce(mesh, Gp, agg))
+        elif self.variant == "ring" and fn == "rate" and agg == "sum":
+            step_fn = self._get_fn(
+                (fn, agg, Gp if agg else None, self.variant),
+                lambda: make_distributed_sum_rate_ring(mesh, Gp))
+        else:
+            step_fn = self._get_fn(
+                (fn, agg, Gp if agg else None, self.variant),
+                lambda: make_distributed_range_agg(mesh, fn, Gp, agg))
+        _M_DISPATCH["split" if use_split else "fused"].inc()
 
         import jax
         import jax.numpy as jnp
@@ -470,6 +602,18 @@ class MeshQueryEngine:
         win_d = jax.device_put(np.int32(low0.window), repl)
         ts_d, vals_d, valid_d, gid_d = placed[:4]
         raw_d = placed[4] if len(placed) > 4 else None
+
+        # split pipeline: prepared per-version device arrays (correction /
+        # prefixes), reused by every query over this batch version
+        split_cv = None
+        split_prefix = None
+        if use_split:
+            if fn in ("rate", "increase") or delta_counter:
+                split_cv = self._prepared(dkey, version, "counter", mesh,
+                                          vals_d, valid_d)
+            elif fn != "delta":
+                split_prefix = self._prepared(dkey, version, "prefix", mesh,
+                                              vals_d, valid_d)
 
         # Fixed call shapes: compile storms would otherwise follow the batch
         # size (every distinct ΣKp is a fresh program). Queries grouped by
@@ -505,7 +649,13 @@ class MeshQueryEngine:
                         self._grid_cache.pop(next(iter(self._grid_cache)))
                     grid_d = self._grid_cache[gkey] = jax.device_put(
                         blob, repl)
-                if raw_d is not None:
+                if use_split:
+                    ev_d = self._series_eval_cached(
+                        dkey, version, low0.window, gkey, fn, mesh, ts_d,
+                        vals_d, valid_d, grid_d, win_d, split_cv,
+                        split_prefix, raw_d, delta_counter)
+                    out = ev_d if step_fn is None else step_fn(ev_d, gid_d)
+                elif raw_d is not None:
                     out = step_fn(ts_d, vals_d, valid_d, gid_d, grid_d,
                                   win_d, raw_d)
                 else:
@@ -551,6 +701,92 @@ class MeshQueryEngine:
         if len(self._batch_cache) >= self._batch_cache_cap:
             self._batch_cache.pop(next(iter(self._batch_cache)))
         self._batch_cache[ckey] = entry
+
+    def _get_fn(self, key, builder):
+        """Compiled-program cache with hit/miss accounting."""
+        fn = self._fns.get(key)
+        if fn is None:
+            _M_COMPILE["miss"].inc()
+            fn = self._fns[key] = builder()
+        else:
+            _M_COMPILE["hit"].inc()
+        return fn
+
+    def _prepared(self, dkey, version, kind, mesh, vals_d, valid_d):
+        """Device-resident prepared arrays for the split pipeline, one
+        entry per (batch cache key, kind), invalidated by data version.
+        ``kind="counter"``: corrected values; ``"prefix"``: (csum, cnt,
+        csum2) exclusive prefixes."""
+        key = (dkey, kind)
+        hit = self._prep_cache.get(key)
+        if hit is not None and hit[0] == version:
+            return hit[1]
+        prep_fn = self._get_fn(("prep", kind),
+                               lambda: make_mesh_prepare(mesh, kind))
+        out = prep_fn(vals_d, valid_d)
+        if len(self._prep_cache) >= self._prep_cache_cap:
+            self._prep_cache.pop(next(iter(self._prep_cache)))
+        self._prep_cache[key] = (version, out)
+        return out
+
+    def _window_bounds_cached(self, dkey, version, window, grid_bytes,
+                              mesh, ts_d, grid_d, win_d):
+        """Cached (lo, hi) window bounds per (batch version, step grid,
+        window) — the vmapped double searchsorted is the dominant per-query
+        cost of the fused path, and its inputs change only when data or
+        the query grid do."""
+        bkey = (dkey, version, window, grid_bytes)
+        hit = self._bounds_cache.get(bkey)
+        if hit is not None:
+            _M_BOUNDS["hit"].inc()
+            return hit
+        _M_BOUNDS["miss"].inc()
+        bounds_fn = self._get_fn(("bounds",), lambda: make_mesh_bounds(mesh))
+        out = bounds_fn(ts_d, grid_d, win_d)
+        if len(self._bounds_cache) >= self._bounds_cache_cap:
+            self._bounds_cache.pop(next(iter(self._bounds_cache)))
+        self._bounds_cache[bkey] = out
+        return out
+
+    def _series_eval_cached(self, dkey, version, window, grid_bytes, fn,
+                            mesh, ts_d, vals_d, valid_d, grid_d, win_d,
+                            split_cv, split_prefix, raw_d,
+                            delta_counter=False):
+        """Cached per-series evaluated windows [P, K] per (batch version,
+        step grid, window, fn) — the boundary gathers + time combine that
+        remain the dominant per-query device cost once bounds are cached.
+        Nothing here depends on the query's grouping, so every agg over
+        the same inner range function shares one entry and a warm query
+        runs only the group reduce."""
+        ekey = (dkey, version, window, grid_bytes, fn)
+        hit = self._eval_cache.get(ekey)
+        if hit is not None:
+            _M_EVAL["hit"].inc()
+            return hit
+        _M_EVAL["miss"].inc()
+        lo_d, hi_d = self._window_bounds_cached(dkey, version, window,
+                                                grid_bytes, mesh, ts_d,
+                                                grid_d, win_d)
+        if fn in ("rate", "increase", "delta"):
+            # delta-on-counter compiles its own corrected variant; the
+            # dkey's filters pin the schema, so the eval cache key needs
+            # no extra discriminator
+            counter = fn in ("rate", "increase") or delta_counter
+            ev_fn = self._get_fn(
+                ("eval", fn, counter),
+                lambda: make_mesh_eval_delta(mesh, fn, counter=counter))
+            out = ev_fn(ts_d, vals_d, valid_d, lo_d, hi_d, grid_d, win_d,
+                        cv=split_cv, raw=raw_d)
+        else:
+            ev_fn = self._get_fn(("eval", fn),
+                                 lambda: make_mesh_eval_simple(mesh, fn))
+            cs_d, cn_d, cs2_d = split_prefix
+            out = ev_fn(ts_d, vals_d, valid_d, cs_d, cn_d, cs2_d, lo_d,
+                        hi_d, grid_d, win_d)
+        if len(self._eval_cache) >= self._eval_cache_cap:
+            self._eval_cache.pop(next(iter(self._eval_cache)))
+        self._eval_cache[ekey] = out
+        return out
 
     @staticmethod
     def _group_key(k: RangeVectorKey, low: _Lowered) -> RangeVectorKey:

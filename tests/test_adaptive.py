@@ -34,10 +34,11 @@ def _service(engine="adaptive"):
 
 
 class TestAdaptiveOnCpu:
-    def test_degenerates_to_device_lane_on_cpu(self):
+    def test_degenerates_to_device_lanes_on_cpu(self):
         """With a cpu default backend there is no separate host lane: the
-        adaptive engine must route everything to the device engine and
-        produce results identical to engine="mesh"."""
+        adaptive engine must route everything to the device-backend lanes
+        (sharded mesh or the single-device form) and produce results
+        identical to engine="mesh"."""
         svc = _service("adaptive")
         ref = _service("mesh")
         q = ("sum(rate(http_requests_total[5m]))", START + 900, 60,
@@ -49,7 +50,8 @@ class TestAdaptiveOnCpu:
         eng = svc.mesh_engine
         assert isinstance(eng, AdaptiveQueryEngine)
         assert eng._host() is None
-        assert eng.routed["device"] >= 1 and eng.routed["host"] == 0
+        assert eng.routed["device"] + eng.routed["single"] >= 1
+        assert eng.routed["host"] == 0
 
     def test_execute_many_parity(self):
         svc = _service("adaptive")

@@ -1,0 +1,95 @@
+"""Accelerator-probe fast-fail helpers in ``bench.py``: outcome cache
+(TTL disk record) and the total probe time budget. Pure host-side logic —
+no jax, no subprocess probes (``_probe_once`` is stubbed)."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def isolated(tmp_path, monkeypatch):
+    """Fresh cache path, no env short-circuits, no real CPU forcing."""
+    monkeypatch.setattr(bench, "PROBE_CACHE_PATH",
+                        str(tmp_path / "probe_cache.json"))
+    monkeypatch.setattr(bench, "_force_cpu", lambda: None)
+    monkeypatch.delenv("FILODB_BENCH_CPU", raising=False)
+    monkeypatch.delenv("FILODB_BENCH_PROBE_ATTEMPTS", raising=False)
+    return tmp_path
+
+
+class TestProbeCache:
+    def test_round_trip(self, isolated):
+        bench._probe_cache_write("tpu")
+        rec = bench._probe_cache_read()
+        assert rec["platform"] == "tpu"
+
+    def test_absent_and_corrupt_return_none(self, isolated):
+        assert bench._probe_cache_read() is None
+        with open(bench.PROBE_CACHE_PATH, "w") as f:
+            f.write("not json{")
+        assert bench._probe_cache_read() is None
+
+    def test_stale_entry_expires(self, isolated):
+        with open(bench.PROBE_CACHE_PATH, "w") as f:
+            json.dump({"platform": "tpu", "ts": time.time() - 10_000}, f)
+        assert bench._probe_cache_read() is None
+        assert bench._probe_cache_read(ttl_s=100_000)["platform"] == "tpu"
+
+
+class TestEnsureBackend:
+    def test_env_short_circuit(self, isolated, monkeypatch):
+        monkeypatch.setenv("FILODB_BENCH_CPU", "1")
+        monkeypatch.setattr(bench, "_probe_once", lambda t: (
+            pytest.fail("probe must not run under FILODB_BENCH_CPU")))
+        plat, log = bench._ensure_backend()
+        assert plat == "cpu"
+        assert log[0]["outcome"] == "skipped"
+
+    def test_cached_outcome_skips_probe(self, isolated, monkeypatch):
+        bench._probe_cache_write("cpu")
+        monkeypatch.setattr(bench, "_probe_once", lambda t: (
+            pytest.fail("probe must not run on a cache hit")))
+        plat, log = bench._ensure_backend()
+        assert plat == "cpu"
+        assert log[0]["outcome"] == "cached"
+
+    def test_success_is_cached(self, isolated, monkeypatch):
+        monkeypatch.setattr(bench, "_probe_once",
+                            lambda t: ("tpu", {"outcome": "ok",
+                                               "platform": "tpu"}))
+        plat, log = bench._ensure_backend()
+        assert plat == "tpu"
+        assert bench._probe_cache_read()["platform"] == "tpu"
+
+    def test_zero_budget_falls_back_immediately(self, isolated, monkeypatch):
+        monkeypatch.setattr(bench, "PROBE_BUDGET_S", 0.0)
+        monkeypatch.setattr(bench, "_probe_once", lambda t: (
+            pytest.fail("no probe may start with the budget spent")))
+        plat, log = bench._ensure_backend()
+        assert plat == "cpu"
+        assert log[-1]["outcome"] == "budget_exhausted"
+        # the CPU fallback is cached too: the next run starts instantly
+        assert bench._probe_cache_read()["platform"] == "cpu"
+
+    def test_backoff_respects_budget(self, isolated, monkeypatch):
+        """A failed attempt whose backoff would overshoot the budget must
+        fall back without sleeping (BENCH_r05 burned ~16 min here)."""
+        monkeypatch.setattr(bench, "PROBE_BUDGET_S", 5.0)
+        monkeypatch.setattr(bench, "_probe_once",
+                            lambda t: (None, {"outcome": "timeout"}))
+        monkeypatch.setattr(bench.time, "sleep", lambda s: (
+            pytest.fail("must not sleep past the probe budget")))
+        t0 = time.time()
+        plat, log = bench._ensure_backend()
+        assert plat == "cpu"
+        assert time.time() - t0 < 2.0
+        assert [r["outcome"] for r in log] == ["timeout",
+                                               "budget_exhausted"]

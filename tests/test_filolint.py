@@ -552,6 +552,43 @@ class TestHotPath:
             """})
         assert out == []
 
+    def test_shard_map_wrapped_kernel_in_parallel(self, tmp_path):
+        """The dist_query factory idiom: an undecorated closure becomes a
+        kernel by being the first argument of shard_map/_shard_map — and
+        parallel/ is in scope alongside query/engine/."""
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/parallel/d.py": """
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def make_step(mesh):
+                def step(ts, vals):
+                    def kernel(ts_l, vals_l):
+                        return vals_l.sum() + float(ts_l.shape)
+                    return _shard_map(kernel, mesh=mesh, in_specs=(),
+                                      out_specs=())(ts, vals)
+                return jax.jit(step)
+            """})
+        assert codes(out) == ["HP301"]
+        assert out[0].symbol == "make_step.step.kernel"
+
+    def test_jit_call_form_wrapped_kernel(self, tmp_path):
+        """``jit(fn)`` call form (no decorator) marks ``fn`` a kernel;
+        the jitted wrapper's own body is scanned too."""
+        out = run_pass(tmp_path, hotpath, {
+            "filodb_tpu/parallel/j.py": """
+            import time
+            from jax import jit
+
+            def prep(vals):
+                t = time.time()
+                return vals + t
+
+            prep_jitted = jit(prep)
+            """})
+        assert codes(out) == ["HP302"]
+        assert out[0].symbol == "prep"
+
 
 # --------------------------------------------------------------------------
 # RL4xx resource lifecycle

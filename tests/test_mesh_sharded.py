@@ -1,0 +1,290 @@
+"""Split-pipeline mesh execution: prepare/bounds/eval caches + per-query
+group reduce (``parallel/dist_query.py`` / ``parallel/mesh_engine.py``).
+
+The split form must be indistinguishable from the fused one-shot kernels
+in every observable way: bitwise-identical values (both forms run the
+same helper float ops in the same order, on the same 8-virtual-device
+mesh the conftest forces), the same exec-path parity, and the same
+result-cache signatures — the kernel form is an engine implementation
+detail, never part of a query's identity.
+"""
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.parallel.dist_query import SPLIT_FNS
+from filodb_tpu.parallel.mesh_engine import (
+    _M_DISPATCH,
+    _M_EVAL,
+    F32_SAFE_MAX,
+    MeshQueryEngine,
+    _device_correction_ok,
+)
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    machine_metrics_series,
+)
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+
+
+def build_store(kind="counter", n_series=37, n_samples=240):
+    """37 series: not a multiple of any mesh axis, so the shard axis pads;
+    240 samples over 4 shards exercises the time axis too."""
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=100,
+                                              groups_per_shard=4))
+    if kind == "counter":
+        keys = counter_series(n_series, metric="http_requests_total")
+        stream = counter_stream(keys, n_samples, start_ms=START * 1000,
+                                interval_ms=10_000, seed=7)
+    else:
+        keys = machine_metrics_series(n_series, metric="gauge_metric")
+        stream = gauge_stream(keys, n_samples, start_ms=START * 1000,
+                              interval_ms=10_000, seed=7)
+    ingest_routed(ms, "timeseries", stream, NUM_SHARDS, spread=1)
+    # uneven tails: a third of the series keep reporting for another 40
+    # samples, so per-series counts (and the padded valid mask) differ
+    extra = counter_stream(keys[::3],
+                           40, start_ms=(START + n_samples * 10) * 1000,
+                           interval_ms=10_000, seed=8) \
+        if kind == "counter" else \
+        gauge_stream(keys[::3], 40,
+                     start_ms=(START + n_samples * 10) * 1000,
+                     interval_ms=10_000, seed=8)
+    ingest_routed(ms, "timeseries", extra, NUM_SHARDS, spread=1)
+    return ms
+
+
+def both_forms(ms, query, monkeypatch, start=START + 600, step=60,
+               end=START + 2800):
+    """Evaluate one query through the SAME engine in split and fused
+    form (the result cache is off on a bare QueryService, so both runs
+    hit the device)."""
+    svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                       engine="mesh")
+    eng = svc.mesh_engine
+    plan = parse_query(query, TimeStepParams(start, step, end))
+    low = eng._lower(plan)
+    assert low is not None, f"{query} must lower"
+    monkeypatch.setenv("FILODB_MESH_SPLIT", "1")
+    split = eng.execute_lowered_many([low], ms, "timeseries")[0]
+    monkeypatch.setenv("FILODB_MESH_SPLIT", "0")
+    fused = eng.execute_lowered_many([low], ms, "timeseries")[0]
+    return split.materialize(), fused.materialize(), svc
+
+
+def assert_bitwise(a, b):
+    assert [str(k) for k in a.keys] == [str(k) for k in b.keys]
+    np.testing.assert_array_equal(a.steps_ms, b.steps_ms)
+    assert np.asarray(a.values).tobytes() == np.asarray(b.values).tobytes()
+
+
+def assert_ulps(a, b):
+    """Equal to f64 rounding error (scale-relative: deltas of large gauge
+    values cancel to near zero, so a tiny absolute term is needed too)."""
+    assert [str(k) for k in a.keys] == [str(k) for k in b.keys]
+    np.testing.assert_array_equal(a.steps_ms, b.steps_ms)
+    np.testing.assert_allclose(np.asarray(a.values), np.asarray(b.values),
+                               rtol=1e-12, atol=1e-8, equal_nan=True)
+
+
+def assert_close(a, b):
+    assert sorted(map(str, a.keys)) == sorted(map(str, b.keys))
+    oa = np.argsort([str(k) for k in a.keys])
+    ob = np.argsort([str(k) for k in b.keys])
+    np.testing.assert_allclose(np.asarray(a.values)[oa],
+                               np.asarray(b.values)[ob],
+                               rtol=1e-9, atol=1e-7, equal_nan=True)
+
+
+class TestSplitEqualsFused:
+    """Every split-eligible fn, split vs fused, bitwise under x64."""
+
+    @pytest.fixture(scope="class")
+    def counter_store(self):
+        return build_store("counter")
+
+    @pytest.fixture(scope="class")
+    def gauge_store(self):
+        return build_store("gauge")
+
+    @pytest.mark.parametrize("fn", SPLIT_FNS)
+    def test_all_split_fns_sum(self, counter_store, gauge_store, fn,
+                               monkeypatch):
+        counter = fn in ("rate", "increase")
+        ms = counter_store if counter else gauge_store
+        metric = "http_requests_total" if counter else "gauge_metric"
+        s, f, _ = both_forms(ms, f"sum({fn}({metric}[5m])) by (_ns_)",
+                             monkeypatch)
+        if fn in ("delta", "stdvar_over_time"):
+            # not bit-for-bit: fused delta runs on host-REBASED values
+            # (a different placement than the split lane's raw values),
+            # and stdvar's variance reduction order is implementation-
+            # defined across program boundaries — both agree to ulps
+            assert_ulps(s, f)
+        else:
+            assert_bitwise(s, f)
+
+    @pytest.mark.parametrize("agg", ["avg", "min", "max", "count",
+                                     "stddev"])
+    def test_rate_agg_matrix(self, counter_store, agg, monkeypatch):
+        s, f, _ = both_forms(
+            counter_store, f"{agg}(rate(http_requests_total[5m]))",
+            monkeypatch)
+        assert_bitwise(s, f)
+
+    def test_per_series_no_agg(self, counter_store, monkeypatch):
+        s, f, _ = both_forms(counter_store,
+                             "rate(http_requests_total[5m])", monkeypatch)
+        assert_bitwise(s, f)
+
+    def test_windows_outside_data_all_nan(self, counter_store,
+                                          monkeypatch):
+        # staleness shape: every window precedes the data (or holds <2
+        # samples) → NaN steps, identically in both forms
+        s, f, _ = both_forms(counter_store,
+                             "sum(rate(http_requests_total[5m]))",
+                             monkeypatch, start=START - 3600,
+                             end=START - 600)
+        assert_bitwise(s, f)
+        assert np.isnan(np.asarray(s.values)).all()
+
+    def test_delta_counter_schema_reset_corrected(self, counter_store,
+                                                  monkeypatch):
+        """The uneven-tail restart (values drop back near zero) is a
+        counter reset: delta on a COUNTER schema mirrors the exec
+        kernels — reset-corrected like rate/increase, but never
+        extrapolate-to-zero clamped — so windows spanning the reset stay
+        non-negative instead of swinging ~-30000."""
+        s, f, _ = both_forms(counter_store,
+                             "sum(delta(http_requests_total[4m]))",
+                             monkeypatch)
+        assert_ulps(s, f)
+        assert np.nanmin(np.asarray(s.values)) >= 0
+
+    def test_split_dispatch_counted(self, counter_store, monkeypatch):
+        before = _M_DISPATCH["split"].value
+        both_forms(counter_store, "sum(increase(http_requests_total[5m]))",
+                   monkeypatch)
+        assert _M_DISPATCH["split"].value == before + 1
+
+    def test_eval_cache_shared_across_aggs(self, counter_store,
+                                           monkeypatch):
+        """Different aggregations over the same inner range function hit
+        ONE cached per-series evaluation — the point of keeping grouping
+        out of the eval stage."""
+        monkeypatch.setenv("FILODB_MESH_SPLIT", "1")
+        svc = QueryService(ms := counter_store, "timeseries", NUM_SHARDS,
+                           spread=1, engine="mesh")
+        eng = svc.mesh_engine
+        misses0, hits0 = _M_EVAL["miss"].value, _M_EVAL["hit"].value
+        for agg in ("sum", "avg", "max"):
+            plan = parse_query(f"{agg}(rate(http_requests_total[5m]))",
+                               TimeStepParams(START + 600, 60,
+                                              START + 2800))
+            eng.execute_lowered_many([eng._lower(plan)], ms,
+                                     "timeseries")[0].materialize()
+        assert _M_EVAL["miss"].value == misses0 + 1
+        assert _M_EVAL["hit"].value == hits0 + 2
+
+
+class TestSplitEqualsExec:
+    """The split path against the scatter-gather exec reference."""
+
+    @pytest.fixture(scope="class")
+    def counter_store(self):
+        return build_store("counter")
+
+    @pytest.mark.parametrize("query", [
+        "sum(rate(http_requests_total[5m]))",
+        "sum(rate(http_requests_total[5m])) by (_ns_)",
+        "avg(increase(http_requests_total[3m])) by (instance)",
+        "rate(http_requests_total[5m])",
+        'sum(delta(http_requests_total{_ns_="App-0"}[4m]))',
+    ])
+    def test_exec_parity(self, counter_store, query, monkeypatch):
+        monkeypatch.setenv("FILODB_MESH_SPLIT", "1")
+        exec_svc = QueryService(counter_store, "timeseries", NUM_SHARDS,
+                                spread=1)
+        mesh_svc = QueryService(counter_store, "timeseries", NUM_SHARDS,
+                                spread=1, engine="mesh")
+        args = (query, START + 600, 60, START + 2800)
+        assert_close(exec_svc.query_range(*args).result.materialize(),
+                     mesh_svc.query_range(*args).result.materialize())
+
+
+class TestCacheBehavior:
+    def test_result_cache_signature_invariant_across_forms(self,
+                                                           monkeypatch):
+        """A result cached by the fused form must satisfy a split-form
+        repeat (and vice versa): the kernel form is not part of the
+        plan signature."""
+        from filodb_tpu.query import result_cache as rc
+
+        ms = build_store("counter", n_series=12, n_samples=120)
+        svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                           engine="mesh", result_cache=True)
+        args = ("sum(rate(http_requests_total[5m]))", START + 600, 60,
+                START + 1500)
+        monkeypatch.setenv("FILODB_MESH_SPLIT", "0")
+        hits0 = rc.cache_hits.value
+        a = svc.query_range(*args).result.materialize()
+        monkeypatch.setenv("FILODB_MESH_SPLIT", "1")
+        b = svc.query_range(*args).result.materialize()
+        assert rc.cache_hits.value > hits0
+        assert np.asarray(a.values).tobytes() == \
+            np.asarray(b.values).tobytes()
+
+    def test_caches_invalidate_on_version_bump(self, monkeypatch):
+        """Prepared correction, bounds, and eval entries are keyed by the
+        dataset data_version: new ingest must flow into the next answer,
+        not a stale cached evaluation."""
+        monkeypatch.setenv("FILODB_MESH_SPLIT", "1")
+        ms = build_store("counter", n_series=12, n_samples=120)
+        svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
+                           engine="mesh")
+        exec_svc = QueryService(ms, "timeseries", NUM_SHARDS, spread=1)
+        args = ("sum(increase(http_requests_total[5m]))", START + 600, 60,
+                START + 1100)
+        first = svc.query_range(*args).result.materialize()
+        keys = counter_series(12, metric="http_requests_total")
+        more = counter_stream(keys, 60, start_ms=(START + 1200) * 1000,
+                              interval_ms=10_000, seed=9)
+        ingest_routed(ms, "timeseries", more, NUM_SHARDS, spread=1)
+        args2 = (args[0], START + 600, 60, START + 1700)
+        after = svc.query_range(*args2).result.materialize()
+        ref = exec_svc.query_range(*args2).result.materialize()
+        assert_close(after, ref)
+        assert np.asarray(after.values).shape != \
+            np.asarray(first.values).shape
+
+
+class TestPrecisionGate:
+    def test_x64_always_ok(self):
+        assert _device_correction_ok(np.array([[1e12, np.inf, np.nan]]))
+
+    def test_f32_gate(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from filodb_tpu.query.engine import kernels
+
+        monkeypatch.setattr(kernels, "fdtype", lambda: jnp.float32)
+        small = np.array([[0.0, 123.5, F32_SAFE_MAX - 1]])
+        big = np.array([[0.0, F32_SAFE_MAX]])
+        assert _device_correction_ok(small)
+        assert not _device_correction_ok(big)
+        # non-finite values are masked out by the kernels; only finite
+        # magnitudes decide the lane
+        assert _device_correction_ok(
+            np.array([[np.nan, np.inf, -np.inf, 5.0]]))
+        assert _device_correction_ok(np.array([[np.nan]]))

@@ -248,6 +248,24 @@ FEDERATION_NAMES = [
 ]
 
 
+# mesh query engine (parallel/mesh_engine.py, parallel/adaptive.py) —
+# plan recognition, split-vs-fused dispatch, device cache behavior,
+# exec-path fallbacks, and adaptive lane routing; all registered at
+# mesh_engine import (QueryService construction at boot)
+MESH_NAMES = [
+    "filodb_mesh_supported_total",
+    "filodb_mesh_unsupported_total",
+    "filodb_mesh_dispatch_total",
+    "filodb_mesh_compile_cache_total",
+    "filodb_mesh_batch_cache_total",
+    "filodb_mesh_bounds_cache_total",
+    "filodb_mesh_eval_cache_total",
+    "filodb_mesh_fallback_total",
+    "filodb_mesh_routed_total",
+    "filodb_mesh_hit_rate",
+]
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -377,6 +395,12 @@ class TestMetricsScrape:
         missing_fed = [n for n in FEDERATION_NAMES
                        if n not in names_present]
         assert not missing_fed, f"missing federation metrics: {missing_fed}"
+
+        # mesh-engine observability: dispatch form, device caches, lane
+        # routing — all render from mesh_engine import at boot, before
+        # the first mesh-eligible query
+        missing_mesh = [n for n in MESH_NAMES if n not in names_present]
+        assert not missing_mesh, f"missing mesh metrics: {missing_mesh}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])
