@@ -480,6 +480,12 @@ def _bench_rules():
     return bench_rules()
 
 
+def _bench_sidecars():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from sidecars import bench_sidecars
+    return bench_sidecars()
+
+
 def _bench_tracing_overhead():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tracing_overhead import bench_tracing_overhead
@@ -522,6 +528,7 @@ ALL = {
     "objectstore": _bench_objectstore,
     "migration": _bench_migration,
     "rules": _bench_rules,
+    "sidecars": _bench_sidecars,
     "tracing_overhead": _bench_tracing_overhead,
     "selfmon_overhead": _bench_selfmon_overhead,
     "federation": _bench_federation,

@@ -1,0 +1,142 @@
+"""Chunk aggregate sidecars vs full decode: where each lane wins.
+
+The sidecar fold pays O(chunks) per series — decode the (at most two)
+edge chunks per window, fold every interior chunk from its fixed-size
+seal-time summary — where the decode lane pays O(samples). The fold's
+only edge over decode is the interior samples it never touches, so the
+economics hinge on chunk size and cache state:
+
+* ``cold_tick_large_chunks`` — the design-center workload: an alert
+  probe over series with large sealed chunks whose decoded arrays are
+  not resident (steady-state ingest keeps sealing fresh chunks and
+  memory pressure evicts decode memos). Interiors fold in O(1);
+  decode pays the full window. The lane wins, and the win grows with
+  chunk size.
+* ``cold_scan_medium_chunks`` — a dashboard range scan over medium
+  chunks, cold. Less interior skipped per partition-window, smaller win.
+* ``gated_scan_small_chunks`` — many partitions, small chunks, warm
+  decode memos: the per-partition python fold cannot amortize, the
+  sealed gate (``FILODB_SIDECAR_SEALED_GATE``) detects it from chunk
+  geometry and the lane bypasses. Reported to show the gate holds the
+  lane at parity instead of regressing.
+
+Identical stores and queries per scenario; the valve (``FILODB_SIDECARS``)
+is the only variable. "Cold" scenarios drop per-chunk decode memos and
+batch caches between timed passes; the gated scenario runs warm (its
+point is the bypass, not the decode cost).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+START = 1_600_000_000
+
+SCENARIOS = [
+    {"name": "cold_tick_large_chunks", "series": 128, "chunk": 2048,
+     "samples": 16384, "window": "2040m", "steps": 1, "cold": True,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))",
+                 "sum(rate(http_requests_total[{w}]))"]},
+    {"name": "cold_scan_medium_chunks", "series": 256, "chunk": 512,
+     "samples": 6144, "window": "680m", "steps": 6, "cold": True,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+    {"name": "gated_scan_small_chunks", "series": 1024, "chunk": 64,
+     "samples": 720, "window": "40m", "steps": 6, "cold": False,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+]
+REPEATS = 3
+
+
+def _build(sc):
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import (
+        counter_series,
+        counter_stream,
+        gauge_stream,
+        machine_metrics_series,
+    )
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=sc["chunk"]))
+    n_gauge = sc["series"]
+    streams = [gauge_stream(machine_metrics_series(n_gauge), sc["samples"],
+                            start_ms=START * 1000, seed=11)]
+    if any("http_requests" in q for q in sc["queries"]):
+        streams.append(counter_stream(counter_series(n_gauge // 4),
+                                      sc["samples"], start_ms=START * 1000,
+                                      seed=3, reset_every=300))
+    for stream in streams:
+        for batch in stream:
+            shard.ingest(batch)
+    return ms
+
+
+def _go_cold(ms):
+    """Steady-state ingest proxy: decoded-chunk memos and batch caches
+    are not resident when the next probe fires."""
+    for shard in ms.shards_for("bench"):
+        shard.batch_cache.clear()
+        for pid in shard.lookup_partitions([], 0, 2 ** 62):
+            p = shard.partition(pid)
+            if p is None:
+                continue
+            for ch in p.chunks:
+                ch.__dict__.pop("_decoded", None)
+
+
+def bench_sidecars():
+    from filodb_tpu.coordinator.query_service import QueryService
+
+    rows = []
+    for sc in SCENARIOS:
+        ms = _build(sc)
+        end = START + (sc["samples"] - 1) * 10
+        qs = end - (sc["steps"] - 1) * 60
+        queries = [q.format(w=sc["window"]) for q in sc["queries"]]
+
+        def run(mode):
+            os.environ["FILODB_SIDECARS"] = mode
+            svc = QueryService(ms, "bench", 1, spread=0)
+            out = {}
+            for q in queries:
+                svc.query_range(q, qs, 60, end)      # compile / warm code
+                t_best = float("inf")
+                for _ in range(REPEATS):
+                    if sc["cold"]:
+                        _go_cold(ms)
+                    else:
+                        for shard in ms.shards_for("bench"):
+                            shard.batch_cache.clear()
+                    t0 = time.perf_counter()
+                    r = svc.query_range(q, qs, 60, end)
+                    t_best = min(t_best, time.perf_counter() - t0)
+                    assert r.result.num_series == 1
+                out[q] = (t_best * 1000, r.stats)
+            return out
+
+        try:
+            decode = run("0")
+            sidecar = run("1")
+        finally:
+            os.environ.pop("FILODB_SIDECARS", None)
+
+        for q in queries:
+            d_ms, _ = decode[q]
+            s_ms, st = sidecar[q]
+            rows.append({
+                "scenario": sc["name"],
+                "query": q,
+                "decode_ms": round(d_ms, 2),
+                "sidecar_ms": round(s_ms, 2),
+                "speedup": round(d_ms / s_ms, 2),
+                "sidecar_chunks": st.sidecar_chunks,
+                "decoded_chunks": st.chunks_touched - st.sidecar_chunks,
+            })
+    return {"metric": "sidecar_vs_decode", "unit": "ms/query",
+            "repeats": REPEATS, "rows": rows}
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(bench_sidecars(), indent=2))

@@ -138,10 +138,11 @@ class QueryService:
         self.mesh_engine = None
         if self.engine == "mesh":
             from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
-            self.mesh_engine = MeshQueryEngine(mesh=self.mesh)
+            self.mesh_engine = MeshQueryEngine(mesh=self.mesh, sidecars=True)
         elif self.engine == "adaptive":
             from filodb_tpu.parallel.adaptive import AdaptiveQueryEngine
-            self.mesh_engine = AdaptiveQueryEngine(mesh=self.mesh)
+            self.mesh_engine = AdaptiveQueryEngine(mesh=self.mesh,
+                                                   sidecars=True)
 
     # ---- promql entry points --------------------------------------------
 

@@ -34,7 +34,7 @@ def _build_registry() -> dict[str, type]:
     from filodb_tpu.coordinator import remote  # noqa: F401
     from filodb_tpu.core.filters import ColumnFilter, Filter
     from filodb_tpu.core.partkey import PartKey
-    from filodb_tpu.memory.chunk import Chunk
+    from filodb_tpu.memory.chunk import Chunk, ColumnSummary
     from filodb_tpu.memory.codecs import HistogramColumn
     from filodb_tpu.query import exec as _exec  # noqa: F401
     from filodb_tpu.query.exec import binaryjoin  # noqa: F401
@@ -65,7 +65,7 @@ def _build_registry() -> dict[str, type]:
                  _tr.RangeVectorTransformer):
         reg[base.__name__] = base
         walk(base)
-    for cls in (ColumnFilter, PartKey, Chunk, HistogramColumn,
+    for cls in (ColumnFilter, PartKey, Chunk, ColumnSummary, HistogramColumn,
                 MigrationManifest, PlannerParams,
                 QueryBudget, QueryContext, QueryResult, QueryStats,
                 RangeVectorKey, ScalarResult, StepMatrix, TraceContext):

@@ -112,6 +112,30 @@ class NativeShardCore:
     def part_hash(self, pid: int) -> int:
         return int(self._lib.shard_core_part_hash(self._core, pid))
 
+    def buf_fold(self, pids, t0s, t1s, col: int):
+        """Batched sequential window fold over write buffers (the sidecar
+        query lane's buffer tail): one C call for all partitions instead of
+        a ctypes buffer copy per partition. Returns (stats [P, W, 12] f64,
+        flags [P] i32) — see ``shard_buf_fold`` in filodb_native.cpp — or
+        None when the loaded .so predates the entry point."""
+        if not hasattr(self._lib, "shard_buf_fold"):
+            return None
+        pids = np.ascontiguousarray(pids, np.int32)
+        t0s = np.ascontiguousarray(t0s, np.int64)
+        t1s = np.ascontiguousarray(t1s, np.int64)
+        P, W = len(pids), len(t0s)
+        out = np.empty((P, W, 12), np.float64)
+        flags = np.empty(P, np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        with self.lock:
+            self._lib.shard_buf_fold(
+                self._core, pids.ctypes.data_as(i32p), P,
+                t0s.ctypes.data_as(i64p), t1s.ctypes.data_as(i64p), W, col,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                flags.ctypes.data_as(i32p))
+        return out, flags
+
     def lookup(self, key_blob: bytes) -> int:
         """pid for canonical key bytes, or -1 — the authoritative key map
         for restored shards (no host-language dictionary needed)."""
@@ -176,7 +200,7 @@ class NativeBackedPartition:
     __slots__ = ("part_id", "max_chunk_size", "shard",
                  "device_pages", "_core", "_lib", "_chunks_cache",
                  "_chunks_ver", "_part_key", "_schema", "_key_blob",
-                 "_schemas")
+                 "_schemas", "_sc_cache")
 
     def __init__(self, core: NativeShardCore, part_id: int,
                  part_key: PartKey | None = None,
@@ -341,11 +365,18 @@ class NativeBackedPartition:
             self._lib.part_seal_buffer(self._core._core, self.part_id)
 
     def make_flush_chunks(self, flush_buffer: bool = True) -> list[Chunk]:
+        from filodb_tpu.memory.chunk import ensure_summary
         with self._core.lock:
             if flush_buffer:
                 self._lib.part_seal_buffer(self._core._core, self.part_id)
             flushed = self._flushed_id
-            return [c for c in self.chunks if c.id > flushed]
+            out = [c for c in self.chunks if c.id > flushed]
+        # natively-sealed chunks carry no summary yet: attach before the
+        # chunks leave for the column store (decode memoizes on the Chunk,
+        # and the version-keyed chunks cache keeps the attachment)
+        for c in out:
+            ensure_summary(c)
+        return out
 
     def mark_flushed(self, up_to_id: int) -> None:
         self._lib.part_mark_flushed(self._core._core, self.part_id, up_to_id)

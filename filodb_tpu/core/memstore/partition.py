@@ -170,7 +170,7 @@ class TimeSeriesPartition:
 
     __slots__ = ("part_id", "part_key", "schema", "max_chunk_size", "chunks",
                  "_buf", "_chunk_seq", "_flushed_id", "bucket_les", "shard",
-                 "device_pages", "_dedup_floor", "buffer_pool")
+                 "device_pages", "_dedup_floor", "buffer_pool", "_sc_cache")
 
     def __init__(self, part_id: int, part_key: PartKey, schema: Schema,
                  max_chunk_size: int = 400, shard: int = 0,
@@ -364,7 +364,8 @@ class TimeSeriesPartition:
                     else np.zeros(rows.shape[1]), rows))
             else:
                 cols.append(data[: b.n])
-        return encode_chunk(self.schema, b.ts[: b.n], cols, 0xFFF)
+        return encode_chunk(self.schema, b.ts[: b.n], cols, 0xFFF,
+                            with_summary=False)
 
     def has_unpersisted_data(self) -> bool:
         """True while buffer samples or un-flushed chunks remain — such a

@@ -57,7 +57,7 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.store.api import (ColumnStore, MetaStore, PartKeyRecord)
 from filodb_tpu.core.store.localstore import _pk_blob, _pk_from_blob
 from filodb_tpu.core.store.remotestore import split_of
-from filodb_tpu.memory.chunk import Chunk
+from filodb_tpu.memory.chunk import Chunk, ensure_summary
 from filodb_tpu.utils.metrics import Counter, Gauge, GaugeFn
 from filodb_tpu.utils.resilience import FaultInjector, RetryPolicy
 from filodb_tpu.utils.tracing import span
@@ -155,7 +155,11 @@ OLDEST_TASK_AGE = GaugeFn(
 # --------------------------------------------------------------------------
 # segment binary format
 
-_MAGIC = b"FSG1"
+# FSG2 chunk payloads carry the chunk aggregate sidecar trailer
+# (memory/chunk.py); FSG1 segments (pre-sidecar) stay readable — their
+# chunks deserialize without summaries and compaction backfills them
+_MAGIC = b"FSG2"
+_MAGIC_V1 = b"FSG1"
 _FOOTER = struct.Struct("<BII")       # 0xFE, entry_count, crc32c(body)
 _FOOTER_MARK = 0xFE
 _E_CHUNK, _E_PARTKEY, _E_DELETE = 1, 2, 3
@@ -238,7 +242,8 @@ def parse_segment(data: bytes, key: str = "?"):
     crc, payload)`` / ``("partkey", pk_blob, start, end, upd)`` /
     ``("delete", pk_blob)``.  Raises :class:`CorruptSegmentError` on any
     mismatch."""
-    if len(data) < len(_MAGIC) + _FOOTER.size or data[:4] != _MAGIC:
+    if len(data) < len(_MAGIC) + _FOOTER.size \
+            or data[:4] not in (_MAGIC, _MAGIC_V1):
         CORRUPT.inc()
         raise CorruptSegmentError(f"{key}: bad magic/size")
     mark, count, crc = _FOOTER.unpack_from(data, len(data) - _FOOTER.size)
@@ -1076,6 +1081,9 @@ class ObjectStoreColumnStore(ColumnStore):
                             if ref is None or ref.seq != s.seq:
                                 continue   # deleted or superseded
                             ch = Chunk.deserialize(e[10])
+                            # FSG1 → FSG2 backfill: chunks from pre-sidecar
+                            # segments gain summaries on rewrite
+                            ensure_summary(ch, backfill=True)
                             off, dlen, crc = new.add_chunk(
                                 blob, ch, ref.ingestion_time, ref.upd)
                             moved.append((pk, _ChunkRef(

@@ -13,17 +13,165 @@ the wire protocol.
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
 from filodb_tpu.core.schemas import ColumnType, Schema
 from filodb_tpu.memory import codecs
+from filodb_tpu.utils.metrics import Counter
+
+# chunks whose summary was computed after the fact (compaction of a
+# pre-sidecar segment, lazy reads of natively-sealed chunks)
+SIDECAR_BACKFILLED = Counter(
+    "filodb_sidecar_backfilled",
+    help="chunk summaries computed after seal (old segments, native seals)")
 
 
 def chunk_id(start_time: int, ingestion_seq: int = 0) -> int:
     """Time-sortable chunk id: millis in high bits, sequence in low 12 bits."""
     return (start_time << 12) | (ingestion_seq & 0xFFF)
+
+
+# ---------------------------------------------------------------------------
+# aggregate sidecars (chunk-level summaries)
+#
+# Per scalar column, a 12-slot float64 stats vector computed once at seal
+# time with strictly SEQUENTIAL accumulation (np.cumsum semantics — the same
+# addition order a plain left-to-right loop produces), so a summary
+# recomputed from the decoded vector is bitwise identical to the stored one
+# (codecs are lossless):
+#
+#   0 count      non-NaN samples
+#   1 sum        Σv            (sequential)
+#   2 sumsq      Σv²           (sequential)
+#   3 min / 4 max
+#   5 first_ts / 6 first_val   first non-NaN sample
+#   7 last_ts  / 8 last_val    last non-NaN sample
+#   9 resets     count of drops v[i] < v[i-1] over the non-NaN sequence
+#  10 corr       Σ prev at drop points (Prometheus reset correction, seq.)
+#  11 changes    count of v[i] != v[i-1]
+#
+# plus an optional mergeable log2-bucket sketch (uint16[64]) for
+# quantile/topk at declared approximation.
+
+STATS_WIDTH = 12
+(S_COUNT, S_SUM, S_SUMSQ, S_MIN, S_MAX, S_FIRST_TS, S_FIRST_VAL, S_LAST_TS,
+ S_LAST_VAL, S_RESETS, S_CORR, S_CHANGES) = range(STATS_WIDTH)
+
+SKETCH_BUCKETS = 64
+_SC_MAGIC = b"SC01"
+
+
+@dataclass(frozen=True, eq=False)
+class ColumnSummary:
+    """Fixed-size aggregate sidecar for one scalar column (see layout above).
+
+    Registered on the wire (rides inside ``Chunk``); ``eq`` disabled —
+    ndarray comparison is ambiguous and identity is what callers need."""
+
+    stats: np.ndarray  # float64 [STATS_WIDTH]
+    sketch: np.ndarray | None = None  # uint16 [SKETCH_BUCKETS]
+
+
+def _sketch_values(vals: np.ndarray) -> np.ndarray:
+    """Symmetric log2 histogram: bucket 32 = zero, 33..63 positive magnitudes
+    by exponent (clipped), 31..1 negative mirrored, 0/63 overflow."""
+    sk = np.zeros(SKETCH_BUCKETS, np.uint16)
+    if vals.size == 0:
+        return sk
+    _, e = np.frexp(vals)  # |v| = m * 2^e, 0.5 <= |m| < 1
+    mag = np.clip(e - 1 + 16, 0, 30)  # exponent -16..14 usable
+    b = np.where(vals == 0, 32, np.where(vals > 0, 33 + mag, 31 - mag))
+    np.add.at(sk, b.astype(np.int64), 1)
+    return sk
+
+
+def summarize_values(ts: np.ndarray, vals: np.ndarray,
+                     with_sketch: bool = True) -> ColumnSummary:
+    """Summarize one column of one chunk (or any time slice of it).
+
+    NaN samples are excluded exactly like the decode lane
+    (``engine/batch.build_batch`` filters them before the kernels see data).
+    All accumulations are sequential (cumsum) so recomputation from a
+    losslessly-decoded vector reproduces the stored bits."""
+    vals = np.asarray(vals, np.float64)
+    ts = np.asarray(ts, np.int64)
+    stats = np.zeros(STATS_WIDTH, np.float64)
+    m = ~np.isnan(vals)
+    vv = vals[m]
+    if vv.size == 0:
+        stats[S_MIN:S_LAST_VAL + 1] = np.nan
+        return ColumnSummary(stats, _sketch_values(vv) if with_sketch
+                             else None)
+    tv = ts[m]
+    stats[S_COUNT] = vv.size
+    stats[S_SUM] = np.cumsum(vv)[-1]
+    stats[S_SUMSQ] = np.cumsum(vv * vv)[-1]
+    stats[S_MIN] = np.min(vv)
+    stats[S_MAX] = np.max(vv)
+    stats[S_FIRST_TS] = tv[0]
+    stats[S_FIRST_VAL] = vv[0]
+    stats[S_LAST_TS] = tv[-1]
+    stats[S_LAST_VAL] = vv[-1]
+    if vv.size > 1:
+        prev, cur = vv[:-1], vv[1:]
+        drop = cur < prev
+        stats[S_RESETS] = drop.sum()
+        stats[S_CORR] = np.cumsum(np.where(drop, prev, 0.0))[-1]
+        stats[S_CHANGES] = (cur != prev).sum()
+    return ColumnSummary(stats, _sketch_values(vv) if with_sketch else None)
+
+
+def summarize_columns(schema: Schema, ts: np.ndarray,
+                      columns: list) -> tuple:
+    """Per-vector summary tuple for a chunk being sealed from raw appender
+    arrays (entry 0 is the timestamp column: None; non-scalar columns:
+    None)."""
+    out: list[ColumnSummary | None] = [None]
+    for col, data in zip(schema.data.columns[1:], columns):
+        if col.ctype in (ColumnType.DOUBLE, ColumnType.LONG, ColumnType.INT,
+                         ColumnType.TIMESTAMP):
+            out.append(summarize_values(ts, np.asarray(data, np.float64)))
+        else:
+            out.append(None)
+    return tuple(out)
+
+
+def ensure_summary(chunk: "Chunk", backfill: bool = False):
+    """Return the chunk's summary tuple, computing it from the decoded
+    vectors if absent (lazy path for natively-sealed chunks; backfill path
+    for pre-sidecar segments met during compaction). Memoized on the chunk —
+    they are immutable."""
+    if chunk.summary is not None:
+        return chunk.summary
+    # best-effort: a vector this build can't decode (legacy codec, corrupt
+    # bytes) yields no summary rather than failing the caller — compaction
+    # must rewrite such chunks unchanged, and queries bypass to the decode
+    # lane where CorruptVectorError surfaces with full forensic context
+    try:
+        ts = np.asarray(chunk.decode_column(0), np.int64)
+    except CorruptVectorError:
+        return None
+    out: list[ColumnSummary | None] = [None]
+    computed = False
+    for i in range(1, len(chunk.vectors)):
+        try:
+            dec = chunk.decode_column(i)
+        except CorruptVectorError:
+            out.append(None)
+            continue
+        if isinstance(dec, np.ndarray) and dec.ndim == 1 \
+                and dec.dtype.kind in "fiu" and len(dec) == len(ts):
+            out.append(summarize_values(ts, dec))
+            computed = True
+        else:
+            out.append(None)
+    summary = tuple(out)
+    object.__setattr__(chunk, "summary", summary)
+    if backfill and computed:
+        SIDECAR_BACKFILLED.inc()
+    return summary
 
 
 @dataclass(frozen=True)
@@ -35,6 +183,9 @@ class Chunk:
     start_time: int
     end_time: int
     vectors: tuple[bytes, ...]  # one encoded vector per data column
+    # aggregate sidecar: one ColumnSummary|None per vector. Derived data —
+    # excluded from equality (recomputable bit-for-bit from the vectors)
+    summary: tuple | None = field(default=None, compare=False)
 
     @property
     def nbytes(self) -> int:
@@ -67,6 +218,21 @@ class Chunk:
         for v in self.vectors:
             parts.append(struct.pack("<I", len(v)))
             parts.append(v)
+        # sidecar rides as a trailing section: pre-sidecar deserializers
+        # stop after the declared vectors and never see it
+        if self.summary is not None:
+            parts.append(_SC_MAGIC)
+            parts.append(struct.pack("<B", len(self.summary)))
+            for cs in self.summary:
+                if cs is None:
+                    parts.append(b"\x00")
+                elif cs.sketch is None:
+                    parts.append(b"\x01")
+                    parts.append(cs.stats.astype("<f8").tobytes())
+                else:
+                    parts.append(b"\x02")
+                    parts.append(cs.stats.astype("<f8").tobytes())
+                    parts.append(cs.sketch.astype("<u2").tobytes())
         return b"".join(parts)
 
     @staticmethod
@@ -79,7 +245,28 @@ class Chunk:
             off += 4
             vectors.append(data[off : off + ln])
             off += ln
-        return Chunk(cid, rows, st, et, tuple(vectors))
+        summary = None
+        if data[off : off + 4] == _SC_MAGIC:
+            off += 4
+            nents = data[off]
+            off += 1
+            ents: list[ColumnSummary | None] = []
+            for _ in range(nents):
+                kind = data[off]
+                off += 1
+                if kind == 0:
+                    ents.append(None)
+                    continue
+                stats = np.frombuffer(data, "<f8", STATS_WIDTH, off).copy()
+                off += STATS_WIDTH * 8
+                sketch = None
+                if kind == 2:
+                    sketch = np.frombuffer(data, "<u2", SKETCH_BUCKETS,
+                                           off).copy()
+                    off += SKETCH_BUCKETS * 2
+                ents.append(ColumnSummary(stats, sketch))
+            summary = tuple(ents)
+        return Chunk(cid, rows, st, et, tuple(vectors), summary)
 
 
 class CorruptVectorError(RuntimeError):
@@ -101,12 +288,18 @@ class CorruptVectorError(RuntimeError):
         self.column = column
 
 
-def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0) -> Chunk:
+def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0,
+                 with_summary: bool = True) -> Chunk:
     """Encode one chunkset from appender contents.
 
     ``columns`` holds one array per non-timestamp data column, in schema order:
     float64 arrays for DOUBLE, int64 for LONG/INT, (n, nb) int64 for HISTOGRAM,
     list[str] for STRING.
+
+    ``with_summary`` attaches the aggregate sidecar, computed from the raw
+    arrays (bitwise identical to recomputing from the decoded vectors —
+    the codecs are lossless). Pass False on hot transient paths (the live
+    write-buffer pseudo-chunk) where the summary would be thrown away.
     """
     assert len(ts) > 0
     vectors: list[bytes] = [codecs.encode_delta_delta(ts)]
@@ -126,5 +319,6 @@ def encode_chunk(schema: Schema, ts: np.ndarray, columns: list, seq: int = 0) ->
             vectors.append(codecs.encode_map(list(data)))
         else:
             raise ValueError(f"unsupported column type {col.ctype}")
+    summary = summarize_columns(schema, ts, columns) if with_summary else None
     return Chunk(chunk_id(int(ts[0]), seq), len(ts), int(ts[0]), int(ts[-1]),
-                 tuple(vectors))
+                 tuple(vectors), summary)

@@ -155,6 +155,13 @@ def _load():
         lib.part_evict_flushed.restype = i32
         lib.part_seed_floor.argtypes = [vp, i32, i64]
         lib.part_free.argtypes = [vp, i32]
+        # batched buffer window fold (sidecar lane); absent on .so builds
+        # older than the sidecar PR — callers must hasattr-gate
+        if hasattr(lib, "shard_buf_fold"):
+            lib.shard_buf_fold.argtypes = [vp, ctypes.POINTER(i32), i32,
+                                           i64p, i64p, i32, i32, f64p,
+                                           ctypes.POINTER(i32)]
+            lib.shard_buf_fold.restype = i32
         # TagIndex (native part-key inverted index hot paths)
         i32p = ctypes.POINTER(ctypes.c_int32)
         u32p = ctypes.POINTER(ctypes.c_uint32)

@@ -107,8 +107,13 @@ class AdaptiveQueryEngine:
 
     SHADOW_EVERY = 32  # probe the slower lane once per N serving calls
 
-    def __init__(self, mesh=None, variant: str = "gather"):
-        self.device_engine = MeshQueryEngine(mesh=mesh, variant=variant)
+    def __init__(self, mesh=None, variant: str = "gather",
+                 sidecars: bool = False):
+        # sidecar delegation decides at the top (device lane's _lower):
+        # declined plans route to the exec leaf before lane selection runs,
+        # so the inner host/single lanes never see them
+        self.device_engine = MeshQueryEngine(mesh=mesh, variant=variant,
+                                             sidecars=sidecars)
         self._host_engine = None
         self._host_checked = False
         self._single_engine = None

@@ -196,6 +196,15 @@ class MeshQueryEngine:
 
     mesh: object = None
     variant: str = "gather"  # or "ring" (ppermute time combine)
+    # prepare-stage sidecar delegation (engine/sidecar_lane.py): tick-shaped
+    # grids (K ≤ 2 steps — rule ticks and alert probes evaluate at a single
+    # instant) over eligible range functions are declined here so the exec
+    # leaf folds them from chunk aggregate sidecars in O(chunks) —
+    # per-evaluation device prep (decode + upload) never amortizes at K≈1.
+    # Wider grids keep the device pipeline and its warm split caches. Off by
+    # default so direct-constructed engines keep the pure device path;
+    # QueryService turns it on for production-facing engines.
+    sidecars: bool = False
 
     _fns: dict = field(default_factory=dict)
     # decoded global batches are reused across queries over unchanged data
@@ -255,6 +264,15 @@ class MeshQueryEngine:
         return self.hits / total if total else 0.0
 
     def _lower(self, plan) -> _Lowered | None:
+        low = self._lower_plan(plan)
+        if low is not None and self.sidecars \
+                and (low.end - low.start) // max(low.step, 1) + 1 <= 2:
+            from filodb_tpu.query.engine import sidecar_lane
+            if sidecar_lane.covers_fn(low.fn):
+                return None  # sidecar delegation (see ``sidecars`` field)
+        return low
+
+    def _lower_plan(self, plan) -> _Lowered | None:
         """Recognize a plan for mesh execution (None = exec-path fallback)."""
         # wrappers peel off into post-transforms (applied to the small
         # [G|P, K] mesh output, so any value-wise op is safe)

@@ -17,6 +17,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from filodb_tpu.query.engine.kernels import fdtype
 
@@ -142,3 +143,52 @@ def histogram_quantile(q, bucket_rates, les):
     val = jnp.where(jnp.isnan(total), jnp.nan, val)
     return jnp.where((q < 0) | (q > 1),
                      jnp.where(q < 0, -jnp.inf, jnp.inf), val)
+
+
+# ---------------------------------------------------------------------------
+# chunk-sidecar log2 sketches (memory/chunk.py): mergeable fixed-width value
+# histograms served for quantile_over_time under declared approximation
+# (FILODB_SIDECAR_APPROX=1, engine/sidecar_lane.py)
+
+def merge_sketches(sketches) -> np.ndarray:
+    """Sum per-chunk sketches into one bucket-count vector (the mergeability
+    property: counts add, no rank information is lost beyond bucket width)."""
+    out = None
+    for sk in sketches:
+        if sk is None:
+            continue
+        s = np.asarray(sk, np.int64)
+        out = s.copy() if out is None else out + s
+    return out
+
+
+def _sketch_bucket_value(b: int) -> float:
+    """Representative value of sketch bucket ``b`` (geometric midpoint of the
+    power-of-two span; bucket layout in memory/chunk.py::_sketch_values)."""
+    if b == 32:
+        return 0.0
+    if b > 32:
+        mag = b - 33  # clipped exponent-1+16 → span [2^(mag-16), 2^(mag-15))
+        return float(2.0 ** (mag - 16) * 1.5)
+    mag = 31 - b
+    return float(-(2.0 ** (mag - 16) * 1.5))
+
+
+def sketch_quantile(q: float, sketch: np.ndarray) -> float:
+    """Quantile estimate from a merged sketch: walk cumulative bucket counts
+    to the rank (nearest-rank, matching the kernels' lower-index convention
+    within bucket resolution) and return the bucket's representative value.
+    Error is bounded by the bucket width (a factor-of-two span)."""
+    if q < 0:
+        return -np.inf
+    if q > 1:
+        return np.inf
+    counts = np.asarray(sketch, np.float64)
+    total = counts.sum()
+    if total <= 0:
+        return np.nan
+    rank = q * (total - 1)
+    cum = np.cumsum(counts)
+    b = int(np.searchsorted(cum, rank, side="right"))
+    b = min(b, len(counts) - 1)
+    return _sketch_bucket_value(b)

@@ -1,0 +1,780 @@
+"""Sidecar-served evaluation lane: range functions folded from chunk-level
+aggregate summaries instead of decoded samples.
+
+Chunks carry fixed-size per-column summaries computed once at seal time
+(``memory/chunk.py``: count/sum/sumsq/min/max/first/last/resets/corr/changes
+plus a mergeable log2 sketch — the Zarr chunk-level cumulative-sums shape from
+PAPERS.md). For a window (t-w, t] the lane splits each partition's data into
+
+    [left-edge chunk] [interior chunks ...] [right-edge chunk] [write buffer]
+
+folds the interior chunks from their summaries in O(chunks), decodes only the
+(at most two) edge chunks, folds the write-buffer tail in one batched native
+call (``shard_buf_fold``), and merges the segments with Prometheus counter
+-reset carry across segment boundaries. The per-window merged stats row then
+feeds closed-form range-function formulas that mirror
+``query/engine/kernels._range_impl`` operation for operation in float64.
+
+Exactness gate: the lane serves only functions whose window decomposition is
+exact over the summary algebra (sum/avg/count/min/max/stddev/stdvar/last/
+present/absent/changes/resets/zscore/timestamp and the rate/increase/delta
+family via first/last + per-chunk reset corrections). quantile_over_time is
+served from the mergeable sketch only under ``FILODB_SIDECAR_APPROX=1``
+(declared approximation). Anything else — at-modifier pins, histogram
+columns, sample budgets, demand paging, out-of-order buffers — bypasses to
+the decode lane and increments ``filodb_sidecar_bypassed_total``.
+
+Provenance valve (``FILODB_SIDECARS``):
+  ``1`` (default) serve from stored sidecars (computing them lazily for
+        natively-sealed chunks); ``decode`` re-derives every summary from the
+        decoded vectors, ignoring stored sidecars — byte-identical to ``1``
+        because codecs are lossless and the summary fold is strictly
+        sequential; ``0`` disables the lane entirely (kernel lane).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from filodb_tpu.core.schemas import ColumnType
+from filodb_tpu.memory.chunk import (
+    S_CHANGES,
+    S_CORR,
+    S_COUNT,
+    S_FIRST_TS,
+    S_FIRST_VAL,
+    S_LAST_TS,
+    S_LAST_VAL,
+    S_MAX,
+    S_MIN,
+    S_RESETS,
+    S_SUM,
+    S_SUMSQ,
+    STATS_WIDTH,
+    ensure_summary,
+    summarize_values,
+)
+from filodb_tpu.utils.metrics import Counter
+from filodb_tpu.utils.tracing import span
+
+SIDECAR_SERVED = Counter(
+    "filodb_sidecar_served",
+    help="leaf evaluations served from chunk aggregate sidecars")
+SIDECAR_BYPASSED = Counter(
+    "filodb_sidecar_bypassed",
+    help="eligible-path evaluations that fell back to the decode lane")
+
+# functions whose (t-w, t] evaluation is exact over the summary algebra
+ELIGIBLE_FNS = frozenset((
+    "count_over_time", "sum_over_time", "avg_over_time", "min_over_time",
+    "max_over_time", "stddev_over_time", "stdvar_over_time", "zscore",
+    "last_over_time", "present_over_time", "absent_over_time", "changes",
+    "resets", "rate", "increase", "delta", "last_sample", "timestamp",
+))
+
+_SCALAR_CTYPES = (ColumnType.DOUBLE, ColumnType.LONG, ColumnType.INT)
+
+
+def mode() -> str:
+    """``1`` serve, ``decode`` recompute-from-vectors, ``0`` off."""
+    v = os.environ.get("FILODB_SIDECARS", "1").strip().lower()
+    if v in ("0", "off", "false"):
+        return "0"
+    if v == "decode":
+        return "decode"
+    return "1"
+
+
+def approx_enabled() -> bool:
+    return os.environ.get("FILODB_SIDECAR_APPROX", "0") == "1"
+
+
+def _sealed_gate() -> int:
+    """Amortization choke point for the sealed-chunk fold. The buffer
+    tier folds in one batched C call regardless of partition count, but
+    each partition whose window overlaps SEALED chunks pays a fixed
+    Python/numpy cost (edge folds + segment merges, ~0.3ms). The decode
+    lane amortizes the same work across all steps with one vectorized
+    batch, so past ``sealed_partitions * windows > gate`` the fold loses
+    regardless of how many samples it skips, and the lane bypasses.
+    0 disables the gate (always serve)."""
+    try:
+        return int(os.environ.get("FILODB_SIDECAR_SEALED_GATE", "4096"))
+    except ValueError:
+        return 4096
+
+
+# Below this many sealed partition-windows the fold's fixed overhead is
+# immaterial and the lane serves unconditionally (keeps small stores and
+# tests deterministic). Above it, serve only when each partition-window
+# skips enough interior samples to buy back its fixed cost.
+_SEALED_FREE_PART_WINDOWS = 512
+_SEALED_MIN_SKIPPED_SAMPLES = 1024
+
+
+def _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W: int) -> bool:
+    """Decide whether the per-partition sealed fold beats full decode.
+
+    Cost model: the fold costs ~a per sealed partition-window (python
+    edge decode + segment merges); the decode lane costs ~b per sample
+    in the window, batched. The fold's only edge is the interior samples
+    it never touches, so it pays exactly when
+    ``skipped_samples_per_partition_window * b > a`` — empirically about
+    a thousand samples. Interior skip is estimated from the first sealed
+    partition's chunk geometry (span and density), not by decoding."""
+    n_sealed = int(sealed_overlap.sum())
+    if n_sealed == 0:
+        return True
+    gate = _sealed_gate()
+    if gate <= 0:
+        return True
+    if n_sealed * W > gate:
+        return False
+    if n_sealed * W <= _SEALED_FREE_PART_WINDOWS:
+        return True
+    chunks = sparts[int(np.argmax(sealed_overlap))].chunks[:8]
+    spans = [c.end_time - c.start_time for c in chunks
+             if c.end_time > c.start_time]
+    if not spans:
+        return False
+    span = float(np.median(spans))
+    density = float(np.median([c.num_rows for c in chunks])) / span
+    window_ms = float((t1s - t0s).max())
+    skipped = max(0.0, window_ms - 2.0 * span) * density
+    return skipped >= _SEALED_MIN_SKIPPED_SAMPLES
+
+
+def covers_fn(fn: str) -> bool:
+    """Would the lane serve this range function (mesh prepare-stage
+    precheck)? quantile only under declared approximation."""
+    if mode() == "0":
+        return False
+    return fn in ELIGIBLE_FNS or (
+        fn == "quantile_over_time" and approx_enabled())
+
+
+class _Bypass(Exception):
+    """Raised anywhere in the lane when exactness can't be guaranteed —
+    the caller falls back to the decode lane."""
+
+
+# ---------------------------------------------------------------------------
+# per-series window folds (prefix-gather form, vectorized over windows)
+
+def _eprefix(x: np.ndarray) -> np.ndarray:
+    out = np.empty(len(x) + 1, np.float64)
+    out[0] = 0.0
+    np.cumsum(x, out=out[1:])
+    return out
+
+
+class _FoldArrays:
+    """Prefix-sum bundle over one NaN-filtered value sequence, for O(1)
+    per-window gathers (the host analog of the kernels' ``_eprefix``)."""
+
+    __slots__ = ("tv", "vv", "ps", "ps2", "pr", "pcorr", "pchg")
+
+    def __init__(self, tv: np.ndarray, vv: np.ndarray):
+        self.tv = tv
+        self.vv = vv
+        self.ps = _eprefix(vv)
+        self.ps2 = _eprefix(vv * vv)
+        if len(vv) > 1:
+            prev, cur = vv[:-1], vv[1:]
+            drop = cur < prev
+            ind = np.zeros(len(vv), np.float64)
+            ind[1:] = drop
+            self.pr = _eprefix(ind)
+            ind2 = np.zeros(len(vv), np.float64)
+            ind2[1:] = np.where(drop, prev, 0.0)
+            self.pcorr = _eprefix(ind2)
+            ind3 = np.zeros(len(vv), np.float64)
+            ind3[1:] = cur != prev
+            self.pchg = _eprefix(ind3)
+        else:
+            z = np.zeros(len(vv) + 1, np.float64)
+            self.pr = self.pcorr = self.pchg = z
+
+
+def _fold_windows(fa: _FoldArrays, t0s: np.ndarray,
+                  t1s: np.ndarray) -> np.ndarray:
+    """Stats rows [W, STATS_WIDTH] for windows (t0, t1] over one sequence."""
+    W = len(t0s)
+    out = np.zeros((W, STATS_WIDTH), np.float64)
+    n = len(fa.tv)
+    out[:, S_MIN:S_LAST_VAL + 1] = np.nan
+    if n == 0:
+        return out
+    lo = np.searchsorted(fa.tv, t0s, side="right")
+    hi = np.searchsorted(fa.tv, t1s, side="right")
+    cnt = (hi - lo).astype(np.float64)
+    have = hi > lo
+    out[:, S_COUNT] = np.where(have, cnt, 0.0)
+    out[:, S_SUM] = np.where(have, fa.ps[hi] - fa.ps[lo], 0.0)
+    out[:, S_SUMSQ] = np.where(have, fa.ps2[hi] - fa.ps2[lo], 0.0)
+    # reset/change indicators at position j compare vv[j] to vv[j-1]; only
+    # pairs fully inside the window count: j in [lo+1, hi)
+    lo1 = np.minimum(lo + 1, hi)
+    out[:, S_RESETS] = fa.pr[hi] - fa.pr[lo1]
+    out[:, S_CORR] = fa.pcorr[hi] - fa.pcorr[lo1]
+    out[:, S_CHANGES] = fa.pchg[hi] - fa.pchg[lo1]
+    fi = np.clip(lo, 0, n - 1)
+    li = np.clip(hi - 1, 0, n - 1)
+    out[:, S_FIRST_TS] = np.where(have, fa.tv[fi], np.nan)
+    out[:, S_FIRST_VAL] = np.where(have, fa.vv[fi], np.nan)
+    out[:, S_LAST_TS] = np.where(have, fa.tv[li], np.nan)
+    out[:, S_LAST_VAL] = np.where(have, fa.vv[li], np.nan)
+    # min/max via paired reduceat segments [lo0,hi0),[hi0,lo1),...; a NaN
+    # sentinel makes hi == n addressable (odd/degenerate segments that touch
+    # it are discarded or masked by ``have``)
+    ext = np.append(fa.vv, np.nan)
+    inds = np.empty(2 * W, np.int64)
+    inds[0::2] = lo
+    inds[1::2] = hi
+    mn = np.minimum.reduceat(ext, inds)[0::2]
+    mx = np.maximum.reduceat(ext, inds)[0::2]
+    out[:, S_MIN] = np.where(have, mn, np.nan)
+    out[:, S_MAX] = np.where(have, mx, np.nan)
+    return out
+
+
+def _valid_series(ts: np.ndarray, vals: np.ndarray):
+    vals = np.asarray(vals, np.float64)
+    ts = np.asarray(ts, np.int64)
+    m = ~np.isnan(vals)
+    return ts[m], vals[m]
+
+
+def _merge_vec(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Merge stats rows of two consecutive-in-time segments, [W, 12] each.
+    Counter-reset carry across the boundary follows the kernels'
+    prev-valid-sample comparison: a drop from segment A's last sample to
+    segment B's first counts as one reset with correction A.last."""
+    an = a[:, S_COUNT] > 0
+    bn = b[:, S_COUNT] > 0
+    out = a.copy()
+    only_b = ~an & bn
+    out[only_b] = b[only_b]
+    m = an & bn
+    if m.any():
+        A, B = a[m], b[m]
+        R = A.copy()
+        R[:, S_COUNT] = A[:, S_COUNT] + B[:, S_COUNT]
+        R[:, S_SUM] = A[:, S_SUM] + B[:, S_SUM]
+        R[:, S_SUMSQ] = A[:, S_SUMSQ] + B[:, S_SUMSQ]
+        R[:, S_MIN] = np.minimum(A[:, S_MIN], B[:, S_MIN])
+        R[:, S_MAX] = np.maximum(A[:, S_MAX], B[:, S_MAX])
+        R[:, S_LAST_TS] = B[:, S_LAST_TS]
+        R[:, S_LAST_VAL] = B[:, S_LAST_VAL]
+        bdrop = B[:, S_FIRST_VAL] < A[:, S_LAST_VAL]
+        R[:, S_RESETS] = A[:, S_RESETS] + bdrop + B[:, S_RESETS]
+        R[:, S_CORR] = (A[:, S_CORR]
+                        + np.where(bdrop, A[:, S_LAST_VAL], 0.0)) \
+            + B[:, S_CORR]
+        R[:, S_CHANGES] = A[:, S_CHANGES] \
+            + (B[:, S_FIRST_VAL] != A[:, S_LAST_VAL]) + B[:, S_CHANGES]
+        out[m] = R
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-partition sealed-chunk bundles (summary matrices, cached by version)
+
+class _ChunkBundle:
+    __slots__ = ("starts", "ends", "stats", "chunks", "sketches")
+
+    def __init__(self, starts, ends, stats, chunks, sketches):
+        self.starts = starts
+        self.ends = ends
+        self.stats = stats  # [C, STATS_WIDTH] for count>0 chunks only
+        self.chunks = chunks
+        self.sketches = sketches
+
+
+def _chunk_col_stats(ch, col: int, decode_mode: bool):
+    """(stats row, sketch) of one sealed chunk's value column."""
+    if decode_mode:
+        cs = summarize_values(np.asarray(ch.decode_column(0), np.int64),
+                              np.asarray(ch.decode_column(col), np.float64))
+        return cs.stats, cs.sketch
+    summary = ensure_summary(ch)
+    cs = summary[col] if summary is not None and col < len(summary) else None
+    if cs is None:
+        raise _Bypass
+    return cs.stats, cs.sketch
+
+
+def _part_bundle(p, col: int, decode_mode: bool) -> _ChunkBundle:
+    chs = p.chunks
+    token = (len(chs), chs[-1].id if chs else 0, col, decode_mode)
+    cache = getattr(p, "_sc_cache", None)
+    if cache is not None and cache[0] == token:
+        return cache[1]
+    rows, sketches, keep = [], [], []
+    for ch in chs:
+        st, sk = _chunk_col_stats(ch, col, decode_mode)
+        if st[S_COUNT] > 0:
+            rows.append(st)
+            sketches.append(sk)
+            keep.append(ch)
+    if rows:
+        stats = np.vstack(rows)
+        starts = stats[:, S_FIRST_TS].astype(np.int64)
+        ends = stats[:, S_LAST_TS].astype(np.int64)
+        # exactness requires time-ordered, non-overlapping chunks (valid
+        # sample spans): out-of-order seals fall back to the decode lane
+        if len(starts) > 1 and (np.any(np.diff(starts) <= 0)
+                                or np.any(starts[1:] <= ends[:-1])):
+            raise _Bypass
+    else:
+        stats = np.zeros((0, STATS_WIDTH), np.float64)
+        starts = ends = np.zeros(0, np.int64)
+    bundle = _ChunkBundle(starts, ends, stats, keep, sketches)
+    try:
+        p._sc_cache = (token, bundle)
+    except AttributeError:
+        pass
+    return bundle
+
+
+def _interior_fold(bundle: _ChunkBundle, t0s, t1s):
+    """Merged stats rows [W, 12] of the interior chunk run per window, plus
+    the [i0, i1) run bounds (for edge-chunk identification)."""
+    C = len(bundle.starts)
+    W = len(t0s)
+    out = np.zeros((W, STATS_WIDTH), np.float64)
+    out[:, S_MIN:S_LAST_VAL + 1] = np.nan
+    if C == 0:
+        z = np.zeros(W, np.int64)
+        return out, z, z
+    st = bundle.stats
+    i0 = np.searchsorted(bundle.starts, t0s, side="right")
+    i1 = np.searchsorted(bundle.ends, t1s, side="right")
+    i1 = np.maximum(i1, i0)
+    have = i1 > i0
+    pc = _eprefix(st[:, S_COUNT])
+    ps = _eprefix(st[:, S_SUM])
+    ps2 = _eprefix(st[:, S_SUMSQ])
+    pr = _eprefix(st[:, S_RESETS])
+    pcorr = _eprefix(st[:, S_CORR])
+    pchg = _eprefix(st[:, S_CHANGES])
+    # chunk-boundary reset/change carry between consecutive kept chunks
+    if C > 1:
+        bdrop = st[1:, S_FIRST_VAL] < st[:-1, S_LAST_VAL]
+        br = _eprefix(bdrop.astype(np.float64))
+        bc = _eprefix(np.where(bdrop, st[:-1, S_LAST_VAL], 0.0))
+        bg = _eprefix(
+            (st[1:, S_FIRST_VAL] != st[:-1, S_LAST_VAL]).astype(np.float64))
+    else:
+        br = bc = bg = np.zeros(1, np.float64)
+    out[:, S_COUNT] = pc[i1] - pc[i0]
+    out[:, S_SUM] = ps[i1] - ps[i0]
+    out[:, S_SUMSQ] = ps2[i1] - ps2[i0]
+    # boundaries between chunks c,c+1 with both inside [i0, i1)
+    blo = np.minimum(i0, len(br) - 1)
+    bhi = np.clip(i1 - 1, blo, len(br) - 1)
+    out[:, S_RESETS] = (pr[i1] - pr[i0]) + (br[bhi] - br[blo])
+    out[:, S_CORR] = (pcorr[i1] - pcorr[i0]) + (bc[bhi] - bc[blo])
+    out[:, S_CHANGES] = (pchg[i1] - pchg[i0]) + (bg[bhi] - bg[blo])
+    fi = np.clip(i0, 0, C - 1)
+    li = np.clip(i1 - 1, 0, C - 1)
+    out[:, S_FIRST_TS] = np.where(have, st[fi, S_FIRST_TS], np.nan)
+    out[:, S_FIRST_VAL] = np.where(have, st[fi, S_FIRST_VAL], np.nan)
+    out[:, S_LAST_TS] = np.where(have, st[li, S_LAST_TS], np.nan)
+    out[:, S_LAST_VAL] = np.where(have, st[li, S_LAST_VAL], np.nan)
+    if C * W <= 1 << 22:
+        sel = (np.arange(C)[:, None] >= i0[None, :]) \
+            & (np.arange(C)[:, None] < i1[None, :])
+        mn = np.where(sel, st[:, S_MIN][:, None], np.inf).min(axis=0)
+        mx = np.where(sel, st[:, S_MAX][:, None], -np.inf).max(axis=0)
+    else:  # very wide scans: per-window gather to bound memory
+        mn = np.array([st[a:b, S_MIN].min() if b > a else np.inf
+                       for a, b in zip(i0, i1)])
+        mx = np.array([st[a:b, S_MAX].max() if b > a else -np.inf
+                       for a, b in zip(i0, i1)])
+    out[:, S_MIN] = np.where(have, mn, np.nan)
+    out[:, S_MAX] = np.where(have, mx, np.nan)
+    out[~have, S_COUNT] = 0.0
+    return out, i0, i1
+
+
+_CHUNK_FA = "_fold_arrays"
+
+
+def _chunk_fa(ch, col: int) -> _FoldArrays:
+    """Decoded + NaN-filtered fold arrays for an edge chunk, memoized on the
+    (immutable) chunk object per column."""
+    cache = ch.__dict__.get(_CHUNK_FA)
+    if cache is None:
+        object.__setattr__(ch, _CHUNK_FA, {})
+        cache = ch.__dict__[_CHUNK_FA]
+    fa = cache.get(col)
+    if fa is None:
+        tv, vv = _valid_series(ch.decode_column(0), ch.decode_column(col))
+        fa = cache[col] = _FoldArrays(tv, vv)
+    return fa
+
+
+def _edge_stats(bundle: _ChunkBundle, col: int, edge_idx: np.ndarray,
+                t0s, t1s, touched: set) -> np.ndarray:
+    """Stats rows [W, 12] of the window∩chunk slice for per-window edge
+    chunk indices (-1 = no edge chunk for that window)."""
+    W = len(edge_idx)
+    out = np.zeros((W, STATS_WIDTH), np.float64)
+    out[:, S_MIN:S_LAST_VAL + 1] = np.nan
+    for c in np.unique(edge_idx[edge_idx >= 0]):
+        k = np.flatnonzero(edge_idx == c)
+        fa = _chunk_fa(bundle.chunks[c], col)
+        out[k] = _fold_windows(fa, t0s[k], t1s[k])
+        touched.add(id(bundle.chunks[c]))
+    return out
+
+
+def eval_partition_windows(p, col: int, t0s, t1s, buf_rows, decode_mode: bool,
+                           stats_acc: dict) -> np.ndarray:
+    """General path for a partition whose sealed chunks overlap the windows:
+    interior-from-summaries + decoded edges + buffer tail, merged in time
+    order. ``buf_rows`` [W, 12] is the already-folded write-buffer segment.
+    Returns merged stats rows [W, 12]."""
+    bundle = _part_bundle(p, col, decode_mode)
+    interior, i0, i1 = _interior_fold(bundle, t0s, t1s)
+    C = len(bundle.starts)
+    # overlap run [o0, o1): left edge = chunk straddling t0, right edge =
+    # chunk straddling t1 (each at most one for non-overlapping chunks)
+    o0 = np.searchsorted(bundle.ends, t0s, side="right")
+    o1 = np.searchsorted(bundle.starts, t1s, side="right")
+    left = np.where(o0 < i0, o0, -1)
+    re_idx = o1 - 1
+    right = np.where((re_idx >= i1) & (re_idx >= 0) & (re_idx < C)
+                     & (re_idx != left), re_idx, -1)
+    touched: set = set()
+    lstats = _edge_stats(bundle, col, left, t0s, t1s, touched)
+    rstats = _edge_stats(bundle, col, right, t0s, t1s, touched)
+    merged = _merge_vec(_merge_vec(_merge_vec(lstats, interior), rstats),
+                        buf_rows)
+    # exactness: the buffer must strictly follow every sealed sample it is
+    # merged after (out-of-order ingest violates the segment order)
+    pre = _merge_vec(_merge_vec(lstats, interior), rstats)
+    both = (pre[:, S_COUNT] > 0) & (buf_rows[:, S_COUNT] > 0)
+    if np.any(buf_rows[both, S_FIRST_TS] <= pre[both, S_LAST_TS]):
+        raise _Bypass
+    stats_acc["sidecar_chunks"] = stats_acc.get("sidecar_chunks", 0) \
+        + int((i1 - i0).sum())
+    stats_acc["decoded_chunks"] = stats_acc.get("decoded_chunks", 0) \
+        + len(touched)
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# range-function formulas over merged stats (mirrors kernels._range_impl)
+
+def formula(fn: str, st: np.ndarray, steps_ms: np.ndarray, window_ms: int,
+            counter: bool) -> np.ndarray:
+    """st: [..., W, 12] merged stats; steps_ms: [W] absolute eval steps.
+    Returns [..., W] float64 values with kernel-matching NaN gating."""
+    n = st[..., S_COUNT]
+    has1 = n >= 1
+    nan = np.nan
+    with np.errstate(divide="ignore", invalid="ignore"):
+        if fn == "count_over_time":
+            return np.where(has1, n, nan)
+        if fn == "present_over_time":
+            return np.where(has1, 1.0, nan)
+        if fn == "absent_over_time":
+            return np.where(has1, nan, 1.0)
+        if fn == "sum_over_time":
+            return np.where(has1, st[..., S_SUM], nan)
+        if fn == "avg_over_time":
+            return np.where(has1, st[..., S_SUM] / np.maximum(n, 1.0), nan)
+        if fn in ("stddev_over_time", "stdvar_over_time", "zscore"):
+            mean = st[..., S_SUM] / np.maximum(n, 1.0)
+            var = np.maximum(
+                st[..., S_SUMSQ] / np.maximum(n, 1.0) - mean * mean, 0.0)
+            if fn == "stdvar_over_time":
+                return np.where(has1, var, nan)
+            sd = np.sqrt(var)
+            if fn == "stddev_over_time":
+                return np.where(has1, sd, nan)
+            return np.where(has1, (st[..., S_LAST_VAL] - mean) / sd, nan)
+        if fn == "min_over_time":
+            return np.where(has1, st[..., S_MIN], nan)
+        if fn == "max_over_time":
+            return np.where(has1, st[..., S_MAX], nan)
+        if fn in ("last_over_time", "last_sample"):
+            return np.where(has1, st[..., S_LAST_VAL], nan)
+        if fn == "timestamp":
+            return np.where(has1, st[..., S_LAST_TS] / 1000.0, nan)
+        if fn == "changes":
+            return np.where(has1, st[..., S_CHANGES], nan)
+        if fn == "resets":
+            return np.where(has1, st[..., S_RESETS], nan)
+        if fn in ("rate", "increase", "delta"):
+            has2 = n >= 2
+            corrected = counter or fn in ("rate", "increase")
+            raw_first = st[..., S_FIRST_VAL]
+            v_last = st[..., S_LAST_VAL]
+            if corrected:
+                v_last = v_last + st[..., S_CORR]
+            result = v_last - raw_first
+            t_first = st[..., S_FIRST_TS] / 1000.0
+            t_last = st[..., S_LAST_TS] / 1000.0
+            range_start = (steps_ms - window_ms) / 1000.0
+            range_end = steps_ms / 1000.0
+            sampled = t_last - t_first
+            avg_dur = sampled / np.maximum(n - 1.0, 1.0)
+            dur_start = t_first - range_start
+            dur_end = range_end - t_last
+            if fn in ("rate", "increase"):
+                dur_to_zero = np.where(
+                    result > 0,
+                    sampled * raw_first / np.maximum(result, 1e-30), np.inf)
+                dur_start = np.minimum(dur_start, dur_to_zero)
+            threshold = avg_dur * 1.1
+            extend = sampled \
+                + np.where(dur_start < threshold, dur_start, avg_dur / 2.0) \
+                + np.where(dur_end < threshold, dur_end, avg_dur / 2.0)
+            result = result * (extend / np.maximum(sampled, 1e-10))
+            if fn == "rate":
+                result = result / (window_ms / 1000.0)
+            return np.where(has2, result, nan)
+    raise _Bypass
+
+
+# ---------------------------------------------------------------------------
+# leaf entry point
+
+def try_execute(plan, ctx):
+    """Attempt to serve a SelectRawPartitionsExec leaf's windowing stage from
+    sidecars. Returns the PeriodicSamplesMapper-equivalent StepMatrix (the
+    caller applies the remaining transformers), or None to fall back to the
+    decode lane."""
+    m = mode()
+    if m == "0":
+        return None
+    from filodb_tpu.query.exec.transformers import (
+        PeriodicSamplesMapper,
+        steps_array,
+    )
+    if not plan.transformers \
+            or not isinstance(plan.transformers[0], PeriodicSamplesMapper):
+        return None
+    psm = plan.transformers[0]
+    fn = psm.function or "last_sample"
+    approx = approx_enabled()
+    if fn not in ELIGIBLE_FNS \
+            and not (fn == "quantile_over_time" and approx):
+        SIDECAR_BYPASSED.inc()
+        return None
+    if psm.at_ms is not None or (psm.params and fn != "quantile_over_time") \
+            or ctx.budget is not None:
+        SIDECAR_BYPASSED.inc()
+        return None
+    try:
+        return _execute(plan, ctx, psm, fn, m == "decode", approx)
+    except _Bypass:
+        SIDECAR_BYPASSED.inc()
+        return None
+
+
+def _execute(plan, ctx, psm, fn, decode_mode: bool, approx: bool):
+    from filodb_tpu.core.memstore.native_shard import NativeBackedPartition
+    from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+    from filodb_tpu.query.exec.transformers import steps_array
+    from filodb_tpu.query.model import QueryLimitExceeded, StepMatrix
+
+    memstore = plan.store if plan.store is not None else ctx.memstore
+    dataset = plan.dataset_name or ctx.dataset
+    shard = memstore.get_shard(dataset, plan.shard)
+    cfg = getattr(shard, "config", None)
+    if cfg is None:
+        raise _Bypass
+    part_ids = shard.lookup_partitions(list(plan.filters), plan.chunk_start,
+                                       plan.chunk_end)
+    max_matches = getattr(cfg, "max_query_matches", 0)
+    if max_matches and len(part_ids) > max_matches:
+        raise QueryLimitExceeded(
+            f"query matches {len(part_ids)} series on shard "
+            f"{plan.shard} > limit {max_matches}")
+    parts = [shard.partition(pid) for pid in part_ids]
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        raise _Bypass  # let the decode lane produce the canonical empty
+    for p in parts:
+        if type(p) is not TimeSeriesPartition \
+                and type(p) is not NativeBackedPartition:
+            raise _Bypass  # paged shells / duck-typed tier partitions
+    if getattr(cfg, "demand_paging_enabled", False):
+        # the decode lane would pull cold chunks for partitions whose
+        # resident data doesn't reach the query start — those windows
+        # can't be folded from in-memory sidecars alone
+        from filodb_tpu.core.memstore.odp import needs_paging
+        for p in parts:
+            if needs_paging(p, shard.index.start_time(p.part_id),
+                            plan.chunk_start):
+                raise _Bypass
+    steps = steps_array(psm.start, psm.step, psm.end)
+    eval_steps = (steps - psm.offset).astype(np.int64)
+    window = int(psm.window if psm.function else 300_000)
+    # decode-lane parity: build_batch only sees samples inside
+    # [chunk_start, chunk_end], so windows clip to that range
+    t1s = np.minimum(eval_steps, int(plan.chunk_end))
+    t0s = np.maximum(eval_steps - window, int(plan.chunk_start) - 1)
+    by_schema: dict[str, list] = {}
+    for p in parts:
+        by_schema.setdefault(p.schema.name, []).append(p)
+    mats = []
+    stats_acc: dict = {}
+    t_fold = time.perf_counter()
+    for schema_name, sparts in by_schema.items():
+        schema = sparts[0].schema
+        col = plan._value_col_index(schema)
+        if schema.data.columns[col].ctype not in _SCALAR_CTYPES:
+            raise _Bypass
+        counter = schema.data.columns[col].is_counter
+        with span("decode", schema=schema_name,
+                  partitions=len(sparts), sidecar=True):
+            if fn == "quantile_over_time":
+                out = _eval_group_quantile(
+                    sparts, col, float(psm.params[0]), t0s, t1s,
+                    decode_mode, stats_acc)
+            else:
+                st = _eval_group_stats(sparts, col, t0s, t1s,
+                                       decode_mode, stats_acc)
+                stats_acc["samples"] = stats_acc.get("samples", 0.0) \
+                    + float(st[:, :, S_COUNT].sum())
+                out = formula(fn, st, eval_steps.astype(np.float64),
+                              window, counter)
+        keys = [p.part_key.range_vector_key for p in sparts]
+        mats.append(StepMatrix(psm._out_keys(keys), out, steps))
+    data = StepMatrix.concat(mats) if len(mats) > 1 else mats[0]
+    ctx.stats.series_scanned += len(parts)
+    # stats semantics in this lane: samples_scanned is the per-window
+    # samples-ACCOUNTED figure (the number Prometheus reports as samples
+    # processed — interior samples are folded, never materialized);
+    # chunks_touched counts every chunk consulted, with the sidecar-folded
+    # share broken out in sidecar_chunks; the whole fold (edge decodes +
+    # summary reads) is this lane's decode stage, so its wall time lands
+    # in decode_s.
+    ctx.stats.samples_scanned += int(stats_acc.get("samples", 0.0))
+    ctx.stats.sidecar_chunks += stats_acc.get("sidecar_chunks", 0)
+    ctx.stats.chunks_touched += stats_acc.get("decoded_chunks", 0) \
+        + stats_acc.get("sidecar_chunks", 0)
+    ctx.stats.decode_s += time.perf_counter() - t_fold
+    SIDECAR_SERVED.inc()
+    return data
+
+
+def _buf_rows_python(p, col: int, t0s, t1s) -> np.ndarray:
+    b = p._buf
+    n = b.n
+    if n == 0:
+        out = np.zeros((len(t0s), STATS_WIDTH), np.float64)
+        out[:, S_MIN:S_LAST_VAL + 1] = np.nan
+        return out
+    ts = b.ts[:n]
+    if n > 1 and np.any(np.diff(ts) < 0):
+        raise _Bypass
+    tv, vv = _valid_series(ts, b.cols[col - 1][:n])
+    return _fold_windows(_FoldArrays(tv, vv), t0s, t1s)
+
+
+def _eval_group_stats(sparts, col: int, t0s, t1s, decode_mode: bool,
+                      stats_acc: dict) -> np.ndarray:
+    """Merged stats tensor [P, W, 12] for one schema group."""
+    from filodb_tpu.core.memstore.native_shard import NativeBackedPartition
+    P, W = len(sparts), len(t0s)
+    st = np.zeros((P, W, STATS_WIDTH), np.float64)
+    # batched native buffer fold: one C call per shard core
+    by_core: dict[int, list[int]] = {}
+    cores = {}
+    sealed_overlap = np.zeros(P, bool)
+    buf_rows = [None] * P
+    for i, p in enumerate(sparts):
+        if isinstance(p, NativeBackedPartition):
+            key = id(p._core)
+            cores[key] = p._core
+            by_core.setdefault(key, []).append(i)
+        else:
+            buf_rows[i] = _buf_rows_python(p, col, t0s, t1s)
+            sealed_overlap[i] = any(
+                c.end_time > t0s.min() and c.start_time <= t1s.max()
+                for c in p.chunks)
+    for key, idxs in by_core.items():
+        core = cores[key]
+        pids = np.array([sparts[i].part_id for i in idxs], np.int32)
+        folded = core.buf_fold(pids, t0s, t1s, col - 1)
+        if folded is None:  # pre-sidecar .so: python per-partition fallback
+            for i in idxs:
+                buf_rows[i] = _buf_rows_python(sparts[i], col, t0s, t1s)
+                sealed_overlap[i] = bool(sparts[i].chunks) and any(
+                    c.end_time > t0s.min() and c.start_time <= t1s.max()
+                    for c in sparts[i].chunks)
+            continue
+        rows, flags = folded
+        if np.any(flags & 1):
+            raise _Bypass  # out-of-order buffer (or bad column)
+        for j, i in enumerate(idxs):
+            buf_rows[i] = rows[j]
+            sealed_overlap[i] = bool(flags[j] & 2)
+    if not _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W):
+        raise _Bypass  # sealed fold wouldn't amortize — decode lane wins
+    for i, p in enumerate(sparts):
+        if sealed_overlap[i]:
+            st[i] = eval_partition_windows(p, col, t0s, t1s, buf_rows[i],
+                                           decode_mode, stats_acc)
+        else:
+            st[i] = buf_rows[i]
+    return st
+
+
+def _eval_group_quantile(sparts, col: int, q: float, t0s, t1s,
+                         decode_mode: bool, stats_acc: dict) -> np.ndarray:
+    """Approximate quantile_over_time from mergeable sketches (declared
+    approximation: FILODB_SIDECAR_APPROX=1). Interior chunks contribute
+    their stored sketches; edge/buffer slices are sketched from values."""
+    from filodb_tpu.memory.chunk import SKETCH_BUCKETS, _sketch_values
+    from filodb_tpu.query.engine.aggregations import sketch_quantile
+    P, W = len(sparts), len(t0s)
+    gate = _sealed_gate()
+    if gate > 0 and P * W > gate:
+        raise _Bypass  # per-window sketch merge wouldn't amortize
+    out = np.full((P, W), np.nan)
+    samples = 0
+    for i, p in enumerate(sparts):
+        bundle = _part_bundle(p, col, decode_mode)
+        _, i0, i1 = _interior_fold(bundle, t0s, t1s)
+        b = p._buf
+        n = b.n
+        btv = bvv = None
+        if n:
+            btv, bvv = _valid_series(b.ts[:n], b.cols[col - 1][:n])
+        for k in range(W):
+            sk = np.zeros(SKETCH_BUCKETS, np.int64)
+            total = 0
+            for c in range(i0[k], i1[k]):
+                s = bundle.sketches[c]
+                if s is None:
+                    raise _Bypass
+                sk += s
+                total += int(bundle.stats[c, S_COUNT])
+            for c in list(range(min(i0[k], len(bundle.chunks)))) \
+                    + list(range(i1[k], len(bundle.chunks))):
+                ch = bundle.chunks[c]
+                if ch.end_time > t0s[k] and ch.start_time <= t1s[k]:
+                    fa = _chunk_fa(ch, col)
+                    m = (fa.tv > t0s[k]) & (fa.tv <= t1s[k])
+                    sk += _sketch_values(fa.vv[m]).astype(np.int64)
+                    total += int(m.sum())
+            if btv is not None:
+                m = (btv > t0s[k]) & (btv <= t1s[k])
+                sk += _sketch_values(bvv[m]).astype(np.int64)
+                total += int(m.sum())
+            if total:
+                out[i, k] = sketch_quantile(q, sk)
+            samples += total
+    stats_acc["sidecar_chunks"] = stats_acc.get("sidecar_chunks", 0)
+    stats_acc["samples"] = stats_acc.get("samples", 0.0) + float(samples)
+    return out

@@ -27,6 +27,7 @@ import numpy as np
 from filodb_tpu.core.filters import ColumnFilter
 from filodb_tpu.core.partkey import METRIC_LABEL
 from filodb_tpu.core.schemas import ColumnType
+from filodb_tpu.query.engine import sidecar_lane
 from filodb_tpu.query.engine.batch import build_batch
 from filodb_tpu.query.exec.transformers import (
     RangeVectorTransformer,
@@ -187,8 +188,26 @@ class SelectRawPartitionsExec(ExecPlan):
     dataset_name: str | None = None
 
     def do_execute(self, ctx: ExecContext) -> StepMatrix:
+        # sidecar lane: serve the windowing stage from chunk aggregate
+        # summaries when the range function decomposes exactly over them
+        # (engine/sidecar_lane.py); falls through to the decode lane on any
+        # eligibility miss
+        # one "scan" span per leaf regardless of which lane serves it —
+        # a sidecar bypass mid-fold falls through to the decode scan
+        # inside the SAME span, so distributed trace trees keep exactly
+        # one scan per shard
         with span("scan", shard=self.shard):
-            outs = self._scan_batches(ctx)
+            data = sidecar_lane.try_execute(self, ctx)
+            outs = None if data is not None else self._scan_batches(ctx)
+        if data is not None:
+            with span("reduce"):
+                t0 = time.perf_counter()
+                for t in self.transformers[1:]:
+                    if hasattr(t, "bind"):
+                        t.bind(ctx)
+                    data = t.apply(data)
+                ctx.stats.reduce_s += time.perf_counter() - t0
+            return data
         if outs is None:
             return StepMatrix.empty()
         with span("reduce"):

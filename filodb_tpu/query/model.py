@@ -204,6 +204,9 @@ class QueryStats:
     admission_wait_s: float = 0.0
     decode_s: float = 0.0
     reduce_s: float = 0.0
+    # chunk-window folds served from aggregate sidecars without decoding
+    # (engine/sidecar_lane.py); decoded edge chunks land in chunks_touched
+    sidecar_chunks: int = 0
     # tiered federation (query/federation.py): per-tier attribution of a
     # federated query — {tier: {subqueries, series, samples, chunks,
     # bytes, decodeMs, wallMs}} recorded by TierExec at the routing root;
@@ -224,6 +227,7 @@ class QueryStats:
         self.admission_wait_s += other.admission_wait_s
         self.decode_s += other.decode_s
         self.reduce_s += other.reduce_s
+        self.sidecar_chunks += other.sidecar_chunks
         for tier, bucket in other.tiers.items():
             mine = self.tiers.setdefault(tier, {})
             for k, v in bucket.items():

@@ -1697,4 +1697,78 @@ void tagindex_load_label(void* h, const char* labn, int64_t ll,
     lab.frozen.pids.assign(pids, pids + npids);
 }
 
+// ---------------------------------------------------------------------------
+// batched write-buffer window fold (aggregate-sidecar query lane)
+//
+// For each pid and each window (t0[w], t1[w]], folds the buffer samples of
+// value column `col` (index into NPart::cols) into a 12-double stats row:
+//   [count, sum, sumsq, min, max, first_ts, first_val, last_ts, last_val,
+//    resets, corr, changes]
+// NaN samples are skipped; accumulation is strictly sequential, matching
+// the numpy cumsum semantics of memory/chunk.summarize_values bit for bit.
+// flags_out[i]: bit0 = buffer timestamps non-monotone (caller must bypass),
+// bit1 = a sealed chunk overlaps (min t0, max t1] (buffer-only fold is
+// incomplete for this partition).
+int32_t shard_buf_fold(void* cp, const int32_t* pids, int32_t npids,
+                       const int64_t* t0s, const int64_t* t1s, int32_t nwin,
+                       int32_t col, double* out, int32_t* flags_out) {
+    ShardCore* c = static_cast<ShardCore*>(cp);
+    const double qnan = std::numeric_limits<double>::quiet_NaN();
+    int64_t g0 = INT64_MAX, g1 = INT64_MIN;
+    for (int32_t w = 0; w < nwin; w++) {
+        if (t0s[w] < g0) g0 = t0s[w];
+        if (t1s[w] > g1) g1 = t1s[w];
+    }
+    for (int32_t i = 0; i < npids; i++) {
+        NPart& p = c->parts[pids[i]];
+        int32_t flags = 0;
+        for (auto& s : p.sealed)
+            if (s.end > g0 && s.start <= g1) { flags |= 2; break; }
+        size_t n = p.ts.size();
+        if (col < 0 || (size_t)col >= p.cols.size()) flags |= 1;
+        for (size_t k = 1; k < n; k++)
+            if (p.ts[k] < p.ts[k - 1]) { flags |= 1; break; }
+        flags_out[i] = flags;
+        double* rows = out + (size_t)i * nwin * 12;
+        if (flags & 1) continue;
+        const int64_t* ts = p.ts.data();
+        const double* vals = p.cols[col].data();
+        for (int32_t w = 0; w < nwin; w++) {
+            double* r = rows + (size_t)w * 12;
+            size_t lo = std::upper_bound(ts, ts + n, t0s[w]) - ts;
+            size_t hi = std::upper_bound(ts, ts + n, t1s[w]) - ts;
+            double cnt = 0, sum = 0, sumsq = 0, mn = qnan, mx = qnan;
+            double fts = qnan, fv = qnan, lts = qnan, lv = qnan;
+            double resets = 0, corr = 0, changes = 0;
+            bool have_prev = false;
+            double prev = 0;
+            for (size_t k = lo; k < hi; k++) {
+                double v = vals[k];
+                if (v != v) continue;
+                cnt += 1;
+                sum += v;
+                sumsq += v * v;
+                if (!have_prev) {
+                    mn = mx = v;
+                    fts = (double)ts[k];
+                    fv = v;
+                } else {
+                    if (v < mn) mn = v;
+                    if (v > mx) mx = v;
+                    if (v < prev) { resets += 1; corr += prev; }
+                    if (v != prev) changes += 1;
+                }
+                lts = (double)ts[k];
+                lv = v;
+                prev = v;
+                have_prev = true;
+            }
+            r[0] = cnt; r[1] = sum; r[2] = sumsq; r[3] = mn; r[4] = mx;
+            r[5] = fts; r[6] = fv; r[7] = lts; r[8] = lv;
+            r[9] = resets; r[10] = corr; r[11] = changes;
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"

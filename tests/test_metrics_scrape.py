@@ -61,6 +61,11 @@ EXPECTED_NAMES = [
     "memstore_partitions_queried_total",
     "memstore_chunks_queried_total",
     "query_time_range_minutes_count",
+    # chunk aggregate sidecars (query/engine/sidecar_lane.py,
+    # memory/chunk.py) — registered at import time
+    "filodb_sidecar_served_total",
+    "filodb_sidecar_bypassed_total",
+    "filodb_sidecar_backfilled_total",
     # ODP
     "chunks_paged_in_total",
     "memstore_partitions_paged_in_total",

@@ -392,3 +392,45 @@ class TestConfig:
         assert ResultCache.from_config(cc).config.extent_steps == 9
         same = ResultCache(ResultCacheConfig())
         assert ResultCache.from_config(same) is same
+
+
+class TestSidecarProvenanceInvariance:
+    """The sidecar lane (FILODB_SIDECARS, PR 15) changes HOW a leaf is
+    evaluated, never WHAT it returns — so cached extents populated under
+    one provenance must serve unchanged under any other, and the cache
+    signature must not encode the valve at all."""
+
+    QUERIES = [
+        "sum(rate(http_requests_total[5m]))",
+        "avg_over_time(heap_usage[3m])",
+        "max_over_time(heap_usage[7m])",
+    ]
+
+    def test_signature_ignores_valve(self, monkeypatch):
+        from filodb_tpu.promql.parser import TimeStepParams, parse_query
+
+        def sig(mode):
+            monkeypatch.setenv("FILODB_SIDECARS", mode)
+            return plan_signature(parse_query(
+                "sum(rate(http_requests_total[5m]))",
+                TimeStepParams(QS, STEP, QE), 300_000))
+
+        assert sig("1") == sig("decode") == sig("0")
+
+    @pytest.mark.parametrize("populate,serve", [("1", "0"), ("0", "1"),
+                                                ("1", "decode")])
+    def test_extents_cached_under_one_mode_serve_another(
+            self, plain, cached, monkeypatch, populate, serve):
+        for q in self.QUERIES:
+            monkeypatch.setenv("FILODB_SIDECARS", populate)
+            direct = plain.query_range(q, QS, STEP, QE)
+            cold = cached.query_range(q, QS, STEP, QE)
+            assert_equivalent(direct, cold)
+            # flip the valve: warm hits below come from extents that were
+            # computed under the OTHER provenance
+            monkeypatch.setenv("FILODB_SIDECARS", serve)
+            h0 = rc.cache_hits.value
+            warm = cached.query_range(q, QS, STEP, QE)
+            assert rc.cache_hits.value > h0
+            assert_equivalent(direct, warm)
+            assert_equivalent(plain.query_range(q, QS, STEP, QE), warm)
