@@ -1,0 +1,191 @@
+"""Replication benchmark: warm map-flip failover vs cold recovery, and
+hedged replica reads vs single-target tail latency.
+
+Two measurements for the continuous-replication subsystem
+(``coordinator/replication.py``):
+
+- ``failover``: time from node loss to every lost shard serving again —
+  once with an IN_SYNC follower per shard (promotion = ONE sequenced
+  ACTIVE event, ingest resumes at the follower's applied offset) and once
+  without replicas (cold recovery: DOWN, reassign, manifest read, index
+  recovery, WAL replay from the checkpoints).
+- ``hedged reads``: p50/p99 dispatch latency over a replica set whose
+  primary stalls on a fraction of calls, with the hedge timer on vs
+  dispatching at the primary alone (the reference's tail-latency story).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+
+
+def _publish(logs, stream, num_shards, spread=1):
+    from filodb_tpu.coordinator.ingestion import route_container
+
+    for sd in stream:
+        for shard, cont in route_container(sd.container, num_shards,
+                                           spread).items():
+            logs[shard].append(cont)
+
+
+def _build(replication: int):
+    import tempfile
+
+    from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+    from filodb_tpu.core.store.objectstore import open_object_store
+    from filodb_tpu.kafka.log import InMemoryLog
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    tmp = tempfile.mkdtemp(prefix="filodb-repl-")
+    logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
+    keys = machine_metrics_series(96, ns="App-3")
+    _publish(logs, gauge_stream(keys, 480, start_ms=START * 1000),
+             NUM_SHARDS)
+    cluster = FilodbCluster(replica_in_sync_lag=0,
+                            replica_durable_sync_s=3600.0)
+    # per-node store instances over a shared bucket: cold recovery pays
+    # real manifest/segment reads, the warm flip must pay none
+    for n in ("node-a", "node-b", "node-c"):
+        cs, meta = open_object_store({"endpoint": None, "bucket": "bench"},
+                                     tmp)
+        cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
+    cluster.setup_dataset(
+        IngestionConfig("timeseries", NUM_SHARDS, min_num_nodes=2,
+                        store=StoreConfig(max_chunk_size=60,
+                                          groups_per_shard=2)), logs)
+    assert cluster.wait_active("timeseries", 15)
+    # seal + checkpoint, then publish a WAL tail past the checkpoints:
+    # cold recovery replays it from the durable watermarks; a promoted
+    # follower already holds it and resumes at its applied offset
+    for node in cluster.nodes.values():
+        for (ds, s) in list(node._workers):
+            node.memstore.get_shard(ds, s).flush_all()
+        fl = getattr(node.memstore.column_store, "flush", None)
+        if callable(fl):
+            fl()
+    _publish(logs, gauge_stream(keys, 240,
+                                start_ms=(START + 9600) * 1000),
+             NUM_SHARDS)
+    # warm the query path (plan build + kernel compile) so the failover
+    # measurement times the flip/recovery, not one-time compilation
+    cluster.query_service("timeseries", spread=1).query_range(
+        'sum(heap_usage{_ns_="App-3"})', START + 600, 300, START + 1500)
+    if replication:
+        cluster.replication = replication
+        sm = cluster.shard_managers["timeseries"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(sm.mapper.in_sync_followers(s)
+                   and all(st.watermark >= logs[s].latest_offset
+                           for st in sm.mapper.replicas_of(s).values())
+                   for s in range(NUM_SHARDS)):
+                break
+            cluster.ensure_replicas("timeseries")
+            time.sleep(0.02)
+    return cluster
+
+
+def _failover_ms(cluster) -> float:
+    """Kill node-a; time until every shard is owned + ACTIVE again — the
+    unavailability window (promotion or recovery runs synchronously inside
+    ``leave``).  A full fan-out query afterwards validates the result but
+    is kept out of the timed window since its cost is identical on both
+    paths.  Also reports the objectstore GETs the path issued — the flip's
+    zero-GET property is machine-independent, unlike wall time over a
+    local-disk FakeS3."""
+    from filodb_tpu.coordinator.shardmapper import ShardStatus
+    from filodb_tpu.core.store.objectstore import GETS
+
+    sm = cluster.shard_managers["timeseries"]
+    gets0 = GETS.value
+    t0 = time.perf_counter()
+    cluster.leave("node-a")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if all(sm.mapper.node_for(s) is not None
+               and sm.mapper.statuses[s] == ShardStatus.ACTIVE
+               for s in range(NUM_SHARDS)):
+            break
+        time.sleep(0.0005)
+    ms = (time.perf_counter() - t0) * 1000.0
+    res = cluster.query_service("timeseries", spread=1).query_range(
+        'sum(heap_usage{_ns_="App-3"})', START + 600, 300, START + 1500)
+    assert res, "post-failover query returned no series"
+    return ms, GETS.value - gets0
+
+
+def _hedge_latencies(hedge: bool, n: int = 300):
+    """Dispatch over a 2-candidate replica set whose primary stalls every
+    5th call; with the hedge timer off the set degenerates to the primary
+    alone."""
+    from filodb_tpu.coordinator.replication import (
+        ReplicaCandidate,
+        ReplicaDispatcher,
+    )
+    from filodb_tpu.query.exec.plan import PlanDispatcher
+
+    class _Stub(PlanDispatcher):
+        def __init__(self, base_s, stall_s=0.0, stall_every=0):
+            self.base_s, self.stall_s = base_s, stall_s
+            self.stall_every, self.calls = stall_every, 0
+
+        def dispatch(self, plan, ctx):
+            self.calls += 1
+            slow = self.stall_every and self.calls % self.stall_every == 0
+            time.sleep(self.stall_s if slow else self.base_s)
+            return "ok"
+
+    cands = [ReplicaCandidate("bench-leader",
+                              _Stub(0.001, stall_s=0.040, stall_every=5))]
+    if hedge:
+        cands.append(ReplicaCandidate("bench-follower", _Stub(0.002),
+                                      follower=True))
+    rd = ReplicaDispatcher(0, cands, hedge_timeout_s=0.005)
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        rd.dispatch(None, None)
+        lat.append((time.perf_counter() - t0) * 1000.0)
+    lat.sort()
+    return lat[len(lat) // 2], lat[int(len(lat) * 0.99)]
+
+
+def bench_replication():
+    from filodb_tpu.utils.resilience import reset_breakers, reset_peer_latency
+
+    warm_cluster = _build(replication=1)
+    warm_ms, warm_gets = _failover_ms(warm_cluster)
+    warm_cluster.stop()
+    cold_cluster = _build(replication=0)
+    cold_ms, cold_gets = _failover_ms(cold_cluster)
+    cold_cluster.stop()
+    reset_breakers()
+    reset_peer_latency()
+    hedged_p50, hedged_p99 = _hedge_latencies(hedge=True)
+    solo_p50, solo_p99 = _hedge_latencies(hedge=False)
+    return {"metric": "replication",
+            "warm_failover_ms": round(warm_ms, 1),
+            "cold_failover_ms": round(cold_ms, 1),
+            "failover_speedup": round(cold_ms / max(warm_ms, 1e-9), 2),
+            "warm_failover_gets": warm_gets,
+            "cold_failover_gets": cold_gets,
+            "hedged_p50_ms": round(hedged_p50, 2),
+            "hedged_p99_ms": round(hedged_p99, 2),
+            "unhedged_p50_ms": round(solo_p50, 2),
+            "unhedged_p99_ms": round(solo_p99, 2),
+            "unit": "ms"}
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(bench_replication()))

@@ -474,6 +474,12 @@ def _bench_migration():
     return bench_migration()
 
 
+def _bench_replication():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from replication import bench_replication
+    return bench_replication()
+
+
 def _bench_rules():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from rules import bench_rules
@@ -527,6 +533,7 @@ ALL = {
     "overload": _bench_overload,
     "objectstore": _bench_objectstore,
     "migration": _bench_migration,
+    "replication": _bench_replication,
     "rules": _bench_rules,
     "sidecars": _bench_sidecars,
     "tracing_overhead": _bench_tracing_overhead,

@@ -54,6 +54,12 @@ BELOW_GATE_PREFIXES = ("filodb_tpu/query/", "filodb_tpu/parallel/")
 # like coordinator code so federated sub-query execution stays provably
 # under the admit() gate (TierExec must route through self.gather)
 GATED_QUERY_MODULES = ("filodb_tpu/query/federation.py",)
+# coordinator modules that are nonetheless below the gate:
+# ReplicaDispatcher is a PlanDispatcher routing layer — its dispatch()
+# is only ever reached through an already-admitted plan tree, and its
+# candidate fan-out (hedge/failover recursion into the wrapped
+# per-node dispatchers) must not re-admit: one query, one admission
+BELOW_GATE_MODULES = ("filodb_tpu/coordinator/replication.py",)
 DISPATCHER_BASE = "PlanDispatcher"
 
 
@@ -184,7 +190,8 @@ def _is_gated_call(call: ast.Call) -> str | None:
 
 def _check_cp502(ps: "_PassState", ctx: AnalysisContext) -> None:
     for mi in ctx.modules:
-        if mi.path.startswith(BELOW_GATE_PREFIXES) \
+        if (mi.path.startswith(BELOW_GATE_PREFIXES)
+                or mi.path in BELOW_GATE_MODULES) \
                 and mi.path not in GATED_QUERY_MODULES:
             continue
 

@@ -176,13 +176,18 @@ def cmd_shardmap(args):
     url = f"http://{args.host}/api/v1/cluster/{args.dataset}/shardmap"
     with urllib.request.urlopen(url) as r:
         doc = json.load(r)["data"]
-    print(f"{'SHARD':>5}  {'NODE':<16} {'STATUS':<10} MIGRATION")
+    print(f"{'SHARD':>5}  {'NODE':<16} {'STATUS':<10} {'WM':>8} "
+          f"{'MIGRATION':<24} REPLICAS")
     for entry in doc.get("shards", []):
         mig = entry.get("migration")
         migs = (f"{mig['phase']} {mig['source']}->{mig['dest']} "
                 f"lag={mig['lag']}" if mig else "-")
+        reps = " ".join(
+            f"{r['node']}:{r['status']}@{r.get('watermark', -1)}"
+            for r in entry.get("replicas", [])) or "-"
         print(f"{entry['shard']:>5}  {str(entry.get('node')):<16} "
-              f"{entry.get('status', '?'):<10} {migs}")
+              f"{entry.get('status', '?'):<10} "
+              f"{str(entry.get('watermark', '-')):>8} {migs:<24} {reps}")
     tenants = doc.get("tenants", [])
     if tenants:
         print(f"\n{'TENANT':<24} {'SERIES':>10} {'QUOTA':>10} "
@@ -192,6 +197,42 @@ def cmd_shardmap(args):
             infl = t["max_inflight"] or "-"
             print(f"{t['tenant']:<24} {t['active_series']:>10} "
                   f"{str(quota):>10} {str(infl):>12}")
+
+
+def cmd_replicacheck(args):
+    """Replica-divergence detector: compare each shard's leader watermark
+    against its followers' applied offsets over the shardmap API; a
+    follower trailing by more than ``--max-lag`` (or an IN_SYNC follower
+    with no watermark at all) is a divergence and the command exits 1 —
+    the filolint-style zero-divergence gate, runnable against a live
+    cluster."""
+    import urllib.request
+    url = f"http://{args.host}/api/v1/cluster/{args.dataset}/shardmap"
+    with urllib.request.urlopen(url) as r:
+        doc = json.load(r)["data"]
+    divergent = 0
+    checked = 0
+    print(f"{'SHARD':>5}  {'LEADER':<16} {'WM':>8}  "
+          f"{'FOLLOWER':<16} {'STATUS':<10} {'WM':>8}  VERDICT")
+    for entry in doc.get("shards", []):
+        leader_wm = entry.get("watermark")
+        for rep in entry.get("replicas", []):
+            checked += 1
+            rep_wm = rep.get("watermark", -1)
+            if rep["status"] != "in_sync":
+                verdict = f"skip ({rep['status']})"
+            elif leader_wm is None:
+                verdict = "skip (no leader watermark)"
+            elif leader_wm - rep_wm > args.max_lag:
+                verdict = f"DIVERGED (lag {leader_wm - rep_wm})"
+                divergent += 1
+            else:
+                verdict = "ok"
+            print(f"{entry['shard']:>5}  {str(entry.get('node')):<16} "
+                  f"{str(leader_wm):>8}  {rep['node']:<16} "
+                  f"{rep['status']:<10} {rep_wm:>8}  {verdict}")
+    print(f"\n{checked} replica(s) checked, {divergent} divergent")
+    return 1 if divergent else 0
 
 
 def cmd_rules(args):
@@ -528,6 +569,9 @@ def main(argv=None):
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
     sub.add_parser("shardmap")
+    p = sub.add_parser("replicacheck")
+    p.add_argument("--max-lag", type=int, default=0,
+                   help="offsets a follower may trail the leader by")
     sub.add_parser("rules")
     p = sub.add_parser("slowlog")
     p.add_argument("--limit", type=int, default=0,
@@ -564,7 +608,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
             "lag": cmd_lag, "tiers": cmd_tiers,
-            "shardmap": cmd_shardmap, "rules": cmd_rules,
+            "shardmap": cmd_shardmap, "replicacheck": cmd_replicacheck,
+            "rules": cmd_rules,
             "slowlog": cmd_slowlog,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
             "importcsv": cmd_importcsv, "promql": cmd_promql,

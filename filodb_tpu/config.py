@@ -116,6 +116,14 @@ DEFAULTS = {
         "lag_threshold": 0,           # max replay-offset lag at flip
         "catchup_timeout_s": 30.0,    # abort CATCHUP after this long
     },
+    # continuous shard replication / HA serving
+    # (coordinator/replication.py)
+    "replication": {
+        "n_replicas": 0,              # warm followers per shard (0 = off)
+        "in_sync_lag": 0,             # max WAL-offset lag to count IN_SYNC
+        "hedge_s": 0.05,              # hedged-read timer for replica reads
+        "durable_sync_s": 5.0,        # follower sealed-segment sync cadence
+    },
     # standing queries (filodb_tpu/rules): recording + alerting rule
     # groups evaluated incrementally on ingest progress. Each group:
     #   {"name": ..., "interval": "60s", "dataset": <defaults to first>,
@@ -225,6 +233,7 @@ class ServerConfig:
     governor: dict = field(default_factory=dict)  # GovernorConfig overrides
     store: dict = field(default_factory=dict)  # durable-store backend block
     migration: dict = field(default_factory=dict)  # live-migration knobs
+    replication: dict = field(default_factory=dict)  # shard-replica knobs
     rules: dict = field(default_factory=dict)  # standing-query rule groups
     tracing: dict = field(default_factory=dict)  # TracingConfig overrides
     selfmon: dict = field(default_factory=dict)  # _meta self-monitoring
@@ -275,6 +284,7 @@ class ServerConfig:
             governor=cfg.get("governor", {}),
             store=cfg.get("store", {}),
             migration=cfg.get("migration", {}),
+            replication=cfg.get("replication", {}),
             rules=cfg.get("rules", {}),
             tracing=cfg.get("tracing", {}),
             selfmon=cfg.get("selfmon", {}),

@@ -242,10 +242,15 @@ class ShardUpdateSubscriber:
         if resynced:
             self.mapper = ShardMapper(self.mapper.num_shards)
             self.resyncs += 1
-        for shard, status_name, node, progress in events:
+        for shard, status_name, node, progress, *rest in events:
+            # rest = (replica, watermark) since replica sets; *rest keeps
+            # this reader compatible with further wire growth
+            replica = bool(rest[0]) if len(rest) > 0 else False
+            watermark = int(rest[1]) if len(rest) > 1 else -1
             self.mapper.apply(ShardEvent(int(shard),
                                          ShardStatus[status_name], node,
-                                         int(progress)))
+                                         int(progress), replica=replica,
+                                         watermark=watermark))
         self.last_seq = seq
         self.epoch = epoch
         return len(events)

@@ -30,6 +30,11 @@ from dataclasses import dataclass, field
 
 from filodb_tpu.coordinator.migration import MigrationError, ShardMigration
 from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.replication import (
+    ReplicaCandidate,
+    ReplicaDispatcher,
+    ReplicaSyncer,
+)
 from filodb_tpu.coordinator.query_service import QueryService
 from filodb_tpu.coordinator.shard_manager import ShardManager
 from filodb_tpu.coordinator.shardmapper import ShardStatus
@@ -203,6 +208,28 @@ class Node:
         if w:
             w.stop()
         self.memstore.teardown(dataset, shard)
+
+    def promote_shard(self, dataset: str, shard: int,
+                      config: IngestionConfig, shard_log: ReplayLog,
+                      start_offset: int, on_status=None) -> None:
+        """Failover fast path (follower → leader): the replica image is
+        already warm — index recovered at follow time, WAL applied through
+        ``start_offset`` — so ingestion resumes right there and the shard
+        joins the flush schedule. Deliberately NO manifest refresh, index
+        recovery, or watermark pass: the flip replays only the un-tailed
+        WAL tail and performs zero durable-tier reads (the chaos soak's
+        GET-accounting criterion)."""
+        key = (dataset, shard)
+        if key in self._workers:
+            return
+        s = self.memstore.get_shard(dataset, shard)
+        worker = _IngestWorker(self, s, shard_log, start_offset, on_status)
+        self._workers[key] = worker
+        worker.start()
+        self._register_lag_gauges(dataset, shard, s, shard_log, worker)
+        if self._flusher is None:
+            self._flusher = _FlushScheduler(self, self.flush_tick_s)
+            self._flusher.start()
 
     # -- live migration (coordinator/migration.py source/destination API) --
 
@@ -477,6 +504,14 @@ class FilodbCluster:
     auto_rebalance: bool = False
     migration_lag_threshold: int = 0
     migration_catchup_timeout_s: float = 30.0
+    # continuous replication ("replication" config block): maintain this
+    # many follower replicas per shard on other in-process members; 0 off
+    replication: int = 0
+    replica_in_sync_lag: int = 0    # max offset lag still counted in-sync
+    replica_hedge_s: float = 0.05   # hedge timer for replica reads
+    replica_durable_sync_s: float = 5.0  # follower sealed-segment sync cadence
+    # live follower syncers, keyed (dataset, shard, node)
+    replica_syncers: dict = field(default_factory=dict)
     _hb_misses: dict = field(default_factory=dict)
     _hb_thread: threading.Thread | None = None
     _stop_hb: threading.Event = field(default_factory=threading.Event)
@@ -530,19 +565,93 @@ class FilodbCluster:
                 self._on_event(dataset, ev)
 
     def _on_event(self, dataset: str, ev) -> None:
+        if getattr(ev, "replica", False):
+            # a follower dropping out of a replica set stops its syncer;
+            # upserts are the syncer's own reports — nothing to drive
+            if ev.node and ev.status in (ShardStatus.STOPPED,
+                                         ShardStatus.DOWN,
+                                         ShardStatus.UNASSIGNED):
+                sy = self.replica_syncers.pop((dataset, ev.shard, ev.node),
+                                              None)
+                if sy is not None:
+                    sy.stop()
+            return
+        if ev.status == ShardStatus.ACTIVE and ev.node and \
+                (dataset, ev.shard, ev.node) in self.replica_syncers:
+            # promotion map flip: the ACTIVE event names a node we hold a
+            # follower syncer for — hand its warm image to the ingest path
+            sy = self.replica_syncers.pop((dataset, ev.shard, ev.node))
+            self.nodes[ev.node].promote_shard(
+                dataset, ev.shard, self.configs[dataset],
+                self.logs[(dataset, ev.shard)], sy.promote(),
+                self._status_cb(dataset, ev.node))
+            return
         if ev.status == ShardStatus.ASSIGNED and ev.node:
             node = self.nodes[ev.node]
-            config = self.configs[dataset]
-            sm = self.shard_managers[dataset]
+            node.start_shard(dataset, ev.shard, self.configs[dataset],
+                             self.logs[(dataset, ev.shard)],
+                             self._status_cb(dataset, ev.node))
 
-            def on_status(shard, status, progress, _node=ev.node):
-                if status == ShardStatus.ACTIVE:
-                    sm.shard_active(shard, _node)
-                elif status == ShardStatus.RECOVERY:
-                    sm.shard_recovery(shard, _node, progress)
+    def _status_cb(self, dataset: str, node: str):
+        sm = self.shard_managers[dataset]
 
-            node.start_shard(dataset, ev.shard, config,
-                             self.logs[(dataset, ev.shard)], on_status)
+        def on_status(shard, status, progress, _node=node):
+            if status == ShardStatus.ACTIVE:
+                sm.shard_active(shard, _node)
+            elif status == ShardStatus.RECOVERY:
+                sm.shard_recovery(shard, _node, progress)
+
+        return on_status
+
+    # -- continuous replication --
+
+    def ensure_replicas(self, dataset: str) -> None:
+        """Converge each shard's follower set toward ``replication``
+        replicas: prune syncers whose node died or took leadership, then
+        start new followers on the least-loaded live in-process members.
+        Idempotent; runs every heartbeat tick, so replica placement heals
+        after joins, leaves, and promotions without a dedicated planner."""
+        if not self.replication:
+            return
+        sm = self.shard_managers.get(dataset)
+        if sm is None:
+            return
+        for shard in range(sm.num_shards):
+            owner = sm.mapper.node_for(shard)
+            for name in list(sm.mapper.replicas_of(shard)):
+                node = self.nodes.get(name)
+                sy = self.replica_syncers.get((dataset, shard, name))
+                dead_tail = (sy is not None and sy._tail is not None
+                             and not sy._tail.is_alive())
+                if node is None or not node.alive or name == owner \
+                        or dead_tail:
+                    sy = self.replica_syncers.pop((dataset, shard, name),
+                                                  None)
+                    if sy is not None:
+                        sy.stop()
+                    sm.drop_replica(shard, name)
+            if owner is None:
+                continue  # followers of a DOWN shard keep tailing as-is
+            # count syncers still bootstrapping (not yet in the mapper)
+            # so a slow bootstrap is not doubled up on the next tick
+            have = set(sm.mapper.replicas_of(shard))
+            have |= {n for (d, s, n) in self.replica_syncers
+                     if d == dataset and s == shard}
+            need = self.replication - len(have)
+            if need <= 0:
+                continue
+            cands = [n for n, nd in self.nodes.items()
+                     if nd.alive and getattr(nd, "memstore", None)
+                     is not None and n != owner and n not in have]
+            cands.sort(key=lambda n: len(sm.mapper.follower_shards(n)))
+            for name in cands[:need]:
+                sy = ReplicaSyncer(
+                    self.nodes[name], dataset, shard,
+                    self.configs[dataset], self.logs[(dataset, shard)],
+                    sm, in_sync_lag=self.replica_in_sync_lag,
+                    durable_sync_interval_s=self.replica_durable_sync_s)
+                self.replica_syncers[(dataset, shard, name)] = sy
+                sy.start()
 
     # -- live migration / rebalancing --
 
@@ -648,6 +757,12 @@ class FilodbCluster:
                         get_counter("filodb_heartbeat_errors").inc()
                         log.exception("deferred reassignment of %s/%d "
                                       "failed", dataset, ev.shard)
+                try:
+                    self.ensure_replicas(dataset)
+                except Exception:
+                    get_counter("filodb_heartbeat_errors").inc()
+                    log.exception("replica convergence for %s failed",
+                                  dataset)
             for cb in self.on_heartbeat:
                 try:
                     cb()
@@ -660,6 +775,9 @@ class FilodbCluster:
         self._stop_hb.set()
         if self._hb_thread:
             self._hb_thread.join(timeout=2)
+        for sy in list(self.replica_syncers.values()):
+            sy.stop()
+        self.replica_syncers.clear()
         for node in list(self.nodes.values()):
             node.kill()
 
@@ -681,20 +799,45 @@ class FilodbCluster:
         sm = self.shard_managers[dataset]
         cluster = self
 
-        def dispatcher_for_shard(shard: int) -> PlanDispatcher:
-            owner = sm.mapper.node_for(shard)
-            if owner is None:
-                raise RuntimeError(f"shard {shard} unassigned")
-            node = cluster.nodes[owner]
+        def candidate_for(name: str, follower: bool = False
+                          ) -> ReplicaCandidate:
+            node = cluster.nodes[name]
             if getattr(node, "memstore", None) is not None:
-                return NodeDispatcher(node)  # in-process member
+                # in-process member: breaker-guard under the node name
+                return ReplicaCandidate(name, NodeDispatcher(node),
+                                        follower=follower, guard=True)
             from filodb_tpu.coordinator.remote import RemotePlanDispatcher
             host = getattr(node, "host", "127.0.0.1")
             # the dispatcher's breaker guard skips open peers at dispatch
             # time (CircuitOpenError → scatter-gather partial result); the
             # failure detector force-opens breakers of departed members so
             # the skip never pays a connect timeout
-            return RemotePlanDispatcher(host, node.executor_port)
+            d = RemotePlanDispatcher(host, node.executor_port)
+            return ReplicaCandidate(d.peer, d, follower=follower,
+                                    guard=False)
+
+        def dispatcher_for_shard(shard: int) -> PlanDispatcher:
+            # read the follower set BEFORE the owner slot: a concurrent
+            # promotion writes the new owner first, then pops it from the
+            # replica set — this order never observes "stale dead owner +
+            # empty follower set", which would route a read solely at the
+            # dead leader mid-flip
+            followers = [n for n in sm.mapper.in_sync_followers(shard)
+                         if n in cluster.nodes]
+            owner = sm.mapper.node_for(shard)
+            followers = [n for n in followers if n != owner]
+            if not followers:
+                if owner is None or owner not in cluster.nodes:
+                    raise RuntimeError(f"shard {shard} unassigned")
+                return candidate_for(owner).dispatcher
+            # replica set: leader first (writes & freshest reads), then
+            # in-sync followers; EWMA ordering + hedging inside
+            cands = []
+            if owner is not None and owner in cluster.nodes:
+                cands.append(candidate_for(owner))
+            cands += [candidate_for(n, follower=True) for n in followers]
+            return ReplicaDispatcher(
+                shard, cands, hedge_timeout_s=cluster.replica_hedge_s)
 
         # the facade's local memstore is only used for metadata fan-out;
         # use the first node's
@@ -717,11 +860,31 @@ class FilodbCluster:
                 odp_max_chunks=int(federation.get("odp_max_chunks",
                                                   10_000)),
                 refresh_s=float(federation.get("refresh_s", 60.0)))
-        svc.shard_status_fn = lambda: [
-            (s, sm.mapper.statuses[s].name.lower())
-            for s in range(sm.num_shards)
-            if sm.mapper.statuses[s] in (ShardStatus.RECOVERY,
-                                         ShardStatus.HANDOFF)]
+        def shard_status_fn():
+            out = []
+            for s in range(sm.num_shards):
+                st = sm.mapper.statuses[s]
+                if st in (ShardStatus.RECOVERY, ShardStatus.HANDOFF):
+                    out.append((s, st.name.lower()))
+                    continue
+                if st != ShardStatus.ACTIVE:
+                    continue
+                owner = sm.mapper.node_for(s)
+                node = cluster.nodes.get(owner) if owner else None
+                unhealthy = node is None or not getattr(node, "alive", True)
+                if not unhealthy and getattr(node, "executor_port", None) \
+                        and getattr(node, "memstore", None) is None:
+                    host = getattr(node, "host", "127.0.0.1")
+                    unhealthy = breaker_for(
+                        f"{host}:{node.executor_port}").is_open
+                followers = sm.mapper.in_sync_followers(s)
+                if unhealthy and followers:
+                    # the replica dispatcher will serve this shard from a
+                    # follower — surface that as a result warning
+                    out.append((s, f"served by follower {followers[0]}"))
+            return out
+
+        svc.shard_status_fn = shard_status_fn
         return svc
 
     def shard_statuses(self, dataset: str) -> list[dict]:

@@ -481,15 +481,24 @@ class QueryService:
         return self._attach_recovery_warnings(result)
 
     def _recovery_warnings(self) -> list[str]:
-        """One warning per queryable-but-catching-up shard (recovery replay
-        or live-migration handoff) — satellite rule: queries during
-        migration are correct or *flagged*, never silently stale."""
+        """One warning per queryable-but-catching-up shard (recovery replay,
+        live-migration handoff, or a read served from a follower replica
+        while the leader is unreachable) — satellite rule: queries during
+        migration/failover are correct or *flagged*, never silently
+        stale."""
         fn = self.shard_status_fn
         if fn is None:
             return []
         try:
-            return [f"shard {shard} recovering ({status}): results may "
-                    f"lag live ingest" for shard, status in fn()]
+            out = []
+            for shard, status in fn():
+                if status.startswith("served by"):
+                    out.append(f"shard {shard} {status}: results may "
+                               f"lag live ingest")
+                else:
+                    out.append(f"shard {shard} recovering ({status}): "
+                               f"results may lag live ingest")
+            return out
         except Exception:
             return []
 

@@ -34,6 +34,7 @@ from filodb_tpu.utils.resilience import (
     FaultInjector,
     breaker_for,
     default_retry_policy,
+    record_peer_latency,
 )
 from filodb_tpu.utils.tracing import graft_spans, span, start_trace
 
@@ -457,10 +458,14 @@ class RemotePlanDispatcher(PlanDispatcher):
         # a DeadlineExceeded (raised before even dialing) or an open
         # breaker must not count against a healthy peer — and guarantees
         # a half-open probe reports exactly one outcome
+        t0 = time.perf_counter()
         with span("dispatch", peer=self.peer) as dspan, \
                 breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
             resp = default_retry_policy().call(
                 attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
+        # feed the replica read router's EWMA ordering (successes only —
+        # a failed dispatch says "down", which the breaker already records)
+        record_peer_latency(self.peer, time.perf_counter() - t0)
         if resp[0] == "ok":
             result = resp[1]
             stats = getattr(result, "stats", None)

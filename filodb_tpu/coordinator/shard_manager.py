@@ -112,7 +112,9 @@ class ShardManager:
         return events
 
     def remove_member(self, node: str) -> list[ShardEvent]:
-        """Node lost: mark its shards down, then reassign (rate-limited)
+        """Node lost: promote an in-sync follower where one exists (the map
+        flip — ONE sequenced ACTIVE event, no DOWN window), otherwise mark
+        the shard down and reassign (rate-limited) for cold recovery
         (reference ``removeMember`` → ``MemberRemoved`` handling). A shard
         inside its rate-limit interval is NOT dropped on the floor: it is
         recorded in ``_deferred`` and reassigned by :meth:`check_deferred`
@@ -122,11 +124,21 @@ class ShardManager:
         self._nodes.remove(node)
         events = []
         now = time.monotonic()
+        # follower roles held by the dead node die with it
+        for shard in self.mapper.follower_shards(node):
+            events.append(self._publish(ShardEvent(
+                shard, ShardStatus.STOPPED, node, replica=True)))
+        down = []
         for shard in self.mapper.shards_of(node):
+            best = self._promotion_candidate(shard)
+            if best is not None:
+                events.append(self.promote(shard, best))
+                continue
+            down.append(shard)
             events.append(self._publish(ShardEvent(shard, ShardStatus.DOWN,
                                                    None)))
         if len(self._nodes) >= self.min_num_nodes:
-            for shard in [e.shard for e in events]:
+            for shard in down:
                 last = self._last_reassign.get(shard, 0.0)
                 if now - last < self.reassignment_min_interval_s:
                     log.warning("shard %d reassignment rate-limited; "
@@ -140,7 +152,12 @@ class ShardManager:
     def check_deferred(self) -> list[ShardEvent]:
         """Reassign rate-limited shards whose interval has elapsed. Called
         from every membership change and heartbeat tick, so a deferred
-        shard no longer waits for an unrelated membership event."""
+        shard no longer waits for an unrelated membership event. A deferred
+        shard that meanwhile gained an owner (a follower promotion handled
+        it) is dropped rather than reassigned — retrying it would
+        double-assign the shard over its promoted leader; one whose replica
+        set caught up since the failure is promoted instead of
+        cold-recovered."""
         if not self._deferred:
             return []
         now = time.monotonic()
@@ -149,10 +166,17 @@ class ShardManager:
                  >= self.reassignment_min_interval_s]
         if not ready or len(self._nodes) < self.min_num_nodes:
             return []
+        events = []
         for s in ready:
             self._deferred.discard(s)
+            if self.mapper.node_for(s) is not None:
+                continue  # already owned (promotion won the race)
+            best = self._promotion_candidate(s)
+            if best is not None:
+                events.append(self.promote(s, best))
+                continue
             self._last_reassign[s] = now
-        return self._assign()
+        return events + self._assign()
 
     @property
     def nodes(self) -> list[str]:
@@ -221,6 +245,51 @@ class ShardManager:
         """Roll the shard back to ACTIVE on the source (migration abort)."""
         return self._publish(ShardEvent(shard, ShardStatus.ACTIVE, source))
 
+    # -- replica sets (coordinator/replication.py drives these) --
+
+    def replica_update(self, shard: int, node: str, status: ShardStatus,
+                       watermark: int = -1) -> ShardEvent | None:
+        """Upsert one follower's replica state. Status CHANGES publish a
+        sequenced event (remote mirrors track the lifecycle); watermark-only
+        progress mutates in place under the event lock — a follower tails
+        continuously, and sequencing every applied offset would churn the
+        retained event window out from under slow subscribers."""
+        cur = self.mapper.replicas[shard].get(node)
+        if cur is not None and cur.status == status:
+            with self._ev_lock:
+                cur.watermark = watermark
+            return None
+        return self._publish(ShardEvent(shard, status, node, replica=True,
+                                        watermark=watermark))
+
+    def drop_replica(self, shard: int, node: str) -> ShardEvent | None:
+        """Remove a follower from the shard's replica set (tail stopped)."""
+        if node not in self.mapper.replicas[shard]:
+            return None
+        return self._publish(ShardEvent(shard, ShardStatus.STOPPED, node,
+                                        replica=True))
+
+    def promote(self, shard: int, node: str) -> ShardEvent:
+        """Failover map flip: ONE sequenced ACTIVE event moves leadership to
+        an in-sync follower (which drops out of the replica set), so mapper
+        observers see either the old or the new leader — never a DOWN gap."""
+        from filodb_tpu.utils.metrics import get_counter
+        get_counter("filodb_replica_promotions",
+                    {"dataset": self.dataset}).inc()
+        log.warning("promoting in-sync follower %s to leader of %s/%d",
+                    node, self.dataset, shard)
+        return self._publish(ShardEvent(shard, ShardStatus.ACTIVE, node))
+
+    def _promotion_candidate(self, shard: int) -> str | None:
+        """Best in-sync follower still in the membership: highest applied
+        watermark wins (shortest WAL tail left to replay)."""
+        live = [n for n in self.mapper.in_sync_followers(shard)
+                if n in self._nodes]
+        if not live:
+            return None
+        return max(live,
+                   key=lambda n: self.mapper.replicas[shard][n].watermark)
+
     # -- assignment --
 
     def _assign(self) -> list[ShardEvent]:
@@ -280,15 +349,25 @@ class ShardManager:
             ahead = since_seq > self._seq
             stale_epoch = epoch is not None and epoch != self.epoch
             if behind or ahead or stale_epoch:
-                snapshot = [ShardEvent(s, self.mapper.statuses[s],
-                                       self.mapper.owners[s])
-                            for s in range(self.num_shards)]
+                snapshot = self._state_events()
                 return snapshot, self._seq, True, self.epoch
             events = [ev for seq, ev in self._event_log if seq > since_seq]
             return events, self._seq, False, self.epoch
 
+    def _state_events(self) -> list[ShardEvent]:
+        """Full-state snapshot as a replayable event list: leader mappings
+        first, then replica-set entries (so a resyncing mirror rebuilds
+        both tables)."""
+        out = [ShardEvent(s, self.mapper.statuses[s], self.mapper.owners[s])
+               for s in range(self.num_shards)]
+        for s in range(self.num_shards):
+            for node, st in sorted(self.mapper.replicas[s].items()):
+                out.append(ShardEvent(s, st.status, node, replica=True,
+                                      watermark=st.watermark))
+        return out
+
     def subscribe(self, fn) -> None:
         self.subscribers.append(fn)
         # resync: replay current state (reference SubscribeShardUpdates)
-        for s in range(self.num_shards):
-            fn(ShardEvent(s, self.mapper.statuses[s], self.mapper.owners[s]))
+        for ev in self._state_events():
+            fn(ev)

@@ -27,11 +27,23 @@ class ShardStatus(enum.Enum):
     ERROR = "error"
     STOPPED = "stopped"
     DOWN = "down"
+    # follower replica lifecycle (coordinator/replication.py): a follower
+    # tails the shard's durable segments + WAL into a warm read-only image.
+    # None of these make the LEADER mapping queryable — replica status lives
+    # in a side table keyed (shard, node), never in the owner slot.
+    FOLLOWING = "following"
+    IN_SYNC = "in_sync"
+    LAGGING = "lagging"
 
     @property
     def queryable(self) -> bool:
         return self in (ShardStatus.ACTIVE, ShardStatus.RECOVERY,
                         ShardStatus.HANDOFF)
+
+    @property
+    def is_replica(self) -> bool:
+        return self in (ShardStatus.FOLLOWING, ShardStatus.IN_SYNC,
+                        ShardStatus.LAGGING)
 
 
 @dataclass
@@ -42,6 +54,21 @@ class ShardEvent:
     status: ShardStatus
     node: str | None = None
     progress: int = 0  # recovery progress percent
+    # replica events target the shard's FOLLOWER set, not the leader slot:
+    # status FOLLOWING/IN_SYNC/LAGGING upserts the (shard, node) replica
+    # entry, UNASSIGNED/DOWN/STOPPED removes it
+    replica: bool = False
+    watermark: int = -1  # follower's applied log offset
+
+
+@dataclass
+class ReplicaState:
+    """One follower's view of a shard: lifecycle status + the log offset it
+    has applied (the in-sync watermark compared against the leader's
+    covered offset)."""
+
+    status: ShardStatus
+    watermark: int = -1
 
 
 @dataclass
@@ -49,6 +76,9 @@ class ShardMapper:
     num_shards: int
     statuses: list[ShardStatus] = field(default_factory=list)
     owners: list[str | None] = field(default_factory=list)
+    # per-shard follower replica sets: node -> ReplicaState. Maintained
+    # beside the leader slot so replica churn never perturbs routing.
+    replicas: list[dict[str, ReplicaState]] = field(default_factory=list)
 
     def __post_init__(self):
         assert self.num_shards & (self.num_shards - 1) == 0, \
@@ -56,15 +86,31 @@ class ShardMapper:
         if not self.statuses:
             self.statuses = [ShardStatus.UNASSIGNED] * self.num_shards
             self.owners = [None] * self.num_shards
+        if not self.replicas:
+            self.replicas = [{} for _ in range(self.num_shards)]
         # routing table read by every query/ingest thread, written by
         # membership and migration events
         racecheck.register(self, "ShardMapper")
 
     def apply(self, ev: ShardEvent) -> None:
+        if ev.replica:
+            # follower-set mutation only: the leader mapping is untouched
+            if ev.status in (ShardStatus.UNASSIGNED, ShardStatus.DOWN,
+                             ShardStatus.STOPPED):
+                if ev.node is not None:
+                    self.replicas[ev.shard].pop(ev.node, None)
+            elif ev.node is not None:
+                self.replicas[ev.shard][ev.node] = ReplicaState(
+                    ev.status, ev.watermark)
+            return
         self.statuses[ev.shard] = ev.status
         if ev.node is not None or ev.status in (ShardStatus.UNASSIGNED,
                                                 ShardStatus.DOWN):
             self.owners[ev.shard] = ev.node
+        if ev.node is not None:
+            # a node taking leadership (promotion / handoff flip) leaves
+            # the follower set — it is no longer a replica of itself
+            self.replicas[ev.shard].pop(ev.node, None)
 
     def node_for(self, shard: int) -> str | None:
         return self.owners[shard]
@@ -88,6 +134,31 @@ class ShardMapper:
     def all_queryable(self, shards: list[int]) -> bool:
         return all(self.statuses[s].queryable for s in shards)
 
+    # -- replica sets --
+
+    def replicas_of(self, shard: int) -> dict[str, ReplicaState]:
+        return dict(self.replicas[shard])
+
+    def in_sync_followers(self, shard: int) -> list[str]:
+        """Followers whose tail has caught up within the in-sync lag bound —
+        the promotion candidates and read-serving alternates."""
+        return [n for n, st in self.replicas[shard].items()
+                if st.status == ShardStatus.IN_SYNC]
+
+    def follower_shards(self, node: str) -> list[int]:
+        """Shards for which ``node`` holds a follower replica."""
+        return [s for s in range(self.num_shards)
+                if node in self.replicas[s]]
+
     def snapshot(self) -> list[dict]:
-        return [{"shard": s, "status": self.statuses[s].value,
-                 "node": self.owners[s]} for s in range(self.num_shards)]
+        out = []
+        for s in range(self.num_shards):
+            entry = {"shard": s, "status": self.statuses[s].value,
+                     "node": self.owners[s]}
+            if self.replicas[s]:
+                entry["replicas"] = [
+                    {"node": n, "status": st.status.value,
+                     "watermark": st.watermark}
+                    for n, st in sorted(self.replicas[s].items())]
+            out.append(entry)
+        return out

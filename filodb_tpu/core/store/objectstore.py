@@ -596,6 +596,62 @@ class ObjectStoreColumnStore(ColumnStore):
             if st is not None and not st.pending and not st.open:
                 del self._states[(dataset, shard)]
 
+    def sync_shard(self, dataset: str, shard: int) -> int:
+        """Follower tail over the durable tier: re-read the remote
+        manifest and apply only UNSEEN sealed segments to the cached
+        state — GETs are per new segment, never a full reload. A replica
+        syncer (coordinator/replication.py) calls this periodically so a
+        read-only follower's view — including ``next_seq``, so a
+        post-promotion flush can never collide with leader-written
+        segment keys — tracks the leader's uploads. Only safe on a
+        read-only view: a shard with open or pending local segments is
+        the WRITER and is skipped (returns 0). Returns the number of new
+        segments applied."""
+        with self._lock:
+            st = self._states.get((dataset, shard))
+            if st is not None and (st.pending or st.open):
+                return 0
+        if st is None:
+            # first touch: the cold load IS the sync
+            self._state(dataset, shard)
+            return 0
+        base = self._shard_prefix(dataset, shard)
+        try:
+            doc = json.loads(self._get(base + "manifest.json"))
+        except KeyError:
+            return 0
+        with self._lock:
+            if st.pending or st.open:
+                return 0  # became a writer since the first check
+            known = set(st.segments)
+            st.next_seq = max(st.next_seq, int(doc.get("next_seq", 1)))
+            st.upd = max(st.upd, int(doc.get("upd", 0)))
+        applied = 0
+        for s in sorted(doc.get("segments", ()),
+                        key=lambda s: int(s["seq"])):
+            if int(s["seq"]) in known:
+                continue
+            info = _SegmentInfo(
+                int(s["seq"]), int(s["bucket"]), s["key"], int(s["size"]),
+                int(s["crc"]), int(s["entries"]), int(s["max_upd"]), True)
+            if not self._bucket_in_split(info.bucket):
+                continue
+            data = self._get(info.key)
+            if crc32c(data[:-_FOOTER.size]) != info.crc:
+                CORRUPT.inc()
+                raise CorruptSegmentError(
+                    f"{info.key}: manifest CRC mismatch")
+            entries = parse_segment(data, info.key)
+            # the GET ran outside the lock (same reasoning as _load_state:
+            # a retried network read must not stall every other shard);
+            # two racing syncs may both apply a segment — _apply_entries
+            # upserts by key, so the second apply is a no-op
+            with self._lock:
+                self._apply_entries(st, info.seq, entries)
+                st.segments[info.seq] = info
+            applied += 1
+        return applied
+
     def _state(self, dataset: str, shard: int) -> _ShardState:
         with self._lock:
             st = self._states.get((dataset, shard))

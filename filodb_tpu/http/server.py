@@ -635,6 +635,21 @@ class HttpDispatcher:
                 mig = cluster.migrations.get((dataset, entry["shard"]))
                 if mig is not None:
                     entry["migration"] = mig.snapshot()
+                # leader covered offset + live follower watermarks: the
+                # in-sync picture replicacheck/shardmap render
+                owner = entry.get("node")
+                node = cluster.nodes.get(owner) if owner else None
+                if node is not None:
+                    try:
+                        entry["watermark"] = node.shard_offset(
+                            dataset, entry["shard"])
+                    except Exception:
+                        pass
+                for rep in entry.get("replicas", ()):
+                    sy = cluster.replica_syncers.get(
+                        (dataset, entry["shard"], rep["node"]))
+                    if sy is not None:
+                        rep["watermark"] = sy.applied
         elif dataset in self.app.shard_maps:
             shards = self.app.shard_maps[dataset]().snapshot()
         else:

@@ -222,7 +222,11 @@ class FiloServer:
         if sm is None:
             return ([], since_seq, False, epoch)
         events, seq, resynced, ep = sm.events_since(since_seq, epoch)
-        return ([(e.shard, e.status.name, e.node, e.progress)
+        # 6-tuples since replica sets: old 4-field readers were removed in
+        # the same change (both ends of this wire ship together), and the
+        # subscriber unpacks with *rest so further growth stays compatible
+        return ([(e.shard, e.status.name, e.node, e.progress,
+                  e.replica, e.watermark)
                  for e in events], seq, resynced, ep)
 
     def _handle_role(self):
@@ -388,6 +392,14 @@ class FiloServer:
                 mig_cfg.get("lag_threshold", 0))
             self.cluster.migration_catchup_timeout_s = float(
                 mig_cfg.get("catchup_timeout_s", 30.0))
+            rep_cfg = cfg.replication or {}
+            self.cluster.replication = int(rep_cfg.get("n_replicas", 0))
+            self.cluster.replica_in_sync_lag = int(
+                rep_cfg.get("in_sync_lag", 0))
+            self.cluster.replica_hedge_s = float(
+                rep_cfg.get("hedge_s", 0.05))
+            self.cluster.replica_durable_sync_s = float(
+                rep_cfg.get("durable_sync_s", 5.0))
             self.cluster.join(self.node)
             from filodb_tpu.coordinator.bootstrap import poll_remote_statuses
             for name, ing_cfg in cfg.datasets.items():

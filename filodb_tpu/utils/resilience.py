@@ -329,6 +329,37 @@ def reset_breakers() -> None:
 
 
 # ---------------------------------------------------------------------------
+# peer latency (EWMA) — replica read routing
+
+_peer_latency: dict[str, float] = {}
+_peer_latency_lock = threading.Lock()
+PEER_LATENCY_ALPHA = 0.3  # weight of the newest sample
+
+
+def record_peer_latency(key: str, seconds: float) -> None:
+    """Fold one observed dispatch round-trip into the peer's EWMA. Keys
+    match the breaker registry ("host:port" for remote peers, the node
+    name for in-process members); the replica read path orders candidates
+    by this value (coordinator/replication.py)."""
+    with _peer_latency_lock:
+        prev = _peer_latency.get(key)
+        _peer_latency[key] = seconds if prev is None else \
+            prev + PEER_LATENCY_ALPHA * (seconds - prev)
+
+
+def peer_latency(key: str) -> float | None:
+    """Current EWMA dispatch latency for a peer; None before any sample."""
+    with _peer_latency_lock:
+        return _peer_latency.get(key)
+
+
+def reset_peer_latency() -> None:
+    """Drop all latency estimates (tests)."""
+    with _peer_latency_lock:
+        _peer_latency.clear()
+
+
+# ---------------------------------------------------------------------------
 # process-wide resilience config (defaults; overridable via config.py)
 
 
@@ -411,6 +442,10 @@ class FaultInjector:
     - ``node.dispatch``     (ctx: node)        — in-cluster node dispatch
     - ``shard.ingest``      (ctx: dataset, shard, offset) — per-container
       shard ingest (stall/error injection for freshness-alert tests)
+    - ``replica.tail``      (ctx: node, dataset, shard) — follower tail
+      loop top (``coordinator/replication.py``)
+    - ``replica.dispatch``  (ctx: node, shard) — per-candidate replica
+      read dispatch (hedging/failover tests)
     - ``objectstore.put``   (ctx: key)         — object-store segment upload
     - ``migration.*``       (ctx: dataset, shard, source, dest, phase) —
       live-migration kill-points, one per state transition

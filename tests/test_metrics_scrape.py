@@ -253,6 +253,21 @@ FEDERATION_NAMES = [
 ]
 
 
+# continuous shard replication + hedged replica reads
+# (coordinator/replication.py) — counters and untagged gauge anchors
+# registered at import (standalone imports cluster → replication at boot),
+# so the families render before any replica exists
+REPLICATION_NAMES = [
+    "filodb_replica_promotions_total",
+    "filodb_replica_divergence_total",
+    "filodb_replica_follower_reads_total",
+    "filodb_replica_lag",
+    "filodb_replica_watermark",
+    "filodb_hedged_reads_total",
+    "filodb_hedged_reads_won_total",
+]
+
+
 # mesh query engine (parallel/mesh_engine.py, parallel/adaptive.py) —
 # plan recognition, split-vs-fused dispatch, device cache behavior,
 # exec-path fallbacks, and adaptive lane routing; all registered at
@@ -406,6 +421,12 @@ class TestMetricsScrape:
         # the first mesh-eligible query
         missing_mesh = [n for n in MESH_NAMES if n not in names_present]
         assert not missing_mesh, f"missing mesh metrics: {missing_mesh}"
+
+        # shard-replication + hedged-read families render at zero before
+        # any replica set is configured
+        missing_rep = [n for n in REPLICATION_NAMES
+                       if n not in names_present]
+        assert not missing_rep, f"missing replication metrics: {missing_rep}"
 
         def total(name):
             return sum(float(line.rsplit(" ", 1)[1])

@@ -1,0 +1,629 @@
+"""Continuous shard replication + HA serving chaos tests.
+
+Mirrors the reference's multi-jvm recovery specs for the replication
+subsystem (``coordinator/replication.py``):
+
+- followers bootstrap warm read-only images and reach IN_SYNC, publishing
+  watermarks through the sequenced shard-event feed;
+- failover is a map flip: an in-sync follower is promoted with ONE
+  sequenced ACTIVE event and ZERO object-store GETs (the sealed segments
+  it already tailed are never re-read);
+- kill-a-node soak: continuous queries across kill → detection →
+  promotion → rejoin-as-follower see zero failures and zero wrong
+  results vs an unkilled control cluster, with zero replica divergence
+  at teardown (lockcheck + racecheck armed throughout);
+- a deferred (rate-limited) reassignment skips shards whose replica set
+  already produced a leader, and promotes a caught-up follower instead
+  of cold-assigning (the double-assign regression);
+- hedged replica reads: EWMA ordering, hedge-timer launches, failover on
+  failure, open breakers to the back;
+- ``filo-cli replicacheck`` exits 1 on watermark divergence.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.bootstrap import ShardUpdateSubscriber
+from filodb_tpu.coordinator.cluster import FilodbCluster, Node
+from filodb_tpu.coordinator.ingestion import route_container
+from filodb_tpu.coordinator.replication import (
+    FOLLOWER_READS,
+    HEDGED,
+    HEDGED_WON,
+    ReplicaCandidate,
+    ReplicaDispatcher,
+    assert_no_divergence,
+    check_replicas,
+)
+from filodb_tpu.coordinator.shard_manager import ShardManager
+from filodb_tpu.coordinator.shardmapper import ShardStatus
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store.api import InMemoryColumnStore, InMemoryMetaStore
+from filodb_tpu.core.store.config import IngestionConfig, StoreConfig
+from filodb_tpu.core.store.objectstore import GETS, open_object_store
+from filodb_tpu.kafka.log import InMemoryLog
+from filodb_tpu.query.exec.plan import PlanDispatcher
+from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+from filodb_tpu.utils import lockcheck, racecheck
+from filodb_tpu.utils.metrics import get_counter
+from filodb_tpu.utils.resilience import (
+    FaultInjector,
+    breaker_for,
+    record_peer_latency,
+    reset_breakers,
+    reset_peer_latency,
+)
+
+START = 1_600_000_000
+NUM_SHARDS = 4
+QUERY = 'sum(heap_usage{_ns_="App-3"})'
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    FaultInjector.reset()
+    reset_breakers()
+    reset_peer_latency()
+    yield
+    FaultInjector.reset()
+    reset_breakers()
+    reset_peer_latency()
+
+
+def _publish(logs, stream, num_shards, spread=1):
+    for sd in stream:
+        for shard, cont in route_container(sd.container, num_shards,
+                                           spread).items():
+            logs[shard].append(cont)
+
+
+@pytest.fixture
+def replica_env(tmp_path):
+    # lock-order checker + shared-state race sanitizer armed for the whole
+    # cluster lifetime (same discipline as the migration chaos matrix):
+    # any order cycle, blocking-under-lock, or unguarded write the
+    # replication machinery introduces fails the test at teardown
+    with lockcheck.session():
+        with racecheck.session():
+            stores = []
+            logs = {s: InMemoryLog() for s in range(NUM_SHARDS)}
+            keys = machine_metrics_series(12, ns="App-3")
+            _publish(logs, gauge_stream(keys, 240, start_ms=START * 1000),
+                     NUM_SHARDS)
+            cluster = FilodbCluster(replica_in_sync_lag=0,
+                                    replica_durable_sync_s=3600.0)
+            # each node opens its OWN store instance over the shared
+            # bucket (as real members would): follower bootstraps do real
+            # durable-tier GETs, making the flip's zero-GET claim testable
+            for n in ("node-a", "node-b", "node-c"):
+                cs, meta = open_object_store(
+                    {"endpoint": None, "bucket": "t"}, str(tmp_path))
+                stores.append((cs, meta))
+                cluster.join(Node(n, TimeSeriesMemStore(cs, meta)))
+            config = IngestionConfig("timeseries", NUM_SHARDS,
+                                     min_num_nodes=2,
+                                     store=StoreConfig(max_chunk_size=60,
+                                                       groups_per_shard=2))
+            cluster.setup_dataset(config, logs)
+            assert cluster.wait_active("timeseries", 15)
+            yield cluster, logs
+            cluster.stop()
+            for cs, meta in stores:
+                cs.close()
+                meta.close()
+            rvs = racecheck.violations()
+        vs = lockcheck.violations()
+    assert rvs == [], [v.render() for v in rvs]
+    assert vs == [], [v.render() for v in vs]
+
+
+def _query(cluster):
+    svc = cluster.query_service("timeseries", spread=1)
+    return svc.query_range(QUERY, START + 600, 300, START + 1500)
+
+
+def _flush_leaders(cluster):
+    """Seal + upload every leader shard's data so follower bootstraps have
+    sealed segments to recover (the durable tier the flip must NOT
+    re-read)."""
+    for node in cluster.nodes.values():
+        for (ds, s) in list(node._workers):
+            node.memstore.get_shard(ds, s).flush_all()
+        fl = getattr(node.memstore.column_store, "flush", None)
+        if callable(fl):
+            fl()
+
+
+def _wait_in_sync(cluster, timeout=30.0, drive=True):
+    """Wait until every shard has an IN_SYNC follower. ``drive`` re-runs
+    ensure_replicas from this thread (tests without the heartbeat loop);
+    with the failure detector running the heartbeat drives convergence."""
+    sm = cluster.shard_managers["timeseries"]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if all(sm.mapper.in_sync_followers(s) for s in range(NUM_SHARDS)):
+            return
+        if drive:
+            cluster.ensure_replicas("timeseries")
+        time.sleep(0.05)
+    pytest.fail(f"replicas never in-sync: {sm.mapper.snapshot()}")
+
+
+def _wait_caught_up(cluster, logs, timeout=20.0):
+    """Wait until every shard has an IN_SYNC follower whose published
+    watermark covers the log head."""
+    sm = cluster.shard_managers["timeseries"]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ok = True
+        for s in range(NUM_SHARDS):
+            top = logs[s].latest_offset
+            reps = sm.mapper.replicas_of(s)
+            if not any(st.status == ShardStatus.IN_SYNC
+                       and st.watermark >= top for st in reps.values()):
+                ok = False
+                break
+        if ok:
+            return
+        time.sleep(0.05)
+    pytest.fail(f"followers never caught up: {sm.mapper.snapshot()}")
+
+
+class TestReplicaLifecycle:
+    def test_followers_reach_in_sync(self, replica_env):
+        cluster, logs = replica_env
+        cluster.replication = 1
+        cluster.ensure_replicas("timeseries")
+        _wait_in_sync(cluster)
+        sm = cluster.shard_managers["timeseries"]
+        for s in range(NUM_SHARDS):
+            owner = sm.mapper.node_for(s)
+            followers = sm.mapper.in_sync_followers(s)
+            assert followers and owner not in followers
+            name = followers[0]
+            # follower is read-only: never registered as an ingest worker
+            assert ("timeseries", s) not in cluster.nodes[name]._workers
+            # published watermark covers the log head
+            st = sm.mapper.replicas_of(s)[name]
+            assert st.watermark == logs[s].latest_offset
+            # warm image mirrors the leader's partition set
+            lshard = cluster.nodes[owner].memstore.get_shard("timeseries", s)
+            fshard = cluster.nodes[name].memstore.get_shard("timeseries", s)
+            assert fshard.num_partitions == lshard.num_partitions
+        # the shardmap snapshot carries the replica sets
+        snap = cluster.shard_statuses("timeseries")
+        assert all(e.get("replicas") for e in snap), snap
+        assert check_replicas(cluster, "timeseries") == []
+
+    def test_unhealthy_leader_served_by_follower_with_warning(
+            self, replica_env):
+        cluster, _ = replica_env
+        baseline = _query(cluster)
+        cluster.replication = 1
+        cluster.ensure_replicas("timeseries")
+        _wait_in_sync(cluster)
+        sm = cluster.shard_managers["timeseries"]
+        owner = sm.mapper.node_for(0)
+        cluster.nodes[owner].alive = False  # unhealthy, not yet detected
+        try:
+            r = _query(cluster)
+            assert any("served by follower" in w for w in r.warnings), \
+                r.warnings
+            np.testing.assert_allclose(r.result.values,
+                                       baseline.result.values, rtol=1e-9)
+        finally:
+            cluster.nodes[owner].alive = True
+        reset_breakers()  # failures recorded against the leader while down
+        r2 = _query(cluster)
+        assert not any("served by follower" in w for w in r2.warnings)
+
+
+class TestPromotionMapFlip:
+    """Failover = map flip: ONE sequenced ACTIVE event, the follower's
+    warm image takes over at its applied offset, and the durable tier is
+    never re-read (GET accounting proves no sealed-segment replay)."""
+
+    def test_zero_get_flip(self, replica_env):
+        cluster, _ = replica_env
+        baseline = _query(cluster)
+        _flush_leaders(cluster)
+        cluster.replication = 1
+        cluster.ensure_replicas("timeseries")
+        _wait_in_sync(cluster)
+        sm = cluster.shard_managers["timeseries"]
+        a_shards = sm.mapper.shards_of("node-a")
+        assert a_shards
+        expected = {s: sm.mapper.in_sync_followers(s)[0] for s in a_shards}
+        prom0 = get_counter("filodb_replica_promotions",
+                            {"dataset": "timeseries"}).value
+        gets0 = GETS.value
+        cluster.leave("node-a")
+        # the flip itself performed ZERO object-store reads: no manifest
+        # refresh, no index recovery, no sealed-segment replay
+        assert GETS.value == gets0
+        assert get_counter("filodb_replica_promotions",
+                           {"dataset": "timeseries"}).value - prom0 \
+            == len(a_shards)
+        for s, follower in expected.items():
+            assert sm.mapper.node_for(s) == follower
+            assert sm.mapper.statuses[s] == ShardStatus.ACTIVE
+            # promoted out of the replica set, into the ingest path
+            assert follower not in sm.mapper.replicas_of(s)
+            assert ("timeseries", s) in cluster.nodes[follower]._workers
+            assert ("timeseries", s, follower) not in \
+                cluster.replica_syncers
+        after = _query(cluster)
+        np.testing.assert_allclose(after.result.values,
+                                   baseline.result.values, rtol=1e-9)
+
+
+@pytest.mark.slow
+class TestKillNodeSoak:
+    """Kill a node under continuous query load: zero failed queries, zero
+    wrong results vs an unkilled control cluster, rejoin as follower,
+    zero divergence at teardown."""
+
+    def test_kill_promote_rejoin_soak(self, replica_env):
+        cluster, logs = replica_env
+        sm = cluster.shard_managers["timeseries"]
+        # unkilled control cluster over the same logs: the equivalence
+        # oracle for every result the soak observes
+        control = FilodbCluster()
+        control.join(Node("control", TimeSeriesMemStore(
+            InMemoryColumnStore(), InMemoryMetaStore())))
+        control.setup_dataset(
+            IngestionConfig("timeseries", NUM_SHARDS, min_num_nodes=1,
+                            store=StoreConfig(max_chunk_size=60,
+                                              groups_per_shard=2)),
+            logs)
+        assert control.wait_active("timeseries", 15)
+        svc = control.query_service("timeseries", spread=1)
+        baseline = svc.query_range(QUERY, START + 600, 300,
+                                   START + 1500).result.values
+        control.stop()
+        np.testing.assert_allclose(_query(cluster).result.values, baseline,
+                                   rtol=1e-9)
+
+        _flush_leaders(cluster)
+        cluster.replication = 1
+        cluster.ensure_replicas("timeseries")
+        _wait_in_sync(cluster)
+        # second batch OUTSIDE the query window: followers genuinely
+        # ingest post-bootstrap rows (their high-water timestamps become
+        # comparable to the leaders') without perturbing the oracle
+        keys = machine_metrics_series(12, ns="App-3")
+        _publish(logs, gauge_stream(keys, 60,
+                                    start_ms=(START + 2400) * 1000),
+                 NUM_SHARDS)
+        _wait_caught_up(cluster, logs)
+
+        a_shards = sm.mapper.shards_of("node-a")
+        assert a_shards
+        prom0 = get_counter("filodb_replica_promotions",
+                            {"dataset": "timeseries"}).value
+        # freeze replica placement across the kill so the only durable
+        # reads possible during the flip window would be the promotion's
+        # own (there must be none) — re-replication is re-enabled after
+        cluster.replication = 0
+        cluster.start_failure_detector()
+
+        stats = {"ok": 0, "bad": 0, "fail": []}
+        stop_ev = threading.Event()
+
+        def soak():
+            while not stop_ev.is_set():
+                try:
+                    vals = _query(cluster).result.values
+                except Exception as e:  # noqa: BLE001 - tallied, asserted
+                    stats["fail"].append(repr(e))
+                    continue
+                if vals.shape == baseline.shape and \
+                        np.allclose(vals, baseline, rtol=1e-9):
+                    stats["ok"] += 1
+                else:
+                    stats["bad"] += 1
+
+        t = threading.Thread(target=soak, daemon=True, name="soak")
+        t.start()
+        time.sleep(0.3)
+        gets0 = GETS.value
+        node_a = cluster.nodes["node-a"]
+        node_a.kill()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "node-a" not in cluster.nodes and all(
+                    sm.mapper.node_for(s) not in (None, "node-a")
+                    and sm.mapper.statuses[s] == ShardStatus.ACTIVE
+                    for s in range(NUM_SHARDS)):
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail(f"failover never settled: {sm.mapper.snapshot()}")
+        time.sleep(0.5)  # keep querying well past the flip
+        stop_ev.set()
+        t.join(timeout=10)
+
+        # zero failed queries and zero wrong results across kill →
+        # detection → promotion (results may carry warnings; they may
+        # never be wrong or absent)
+        assert stats["fail"] == [], stats["fail"]
+        assert stats["bad"] == 0
+        assert stats["ok"] >= 10, stats
+        # the flip replayed nothing from the durable tier
+        assert GETS.value == gets0
+        assert get_counter("filodb_replica_promotions",
+                           {"dataset": "timeseries"}).value - prom0 \
+            == len(a_shards)
+        # the dead node's follower roles died with it
+        assert not any(k[2] == "node-a" for k in cluster.replica_syncers)
+        r = _query(cluster)
+        assert not any("served by follower" in w for w in r.warnings)
+        np.testing.assert_allclose(r.result.values, baseline, rtol=1e-9)
+
+        # rejoin as follower: the warm ex-leader image is reused, no
+        # leader roles reassigned (rows it already holds dedup on replay)
+        cluster.replication = 1
+        node_a.alive = True
+        cluster.join(node_a)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(sm.mapper.in_sync_followers(s)
+                   for s in range(NUM_SHARDS)) \
+                    and sm.mapper.follower_shards("node-a"):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"rejoin never converged: {sm.mapper.snapshot()}")
+        assert sm.mapper.shards_of("node-a") == []
+        np.testing.assert_allclose(_query(cluster).result.values, baseline,
+                                   rtol=1e-9)
+        # chaos teardown gate: zero replica divergence
+        assert_no_divergence(cluster, "timeseries", timeout_s=15)
+
+
+class TestDeferredPromotionRaces:
+    """Regression: a deferred (rate-limited) shard must not be
+    double-assigned over a leader the replica path produced meanwhile."""
+
+    def _two_losses(self, interval=0.2):
+        sm = ShardManager("ds", 4, min_num_nodes=2,
+                          reassignment_min_interval_s=interval)
+        for n in ("n1", "n2", "n3", "n4"):
+            sm.add_member(n)
+        lost = sm.mapper.shards_of("n1")
+        sm.remove_member("n1")  # stamps the reassignment clock
+        victim = sm.mapper.node_for(lost[0])
+        relost = sm.mapper.shards_of(victim)
+        sm.remove_member(victim)  # inside the interval: deferred
+        assert set(relost) <= sm._deferred
+        return sm, relost
+
+    def test_deferred_skips_shard_promotion_already_owns(self):
+        sm, relost = self._two_losses()
+        s0 = relost[0]
+        survivor = sm.nodes[0]
+        # a promotion claims the shard while it sits deferred
+        sm.promote(s0, survivor)
+        time.sleep(0.25)
+        events = sm.check_deferred()
+        # the retry must NOT re-assign the promoted shard over its leader
+        assert not any(e.shard == s0 and e.status == ShardStatus.ASSIGNED
+                       for e in events), events
+        assert sm.mapper.node_for(s0) == survivor
+        assert s0 not in sm._deferred
+
+    def test_deferred_promotes_caught_up_follower(self):
+        sm, relost = self._two_losses()
+        s0 = relost[0]
+        survivor = sm.nodes[0]
+        # a follower catches up while the shard sits deferred
+        sm.replica_update(s0, survivor, ShardStatus.IN_SYNC, watermark=7)
+        time.sleep(0.25)
+        events = sm.check_deferred()
+        flips = [e for e in events if e.shard == s0 and not e.replica]
+        assert flips and flips[0].status == ShardStatus.ACTIVE
+        assert flips[0].node == survivor
+        assert sm.mapper.node_for(s0) == survivor
+        assert survivor not in sm.mapper.replicas_of(s0)
+        assert s0 not in sm._deferred
+
+
+class _StubDispatcher(PlanDispatcher):
+    def __init__(self, result, delay=0.0, error=None):
+        self.result = result
+        self.delay = delay
+        self.error = error
+        self.calls = 0
+
+    def dispatch(self, plan, ctx):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        if self.error:
+            raise self.error
+        return self.result
+
+
+class TestHedgedReads:
+    def test_hedge_timer_launches_follower_and_wins(self):
+        slow = _StubDispatcher("leader", delay=0.5)
+        fast = _StubDispatcher("follower")
+        rd = ReplicaDispatcher(0, [
+            ReplicaCandidate("hx-leader", slow),
+            ReplicaCandidate("hx-follower", fast, follower=True),
+        ], hedge_timeout_s=0.02)
+        h0, w0, f0 = HEDGED.value, HEDGED_WON.value, FOLLOWER_READS.value
+        assert rd.dispatch(None, None) == "follower"
+        assert HEDGED.value - h0 == 1
+        assert HEDGED_WON.value - w0 == 1
+        assert FOLLOWER_READS.value - f0 == 1
+
+    def test_failure_failover_is_not_hedged(self):
+        dead = _StubDispatcher(None, error=ConnectionError("down"))
+        ok = _StubDispatcher("follower")
+        rd = ReplicaDispatcher(0, [
+            ReplicaCandidate("hf-leader", dead),
+            ReplicaCandidate("hf-follower", ok, follower=True),
+        ], hedge_timeout_s=5.0)
+        h0 = HEDGED.value
+        assert rd.dispatch(None, None) == "follower"
+        assert HEDGED.value == h0  # failover, not a hedge
+
+    def test_all_replicas_failing_raises(self):
+        rd = ReplicaDispatcher(0, [
+            ReplicaCandidate("af-a", _StubDispatcher(
+                None, error=ConnectionError("a"))),
+            ReplicaCandidate("af-b", _StubDispatcher(
+                None, error=ConnectionError("b")), follower=True),
+        ], hedge_timeout_s=0.01)
+        with pytest.raises(ConnectionError):
+            rd.dispatch(None, None)
+
+    def test_open_breaker_candidate_goes_last(self):
+        breaker_for("ob-leader").force_open()
+        a = _StubDispatcher("leader")
+        b = _StubDispatcher("follower")
+        rd = ReplicaDispatcher(0, [
+            ReplicaCandidate("ob-leader", a),
+            ReplicaCandidate("ob-follower", b, follower=True),
+        ], hedge_timeout_s=5.0)
+        assert rd.dispatch(None, None) == "follower"
+        assert a.calls == 0  # never dispatched at the open peer
+
+    def test_ewma_latency_orders_candidates(self):
+        record_peer_latency("ew-slow", 0.5)
+        record_peer_latency("ew-fast", 0.001)
+        rd = ReplicaDispatcher(0, [
+            ReplicaCandidate("ew-slow", _StubDispatcher("s")),
+            ReplicaCandidate("ew-fast", _StubDispatcher("f"),
+                             follower=True),
+        ])
+        assert [c.key for c in rd._ordered()] == ["ew-fast", "ew-slow"]
+        # unknown latencies keep construction order (leader first)
+        reset_peer_latency()
+        assert [c.key for c in rd._ordered()] == ["ew-slow", "ew-fast"]
+
+
+class TestDivergenceCheck:
+    def test_stalled_follower_reported(self, replica_env):
+        cluster, logs = replica_env
+        cluster.replication = 1
+        cluster.ensure_replicas("timeseries")
+        _wait_in_sync(cluster)
+        div0 = get_counter("filodb_replica_divergence").value
+        # freeze one follower's tail (its IN_SYNC claim goes stale), then
+        # advance the leaders past it — picking a shard that actually
+        # carries data (the series set routes to a subset of shards)
+        key = next(k for k in cluster.replica_syncers
+                   if logs[k[1]].latest_offset >= 0)
+        _, stalled_shard, stalled_node = key
+        cluster.replica_syncers[key].stop()
+        keys = machine_metrics_series(12, ns="App-3")
+        _publish(logs, gauge_stream(keys, 20,
+                                    start_ms=(START + 2400) * 1000),
+                 NUM_SHARDS)
+        deadline = time.monotonic() + 10
+        found = []
+        while time.monotonic() < deadline:
+            found = [i for i in check_replicas(cluster, "timeseries")
+                     if i["shard"] == stalled_shard
+                     and i["follower"] == stalled_node
+                     and i["kind"] == "watermark_lag"]
+            if found:
+                break
+            time.sleep(0.05)
+        assert found, "stalled follower never reported divergent"
+        assert get_counter("filodb_replica_divergence").value > div0
+
+
+def _shardmap_doc(leader_wm, rep_wm, rep_status="in_sync"):
+    return {"data": {"shards": [
+        {"shard": 0, "node": "n1", "status": "active",
+         "watermark": leader_wm,
+         "replicas": [{"node": "n2", "status": rep_status,
+                       "watermark": rep_wm}]}], "tenants": []}}
+
+
+class TestReplicacheckCli:
+    def _patch(self, monkeypatch, doc):
+        import urllib.request
+        monkeypatch.setattr(urllib.request, "urlopen",
+                            lambda url: io.StringIO(json.dumps(doc)))
+
+    def test_clean_exits_zero(self, monkeypatch, capsys):
+        from filodb_tpu import cli
+        self._patch(monkeypatch, _shardmap_doc(10, 10))
+        assert cli.main(["--host", "h:1", "replicacheck"]) == 0
+        assert "0 divergent" in capsys.readouterr().out
+
+    def test_divergent_exits_one(self, monkeypatch, capsys):
+        from filodb_tpu import cli
+        self._patch(monkeypatch, _shardmap_doc(10, 5))
+        assert cli.main(["--host", "h:1", "replicacheck"]) == 1
+        out = capsys.readouterr().out
+        assert "DIVERGED (lag 5)" in out and "1 divergent" in out
+
+    def test_lagging_follower_skipped(self, monkeypatch, capsys):
+        from filodb_tpu import cli
+        self._patch(monkeypatch, _shardmap_doc(10, 2, rep_status="lagging"))
+        assert cli.main(["--host", "h:1", "replicacheck"]) == 0
+        assert "skip (lagging)" in capsys.readouterr().out
+
+    def test_shardmap_renders_replica_sets(self, monkeypatch, capsys):
+        from filodb_tpu import cli
+        self._patch(monkeypatch, _shardmap_doc(10, 10))
+        cli.main(["--host", "h:1", "shardmap"])
+        assert "n2:in_sync@10" in capsys.readouterr().out
+
+
+class _EventFeed:
+    """Stub dispatcher bridging ShardManager.events_since over the wire
+    format the standalone executor serves (6-tuples)."""
+
+    def __init__(self, sm):
+        self.sm = sm
+
+    def call(self, method, dataset, since_seq, epoch):
+        assert method == "shard_events"
+        events, seq, resynced, ep = self.sm.events_since(since_seq, epoch)
+        wire = [(e.shard, e.status.name, e.node, e.progress, e.replica,
+                 e.watermark) for e in events]
+        return wire, seq, resynced, ep
+
+
+class TestReplicaEventWire:
+    def test_replica_events_mirror_round_trip(self):
+        sm = ShardManager("ds", 4, min_num_nodes=1)
+        sm.add_member("n1")
+        sub = ShardUpdateSubscriber("ds", 4, _EventFeed(sm))
+        sub.poll()
+        assert sub.mapper.node_for(0) == "n1"
+        sm.replica_update(0, "n2", ShardStatus.FOLLOWING, watermark=3)
+        sm.replica_update(0, "n2", ShardStatus.IN_SYNC, watermark=9)
+        sub.poll()
+        st = sub.mapper.replicas_of(0)["n2"]
+        assert st.status == ShardStatus.IN_SYNC and st.watermark == 9
+        assert sub.mapper.in_sync_followers(0) == ["n2"]
+        sm.drop_replica(0, "n2")
+        sub.poll()
+        assert sub.mapper.replicas_of(0) == {}
+        # a resync snapshot also carries replica sets
+        sm.replica_update(1, "n3", ShardStatus.IN_SYNC, watermark=4)
+        fresh = ShardUpdateSubscriber("ds", 4, _EventFeed(sm))
+        fresh.poll()
+        assert fresh.mapper.in_sync_followers(1) == ["n3"]
+
+    def test_legacy_four_tuple_events_still_apply(self):
+        class _Legacy:
+            def call(self, *_):
+                return [(0, "ACTIVE", "n1", 100)], 1, False, "e1"
+
+        sub = ShardUpdateSubscriber("ds", 4, _Legacy())
+        sub.poll()
+        assert sub.mapper.node_for(0) == "n1"
+        assert sub.mapper.statuses[0] == ShardStatus.ACTIVE
