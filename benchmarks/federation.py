@@ -113,6 +113,145 @@ def bench_federation(n_warm: int = 30):
             "tiers": 3, "unit": "ms"}
 
 
+def bench_federation_yearscan(repeats: int = 5):
+    """Cold-tier long-history scan: demand paging (``FILODB_SIDECARS=0``,
+    the pre-pyramid baseline) vs the pyramid lane folding stored
+    aggregates. The grid is pinned to chunk seal boundaries — the shape
+    a dashboard's aligned range query takes — so the pyramid pass pages
+    ZERO chunk payload bytes; the baseline decodes every chunk. Caches
+    are dropped before every timed pass (both lanes run cold)."""
+    import os
+
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.coordinator.planner import SingleClusterPlanner
+    from filodb_tpu.coordinator.tiered_planner import build_tiered_planner
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.api import InMemoryMetaStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.core.store.objectstore import (
+        BYTES_DOWN,
+        PAYLOAD_BYTES_DOWN,
+        ObjectStoreColumnStore,
+    )
+    from filodb_tpu.promql.parser import TimeStepParams, parse_query
+    from filodb_tpu.query.exec.plan import ExecContext
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+    from filodb_tpu.testing.fake_s3 import FakeS3
+
+    num_shards, series, chunk, samples = 2, 16, 512, 4096
+    s3 = FakeS3()
+    cs = ObjectStoreColumnStore(s3)
+    ms = TimeSeriesMemStore(cs, InMemoryMetaStore())
+    for s in range(num_shards):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=chunk,
+                                              groups_per_shard=2))
+    ingest_routed(ms, "timeseries",
+                  gauge_stream(machine_metrics_series(series), samples,
+                               start_ms=START * 1000, seed=11),
+                  num_shards, spread=0)
+    ms.flush_all("timeseries")
+    cs.flush()
+
+    # everything below the memory floor: the whole scan is cold-tier
+    now = (START + samples * 10 + 100) * 1000
+    planner = build_tiered_planner(
+        SingleClusterPlanner("timeseries", num_shards, spread=0), cs,
+        "timeseries", num_shards, mem_retention_ms=1000,
+        raw_retention_ms=None, ds_planner=None, now_ms=lambda: now)
+    store = planner.cold_planner.store
+    # steps at seal boundaries (chunk k ends at sample 512k-1), window
+    # reaching before the first sample: interior-only composition
+    span_s = chunk * 10
+    q = parse_query(f"sum_over_time(heap_usage[{samples * 10 + 100}s])",
+                    TimeStepParams(START + 2 * span_s - 10, 2 * span_s,
+                                   START + 8 * span_s - 10))
+    ep = planner.materialize(q)
+
+    def one_pass():
+        store.clear_caches()
+        b0, p0 = BYTES_DOWN.value, PAYLOAD_BYTES_DOWN.value
+        t0 = time.perf_counter()
+        ep.dispatcher.dispatch(ep, ExecContext(ms, "timeseries"))
+        dt = (time.perf_counter() - t0) * 1000.0
+        return dt, BYTES_DOWN.value - b0, PAYLOAD_BYTES_DOWN.value - p0
+
+    out = {}
+    for label, valve in (("paging", "0"), ("pyramid", "1")):
+        os.environ["FILODB_SIDECARS"] = valve
+        try:
+            one_pass()  # jit/compile warmup, then timed cold passes
+            runs = [one_pass() for _ in range(repeats)]
+        finally:
+            os.environ.pop("FILODB_SIDECARS", None)
+        out[label] = {
+            "p50_ms": round(_percentile([r[0] for r in runs], 50), 3),
+            "bytes_down": int(runs[0][1]),
+            "payload_bytes": int(runs[0][2]),
+        }
+    return {"metric": "federation_yearscan_paging_vs_pyramid",
+            "series": series, "chunks_per_series": samples // chunk,
+            **{f"{k}_{kk}": vv for k, v in out.items()
+               for kk, vv in v.items()},
+            "speedup_p50": round(out["paging"]["p50_ms"]
+                                 / out["pyramid"]["p50_ms"], 1),
+            "unit": "ms"}
+
+
+def bench_pyramid_topk_1m(n_series: int = 1_000_000,
+                          n_segments: int = 64):
+    """Sketch-served ``topk(10)`` / count-distinct at 1M series: build
+    per-segment TopK + HLL footers over a synthetic splitmix64 key
+    population, then merge + rank — the summary-only scan the approx
+    lane runs, with zero chunk payloads by construction."""
+    import numpy as np
+
+    from filodb_tpu.memory.sketches import HLLSketch, TopKSketch, splitmix64
+
+    rng = np.random.default_rng(5)
+    hashes = splitmix64(np.arange(1, n_series + 1, dtype=np.uint64))
+    values = rng.pareto(2.0, n_series) * 100.0
+    per = n_series // n_segments
+
+    t0 = time.perf_counter()
+    topks, hlls = [], []
+    for s in range(n_segments):
+        tk, hl = TopKSketch(capacity=64), HLLSketch()
+        lo = s * per
+        hl.update_hashes(hashes[lo:lo + per])
+        # only candidates can place: feeding the per-segment top slice
+        # mirrors the seal-time fold (every row passes through update)
+        seg_vals = values[lo:lo + per]
+        for i in np.argpartition(seg_vals, -64)[-64:]:
+            tk.update(int(hashes[lo + i]).to_bytes(8, "little"),
+                      float(seg_vals[i]))
+        topks.append(tk)
+        hlls.append(hl)
+    build_ms = (time.perf_counter() - t0) * 1000.0
+
+    t0 = time.perf_counter()
+    topk, hll = TopKSketch(capacity=256), HLLSketch()
+    for tk, hl in zip(topks, hlls):
+        topk.merge(tk)
+        hll.merge(hl)
+    top10 = topk.top(10)
+    est = hll.estimate()
+    merge_ms = (time.perf_counter() - t0) * 1000.0
+
+    true10 = np.sort(values)[-10:][::-1]
+    got10 = np.array([v for _, v in top10])
+    return {"metric": "pyramid_topk_1m", "series": n_series,
+            "segments": n_segments,
+            "build_ms": round(build_ms, 1),
+            "merge_and_rank_ms": round(merge_ms, 3),
+            "topk_exact": bool(np.allclose(got10, true10)),
+            "cardinality_est": int(est),
+            "cardinality_err_pct": round(
+                abs(est - n_series) / n_series * 100.0, 2),
+            "unit": "ms"}
+
+
 if __name__ == "__main__":
     import json
     print(json.dumps(bench_federation()))
+    print(json.dumps(bench_federation_yearscan()))
+    print(json.dumps(bench_pyramid_topk_1m()))

@@ -510,6 +510,18 @@ def _bench_federation():
     return bench_federation()
 
 
+def _bench_federation_yearscan():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from federation import bench_federation_yearscan
+    return bench_federation_yearscan()
+
+
+def _bench_pyramid_topk_1m():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from federation import bench_pyramid_topk_1m
+    return bench_pyramid_topk_1m()
+
+
 def _bench_mesh_scaling(devices=None):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mesh_scaling import DEFAULT_DEVICES, run_sweep
@@ -539,6 +551,8 @@ ALL = {
     "tracing_overhead": _bench_tracing_overhead,
     "selfmon_overhead": _bench_selfmon_overhead,
     "federation": _bench_federation,
+    "federation_yearscan": _bench_federation_yearscan,
+    "pyramid_topk_1m": _bench_pyramid_topk_1m,
     "mesh_scaling": _bench_mesh_scaling,
 }
 

@@ -14,11 +14,19 @@ economics hinge on chunk size and cache state:
   chunk size.
 * ``cold_scan_medium_chunks`` — a dashboard range scan over medium
   chunks, cold. Less interior skipped per partition-window, smaller win.
+* ``wide_fanout_batched_fold`` — 1024 partitions x 6 steps: 6144
+  partition-windows, ABOVE the pre-batching gate default (4096) and
+  well under the current one (65536). The flat-batch sealed fold
+  (``_eval_sealed_batch``) amortizes the python cost across the whole
+  group in one composite-key pass, so the lane now wins where the
+  per-partition fold used to bypass — the measurement the 16x gate
+  widening rests on.
 * ``gated_scan_small_chunks`` — many partitions, small chunks, warm
-  decode memos: the per-partition python fold cannot amortize, the
-  sealed gate (``FILODB_SIDECAR_SEALED_GATE``) detects it from chunk
-  geometry and the lane bypasses. Reported to show the gate holds the
-  lane at parity instead of regressing.
+  decode memos: tiny chunk spans leave almost no interior to skip, the
+  amortization check (``FILODB_SIDECAR_SEALED_GATE`` + the
+  skipped-samples estimate) detects it from chunk geometry and the
+  lane bypasses. Reported to show the gate holds the lane at parity
+  instead of regressing.
 
 Identical stores and queries per scenario; the valve (``FILODB_SIDECARS``)
 is the only variable. "Cold" scenarios drop per-chunk decode memos and
@@ -40,6 +48,9 @@ SCENARIOS = [
                  "sum(rate(http_requests_total[{w}]))"]},
     {"name": "cold_scan_medium_chunks", "series": 256, "chunk": 512,
      "samples": 6144, "window": "680m", "steps": 6, "cold": True,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+    {"name": "wide_fanout_batched_fold", "series": 1024, "chunk": 512,
+     "samples": 3072, "window": "500m", "steps": 6, "cold": True,
      "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
     {"name": "gated_scan_small_chunks", "series": 1024, "chunk": 64,
      "samples": 720, "window": "40m", "steps": 6, "cold": False,

@@ -24,6 +24,13 @@ scrape-test lists REGARDLESS of the lazy/GaugeFn exemptions PR203 grants
 default lag alerts), so an unasserted family here means the monitoring
 of the monitor is untested.
 
+PR207 extends the same no-exemption treatment to the aggregate-pyramid
+families (``filodb_pyramid_``): the cold-tier zero-payload guarantee is
+asserted through these counters (``core/store/pyramid.py``,
+``query/engine/pyramid_lane.py``), and they all register when
+objectstore imports pyramid at boot, so every family must be pinned in
+the scrape test.
+
 Static approximations: the wire walk mirrors ``_build_registry`` by
 reading its two loops from the AST (explicit tuple + subclass-walked
 bases) and closing over AST-declared subclasses; metric creations made
@@ -339,6 +346,26 @@ def _check_metrics(ctx: AnalysisContext, out: list[Finding]) -> None:
                 f"{e!r} which no expected-name list in "
                 f"{ctx.scrape_test} asserts (the lazy/GaugeFn "
                 f"exemptions do not apply to ingest/selfmon families)"))
+
+    # PR207: aggregate-pyramid families must be breadth-tested the same
+    # way — they carry the cold-tier zero-payload accounting, register at
+    # import (objectstore imports pyramid), and render at zero before any
+    # cold fold, so neither the lazy nor the GaugeFn exemption applies.
+    seen207: set[tuple[str, str]] = set()
+    for s in sites:
+        if not s.name.startswith("filodb_pyramid_"):
+            continue
+        for e in s.exposed:
+            if e in expected or (s.name, e) in seen207:
+                continue
+            seen207.add((s.name, e))
+            out.append(Finding(
+                "PR207", s.path, s.line, s.symbol, e,
+                f"aggregate-pyramid metric {s.name!r} renders family "
+                f"{e!r} which no expected-name list in "
+                f"{ctx.scrape_test} asserts (pyramid families carry the "
+                f"zero-payload accounting and register at import; no "
+                f"exemptions apply)"))
 
     # PR204: asserted name no creation site produces (lazy sites count)
     produced: set[str] = set()

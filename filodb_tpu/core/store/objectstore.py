@@ -57,6 +57,10 @@ from filodb_tpu.core.partkey import PartKey
 from filodb_tpu.core.store.api import (ColumnStore, MetaStore, PartKeyRecord)
 from filodb_tpu.core.store.localstore import _pk_blob, _pk_from_blob
 from filodb_tpu.core.store.remotestore import split_of
+# one-way import: pyramid never imports the object store (its objects
+# carry their own CRC); importing it here also registers the
+# filodb_pyramid_* metric families at store boot
+from filodb_tpu.core.store import pyramid
 from filodb_tpu.memory.chunk import Chunk, ensure_summary
 from filodb_tpu.utils.metrics import Counter, Gauge, GaugeFn
 from filodb_tpu.utils.resilience import FaultInjector, RetryPolicy
@@ -122,6 +126,12 @@ PUTS = Counter("filodb_objectstore_puts")
 GETS = Counter("filodb_objectstore_gets")
 BYTES_UP = Counter("filodb_objectstore_bytes_up")
 BYTES_DOWN = Counter("filodb_objectstore_bytes_down")
+# chunk-payload share of BYTES_DOWN (ranged GETs only — excludes
+# manifests, pyramids and bootstrap full-segment loads): the pyramid
+# lane's zero-payload claim is asserted against this counter's delta
+PAYLOAD_BYTES_DOWN = Counter(
+    "filodb_objectstore_payload_bytes_down",
+    help="bytes of chunk payload fetched via ranged GETs")
 RETRIES = Counter("filodb_objectstore_retries")
 COMPACTIONS = Counter("filodb_objectstore_compactions")
 CORRUPT = Counter("filodb_objectstore_corrupt")
@@ -195,6 +205,8 @@ class _OpenSegment:
         self.buf.write(_MAGIC)
         self.entries = 0
         self.max_upd = 0
+        # sealed (pk_blob, chunk) rows for the pyramid roll-up at seal
+        self.pyr_rows: list[tuple[bytes, Chunk]] = []
 
     def size(self) -> int:
         return self.buf.tell()
@@ -214,6 +226,7 @@ class _OpenSegment:
         b.write(struct.pack("<I", crc))
         self.entries += 1
         self.max_upd = max(self.max_upd, upd)
+        self.pyr_rows.append((pk_blob, ch))
         return off, len(data), crc
 
     def add_part_key(self, pk_blob: bytes, start: int, end: int,
@@ -315,6 +328,12 @@ class _ShardState:
         self.pending: dict[int, bytes] = {}       # seq -> sealed bytes
         self.open: dict[int, _OpenSegment] = {}   # bucket -> open segment
         self.checkpoints: dict[int, int] = {}
+        # pyramid index: seg seqs with an UPLOADED seg-*.pyr beside them,
+        # and per-bucket {"bucket","seq","key","covers"} roll-up records.
+        # Both land in the manifest only after their object is durable —
+        # a reader that races an upload just demotes to chunk fallback
+        self.seg_pyramids: set[int] = set()
+        self.bucket_pyramids: dict[int, dict] = {}
 
 
 _STOP = object()
@@ -504,6 +523,22 @@ class ObjectStoreColumnStore(ColumnStore):
                         f"{kind} parked behind failed upload "
                         f"({dataset}/shard-{shard})")
                     continue
+                if kind == "pyramid":
+                    # derived data: a failed pyramid upload never poisons
+                    # the shard (readers just keep chunk-level fallback);
+                    # the seq registers only after the PUT lands, closing
+                    # the read-race window by construction
+                    seq, key, data = task[3], task[4], task[5]
+                    try:
+                        self._uploader_put(key, data)
+                        with self._lock:
+                            st = self._states.get((dataset, shard))
+                            if st is not None and seq in st.segments:
+                                st.seg_pyramids.add(seq)
+                        self._put_manifest(dataset, shard)
+                    except Exception as e:
+                        self._upload_errors.append(f"pyramid: {e!r}")
+                    continue
                 if kind == "segment":
                     seq, key, data = task[3], task[4], task[5]
                     # slow uploads land in the ingest-side flight recorder
@@ -580,6 +615,11 @@ class ObjectStoreColumnStore(ColumnStore):
                     for s in sorted(st.segments.values(),
                                     key=lambda s: s.seq)
                     if s.uploaded],
+                "pyramids": sorted(
+                    q for q in st.seg_pyramids
+                    if q in st.segments and st.segments[q].uploaded),
+                "bucket_pyramids": [st.bucket_pyramids[b]
+                                    for b in sorted(st.bucket_pyramids)],
             }
         key = self._shard_prefix(dataset, shard) + "manifest.json"
         self._uploader_put(key, json.dumps(doc).encode())
@@ -626,6 +666,10 @@ class ObjectStoreColumnStore(ColumnStore):
             known = set(st.segments)
             st.next_seq = max(st.next_seq, int(doc.get("next_seq", 1)))
             st.upd = max(st.upd, int(doc.get("upd", 0)))
+            st.seg_pyramids = {int(q) for q in doc.get("pyramids", ())}
+            st.bucket_pyramids = {
+                int(d["bucket"]): d
+                for d in doc.get("bucket_pyramids", ())}
         applied = 0
         for s in sorted(doc.get("segments", ()),
                         key=lambda s: int(s["seq"])):
@@ -682,6 +726,11 @@ class ObjectStoreColumnStore(ColumnStore):
             if doc:
                 st.next_seq = int(doc.get("next_seq", 1))
                 st.upd = int(doc.get("upd", 0))
+                st.seg_pyramids = {int(q)
+                                   for q in doc.get("pyramids", ())}
+                st.bucket_pyramids = {
+                    int(d["bucket"]): d
+                    for d in doc.get("bucket_pyramids", ())}
                 for s in doc.get("segments", ()):
                     info = _SegmentInfo(
                         int(s["seq"]), int(s["bucket"]), s["key"],
@@ -752,6 +801,15 @@ class ObjectStoreColumnStore(ColumnStore):
             seg.entries, seg.max_upd, False)
         st.pending[seg.seq] = data
         self._submit(("segment", dataset, shard, seg.seq, key, data))
+        # pyramid roll-up rides FIFO behind its segment, so the manifest
+        # can never advertise a pyramid whose segment isn't durable yet.
+        # FSG1-mode writers (legacy compat tests patch _MAGIC) emit no
+        # pyramids — compaction backfills them on rewrite
+        if _MAGIC == b"FSG2":
+            pdata = pyramid.build_segment_pyramid(seg.pyr_rows)
+            if pdata is not None:
+                self._submit(("pyramid", dataset, shard, seg.seq,
+                              key[:-4] + ".pyr", pdata))
 
     def _seal_all(self, st, dataset, shard) -> None:
         for bkt in list(st.open):
@@ -901,12 +959,14 @@ class ObjectStoreColumnStore(ColumnStore):
         dense = sum(r.length for r in seq_refs)
         if hi - lo <= dense + 4096 * len(seq_refs):
             blob = self._get(key, lo, hi - lo)
+            PAYLOAD_BYTES_DOWN.inc(hi - lo)
             for r in seq_refs:
                 out[r.chunk_id] = blob[r.offset - lo:
                                        r.offset - lo + r.length]
         else:
             for r in seq_refs:
                 out[r.chunk_id] = self._get(key, r.offset, r.length)
+                PAYLOAD_BYTES_DOWN.inc(r.length)
 
     def read_chunks(self, dataset, shard, part_key, start_time, end_time):
         with span("objectstore", op="read_chunks", shard=shard):
@@ -921,6 +981,64 @@ class ObjectStoreColumnStore(ColumnStore):
                 return []
             payloads = self._fetch_refs(dataset, shard, st, part_key, refs)
             return [Chunk.deserialize(payloads[r.chunk_id]) for r in refs]
+
+    # ------------------------------------------------------ pyramid reads
+    def pyramid_refs(self, dataset, shard, part_key):
+        """Pyramid-lane index snapshot for one part key: (chunk refs
+        sorted by id, frozenset of seg seqs with a durable segment
+        pyramid, this key's bucket roll-up record or None)."""
+        # _state() outside the lock: a cold load does retried network
+        # GETs and must not stall other shards (same as read_chunks' seam)
+        st = self._state(dataset, shard)
+        with self._lock:
+            refs = sorted(st.chunks.get(part_key, {}).values(),
+                          key=lambda r: r.chunk_id)
+            part = st.parts.get(part_key)
+            bkt = part[3] if part is not None \
+                else self._bucket_of(_pk_blob(part_key))
+            return refs, frozenset(st.seg_pyramids), \
+                st.bucket_pyramids.get(bkt)
+
+    def _read_pyramid_object(self, key: str, parse) -> dict | None:
+        try:
+            data = self._get(key)
+        except KeyError:
+            return None   # raced a compaction delete: demote a level
+        pyramid.PYR_BYTES_DOWN.inc(len(data))
+        try:
+            return parse(data, key)
+        except pyramid.PyramidParseError:
+            CORRUPT.inc()
+            return None   # derived data: corrupt pyramid only demotes
+
+    def read_segment_pyramid(self, dataset, shard, seq) -> dict | None:
+        st = self._state(dataset, shard)
+        with self._lock:
+            info = st.segments.get(seq)
+            if seq not in st.seg_pyramids or info is None:
+                return None
+            key = info.key[:-4] + ".pyr"
+        return self._read_pyramid_object(key,
+                                         pyramid.parse_segment_pyramid)
+
+    def read_bucket_pyramid(self, dataset, shard, bkt) -> dict | None:
+        st = self._state(dataset, shard)
+        with self._lock:
+            bp = st.bucket_pyramids.get(bkt)
+            if bp is None:
+                return None
+            key = bp["key"]
+        return self._read_pyramid_object(key,
+                                         pyramid.parse_bucket_pyramid)
+
+    def pyramid_index(self, dataset, shard) -> tuple[list[int], dict]:
+        """Enumeration for summary-only scans (approx topk/cardinality):
+        (sorted seg seqs with a pyramid, {bucket: roll-up record})."""
+        st = self._state(dataset, shard)
+        with self._lock:
+            return (sorted(q for q in st.seg_pyramids
+                           if q in st.segments),
+                    dict(st.bucket_pyramids))
 
     def scan_part_keys(self, dataset, shard):
         with self._lock:
@@ -1124,6 +1242,10 @@ class ObjectStoreColumnStore(ColumnStore):
                 # a segment may have been compacted away meanwhile
                 if any(s.seq not in st.segments for s, _ in parsed):
                     return
+                # legacy (FSG1 / pre-pyramid FSG2) inputs gaining pyramid
+                # coverage through this rewrite
+                backfilled = sum(
+                    1 for s, _ in parsed if s.seq not in st.seg_pyramids)
                 new = _OpenSegment(st.next_seq, bkt)
                 st.next_seq += 1
                 moved: list[tuple[PartKey, _ChunkRef]] = []
@@ -1161,9 +1283,31 @@ class ObjectStoreColumnStore(ColumnStore):
                     new.seq, bkt, key, len(data),
                     crc32c(data[:-_FOOTER.size]), new.entries,
                     new.max_upd, False)
+            # pyramid roll-ups over the rewritten rows: the segment level
+            # plus the bucket level (the compacted bucket IS one segment,
+            # so the bucket rows equal the new segment's rows — covers
+            # records that). ensure_summary above backfilled legacy chunks
+            spyr = pyramid.build_segment_pyramid(new.pyr_rows)
+            bpyr = pyramid.build_bucket_pyramid(new.pyr_rows, [new.seq])
+            pkey = key[:-4] + ".pyr"
+            bkey = self._shard_prefix(dataset, shard) \
+                + f"b{bkt:02d}/bkt-{new.seq:08d}.pyr"
             # upload the replacement BEFORE swapping the index/manifest
             self._uploader_put(key, data)
             info.uploaded = True
+            # pyramids too land BEFORE the swap (a manifest must never
+            # advertise an absent pyramid); their failure only demotes
+            # readers to chunk fallback, never aborts the compaction
+            spyr_ok = bpyr_ok = False
+            try:
+                if spyr is not None:
+                    self._uploader_put(pkey, spyr)
+                    spyr_ok = True
+                if bpyr is not None:
+                    self._uploader_put(bkey, bpyr)
+                    bpyr_ok = True
+            except Exception as e:
+                self._upload_errors.append(f"pyramid: {e!r}")
             with self._lock:
                 st.segments[info.seq] = info
                 for pk, ref in moved:
@@ -1172,12 +1316,28 @@ class ObjectStoreColumnStore(ColumnStore):
                         live[ref.chunk_id] = ref
                 for s, _ in parsed:
                     st.segments.pop(s.seq, None)
+                    st.seg_pyramids.discard(s.seq)
+                if spyr_ok:
+                    st.seg_pyramids.add(new.seq)
+                old_bp = st.bucket_pyramids.pop(bkt, None)
+                if bpyr_ok:
+                    st.bucket_pyramids[bkt] = {
+                        "bucket": bkt, "seq": new.seq, "key": bkey,
+                        "covers": [new.seq]}
             self._put_manifest(dataset, shard)
             for s, _ in parsed:
+                for k in (s.key, s.key[:-4] + ".pyr"):
+                    try:
+                        self.client.delete_object(k)
+                    except Exception:
+                        pass   # orphan object; harmless (not in manifest)
+            if old_bp is not None and old_bp.get("key") != bkey:
                 try:
-                    self.client.delete_object(s.key)
+                    self.client.delete_object(old_bp["key"])
                 except Exception:
-                    pass   # orphan object; harmless (not in manifest)
+                    pass
+            if spyr_ok and backfilled:
+                pyramid.PYR_BACKFILLED.inc(backfilled)
             COMPACTIONS.inc()
 
     # ------------------------------------------------------------ lifecycle

@@ -1,0 +1,413 @@
+"""Aggregate pyramids: per-segment and per-bucket summary objects.
+
+PR 15 put a 12-slot summary + log2 sketch on every sealed chunk (FSG2).
+This module climbs the hierarchy (ROADMAP item 2, the Zarr "chunk-level
+cumulative sums in reduced dimensions" design from PAPERS.md): at seal
+and compaction the object store rolls those chunk summaries up into
+
+    seg-XXXXXXXX.pyr   one merged row + sketch per (part key, column),
+                       plus the per-chunk rows (cid-ordered) so a reader
+                       can descend one level without touching payloads
+    bkt-XXXXXXXX.pyr   one merged row per (part key, column) covering a
+                       whole compacted bucket (``covers`` = the segment
+                       seqs it summarizes)
+
+plus per-object population sketches (top-k of per-series maxima and an
+HLL of part keys — ``memory/sketches.py``) that make ``topk`` and
+cardinality estimates summary-only scans under the approx lane.
+
+Pyramid objects are DERIVED data: best-effort, separately fetchable,
+never load-bearing for correctness.  A missing/corrupt/raced pyramid
+demotes the reader one level (bucket → segment → chunk rows → payload
+fallback) — the same exact/bypass algebra the sidecar lane uses.
+
+Determinism contract (bitwise parity of mode "1" vs mode "decode"):
+every merged row is ``merge_rows_seq`` — a strict left fold of the
+scalar merge — over count>0 chunk rows sorted by chunk id.  Chunk
+summaries are themselves bitwise-reproducible from lossless decode
+(``memory/chunk.py``), so a reader that recomputes the fold from
+decoded payloads reproduces the stored rows bit for bit.
+
+This module must not import ``objectstore`` (the store imports us);
+pyramid objects carry their own zlib CRC32 footer rather than reusing
+the store's CRC32C helper.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+import zlib
+
+import numpy as np
+
+from filodb_tpu.memory.chunk import (
+    S_COUNT,
+    S_FIRST_TS,
+    S_FIRST_VAL,
+    S_LAST_TS,
+    S_LAST_VAL,
+    S_MAX,
+    SKETCH_BUCKETS,
+    STATS_WIDTH,
+    ensure_summary,
+)
+from filodb_tpu.memory.sketches import HLLSketch, TopKSketch, _hash64
+from filodb_tpu.utils.metrics import Counter
+
+# metric families asserted by tests/test_metrics_scrape.py and covered by
+# filolint PR207 (every exposed filodb_pyramid_* family must be pinned)
+PYR_WRITTEN_SEG = Counter("filodb_pyramid_objects_written",
+                          {"level": "segment"},
+                          help="segment pyramid objects written")
+PYR_WRITTEN_BKT = Counter("filodb_pyramid_objects_written",
+                          {"level": "bucket"},
+                          help="bucket pyramid objects written")
+PYR_BACKFILLED = Counter(
+    "filodb_pyramid_backfilled",
+    help="legacy segments that gained pyramid coverage via compaction")
+PYR_SERVED = Counter(
+    "filodb_pyramid_served",
+    help="cold-tier leaf evaluations served from pyramid aggregates")
+PYR_FALLBACK = Counter(
+    "filodb_pyramid_fallback",
+    help="pyramid reads demoted to chunk-payload fallback")
+PYR_NODES_BUCKET = Counter("filodb_pyramid_nodes", {"level": "bucket"})
+PYR_NODES_SEGMENT = Counter("filodb_pyramid_nodes", {"level": "segment"})
+PYR_NODES_CHUNK = Counter("filodb_pyramid_nodes", {"level": "chunk"})
+PYR_NODES_DECODE = Counter("filodb_pyramid_nodes", {"level": "decode"})
+PYR_BYTES_DOWN = Counter(
+    "filodb_pyramid_bytes_down",
+    help="bytes of pyramid objects fetched from the object store")
+
+_MAGIC_SEG = b"FPY1"
+_MAGIC_BKT = b"FPB1"
+_ENT_HDR = struct.Struct("<HBBI")  # pk_len, col, flags, n_chunk_rows
+_F_SKETCH = 1
+
+
+# ---------------------------------------------------------------------------
+# merge algebra (scalar-row analog of sidecar_lane._merge_vec)
+
+def _merge_row(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Merge two consecutive-in-time count>0 stats rows [STATS_WIDTH]
+    with the kernels' counter-reset carry at the boundary."""
+    from filodb_tpu.memory.chunk import (S_CHANGES, S_CORR, S_MIN, S_RESETS,
+                                         S_SUM, S_SUMSQ)
+    out = a.copy()
+    out[S_COUNT] = a[S_COUNT] + b[S_COUNT]
+    out[S_SUM] = a[S_SUM] + b[S_SUM]
+    out[S_SUMSQ] = a[S_SUMSQ] + b[S_SUMSQ]
+    out[S_MIN] = min(a[S_MIN], b[S_MIN])
+    out[S_MAX] = max(a[S_MAX], b[S_MAX])
+    out[S_LAST_TS] = b[S_LAST_TS]
+    out[S_LAST_VAL] = b[S_LAST_VAL]
+    bdrop = b[S_FIRST_VAL] < a[S_LAST_VAL]
+    out[S_RESETS] = a[S_RESETS] + bdrop + b[S_RESETS]
+    out[S_CORR] = (a[S_CORR] + (a[S_LAST_VAL] if bdrop else 0.0)) \
+        + b[S_CORR]
+    out[S_CHANGES] = a[S_CHANGES] \
+        + (b[S_FIRST_VAL] != a[S_LAST_VAL]) + b[S_CHANGES]
+    return out
+
+
+def merge_rows_seq(rows) -> np.ndarray | None:
+    """Strict left fold of ``_merge_row`` over count>0 rows (callers pass
+    rows cid-sorted).  The SAME fold runs at write time and in decode
+    mode, so stored parent rows are bitwise-reproducible.  Returns None
+    when no row has samples."""
+    acc = None
+    for r in rows:
+        if r[S_COUNT] <= 0:
+            continue
+        acc = r.copy() if acc is None else _merge_row(acc, r)
+    return acc
+
+
+def _rows_ordered(rows: np.ndarray) -> bool:
+    """Exactness precondition for folding rows as consecutive segments:
+    count>0 rows (already cid-sorted) must be time-ordered and
+    non-overlapping by valid-sample span."""
+    live = rows[rows[:, S_COUNT] > 0]
+    if len(live) < 2:
+        return True
+    starts = live[:, S_FIRST_TS]
+    ends = live[:, S_LAST_TS]
+    return not (np.any(np.diff(starts) <= 0)
+                or np.any(starts[1:] <= ends[:-1]))
+
+
+# ---------------------------------------------------------------------------
+# build (writer side: _seal and compaction hand us the sealed rows)
+
+def _collect(pyr_rows, value_col: int = 1):
+    """Group sealed ``(pk_blob, chunk)`` rows into per-(pk, col) chunk
+    stats + sketches, cid-sorted.  Chunks without a usable summary for a
+    column poison that (pk, col) entry — readers fall back to payloads
+    there rather than trusting a partial roll-up."""
+    groups: dict[tuple[bytes, int], dict] = {}
+    n_chunks: dict[bytes, int] = {}
+    for pk_blob, ch in pyr_rows:
+        n_chunks[pk_blob] = n_chunks.get(pk_blob, 0) + 1
+        summary = ensure_summary(ch)
+        ncols = len(summary) if summary is not None else 0
+        for col in range(1, ncols):
+            cs = summary[col]
+            if cs is None:
+                continue
+            g = groups.setdefault((pk_blob, col),
+                                  {"cids": [], "rows": [], "sketches": []})
+            g["cids"].append(ch.id)
+            g["rows"].append(cs.stats)
+            g["sketches"].append(cs.sketch)
+    out = {}
+    for (pk_blob, col), g in groups.items():
+        if len(g["cids"]) != n_chunks[pk_blob]:
+            continue  # partial summary coverage: demote to payloads
+        order = np.argsort(np.asarray(g["cids"], np.int64), kind="stable")
+        cids = np.asarray(g["cids"], np.int64)[order]
+        rows = np.vstack([g["rows"][i] for i in order])
+        sketches = [g["sketches"][i] for i in order]
+        if not _rows_ordered(rows):
+            continue  # out-of-order seals: reader uses payload fallback
+        merged = merge_rows_seq(rows)
+        if merged is None:
+            continue
+        sk = None
+        if all(s is not None for s in sketches):
+            sk = np.zeros(SKETCH_BUCKETS, np.int64)
+            for s, row in zip(sketches, rows):
+                if row[S_COUNT] > 0:
+                    sk += s.astype(np.int64)
+        out[(pk_blob, col)] = (cids, rows, merged, sk)
+    return out
+
+
+def _footer_sketches(entries, value_col: int = 1) -> tuple:
+    """(TopKSketch over per-series maxima of the value column, HLL over
+    part keys) for one pyramid object."""
+    topk = TopKSketch(capacity=64)
+    hll = HLLSketch()
+    for (pk_blob, col), (_cids, _rows, merged, _sk) in entries.items():
+        if col != value_col:
+            continue
+        hll.update_hashes(np.array([_hash64(pk_blob)], np.uint64))
+        topk.update(pk_blob, float(merged[S_MAX]))
+    return topk, hll
+
+
+def _pack_entries(entries, with_chunk_rows: bool) -> list[bytes]:
+    parts = [struct.pack("<I", len(entries))]
+    for (pk_blob, col) in sorted(entries):
+        cids, rows, merged, sk = entries[(pk_blob, col)]
+        flags = _F_SKETCH if sk is not None else 0
+        n = len(cids)
+        parts.append(_ENT_HDR.pack(len(pk_blob), col, flags, n))
+        parts.append(pk_blob)
+        parts.append(cids.astype("<i8").tobytes())
+        if with_chunk_rows:
+            parts.append(rows.astype("<f8").tobytes())
+        parts.append(merged.astype("<f8").tobytes())
+        if sk is not None:
+            parts.append(sk.astype("<i8").tobytes())
+    return parts
+
+
+def _pack_footer(topk: TopKSketch, hll: HLLSketch) -> list[bytes]:
+    tb = topk.serialize()
+    return [struct.pack("<I", len(tb)), tb, hll.serialize()]
+
+
+def build_segment_pyramid(pyr_rows, value_col: int = 1) -> bytes | None:
+    """Serialize one segment's pyramid object from its sealed
+    ``(pk_blob, chunk)`` rows; None when nothing is summarizable."""
+    entries = _collect(pyr_rows, value_col)
+    if not entries:
+        return None
+    topk, hll = _footer_sketches(entries, value_col)
+    body = b"".join([_MAGIC_SEG] + _pack_entries(entries, True)
+                    + _pack_footer(topk, hll))
+    PYR_WRITTEN_SEG.inc()
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+def build_bucket_pyramid(pyr_rows, covers, value_col: int = 1
+                         ) -> bytes | None:
+    """Serialize a bucket-level pyramid covering segment seqs ``covers``
+    (compaction collapses a bucket to one segment, so the per-(pk, col)
+    merged rows ARE the new segment's rows — stored without the chunk
+    rows, one level terser)."""
+    entries = _collect(pyr_rows, value_col)
+    if not entries:
+        return None
+    topk, hll = _footer_sketches(entries, value_col)
+    head = [_MAGIC_BKT, struct.pack("<I", len(covers))]
+    head.append(np.asarray(sorted(covers), "<i8").tobytes())
+    body = b"".join(head + _pack_entries(entries, False)
+                    + _pack_footer(topk, hll))
+    PYR_WRITTEN_BKT.inc()
+    return body + struct.pack("<I", zlib.crc32(body))
+
+
+# ---------------------------------------------------------------------------
+# parse (reader side)
+
+class PyramidParseError(Exception):
+    """A pyramid object failed its CRC or structure checks — readers
+    demote to the next level down, never error the query."""
+
+
+def _parse_common(data: bytes, magic: bytes, key: str):
+    if len(data) < len(magic) + 4 or data[:4] != magic:
+        raise PyramidParseError(f"{key}: bad magic/size")
+    (crc,) = struct.unpack_from("<I", data, len(data) - 4)
+    body = data[:-4]
+    if zlib.crc32(body) != crc:
+        raise PyramidParseError(f"{key}: CRC32 mismatch")
+    return body
+
+
+def _unpack_entries(body: bytes, off: int, with_chunk_rows: bool):
+    (n_entries,) = struct.unpack_from("<I", body, off)
+    off += 4
+    entries: dict[tuple[bytes, int], dict] = {}
+    for _ in range(n_entries):
+        pk_len, col, flags, n = _ENT_HDR.unpack_from(body, off)
+        off += _ENT_HDR.size
+        pk_blob = bytes(body[off:off + pk_len])
+        off += pk_len
+        cids = np.frombuffer(body, "<i8", n, off).copy()
+        off += 8 * n
+        rows = None
+        if with_chunk_rows:
+            rows = np.frombuffer(body, "<f8", n * STATS_WIDTH,
+                                 off).reshape(n, STATS_WIDTH).copy()
+            off += 8 * n * STATS_WIDTH
+        merged = np.frombuffer(body, "<f8", STATS_WIDTH, off).copy()
+        off += 8 * STATS_WIDTH
+        sk = None
+        if flags & _F_SKETCH:
+            sk = np.frombuffer(body, "<i8", SKETCH_BUCKETS, off).copy()
+            off += 8 * SKETCH_BUCKETS
+        entries[(pk_blob, int(col))] = {
+            "cids": cids, "rows": rows, "row": merged, "sketch": sk}
+    return entries, off
+
+
+def _unpack_footer(body: bytes, off: int):
+    (tlen,) = struct.unpack_from("<I", body, off)
+    off += 4
+    topk, _ = TopKSketch.deserialize(body[off:off + tlen])
+    off += tlen
+    hll, _ = HLLSketch.deserialize(body, off)
+    return topk, hll
+
+
+def parse_segment_pyramid(data: bytes, key: str = "?") -> dict:
+    """{"entries": {(pk_blob, col): {cids, rows, row, sketch}},
+    "topk", "hll"}.  Raises :class:`PyramidParseError` on mismatch."""
+    body = _parse_common(data, _MAGIC_SEG, key)
+    try:
+        entries, off = _unpack_entries(body, 4, True)
+        topk, hll = _unpack_footer(body, off)
+    except (struct.error, ValueError) as e:
+        raise PyramidParseError(f"{key}: truncated: {e}") from None
+    return {"entries": entries, "topk": topk, "hll": hll}
+
+
+def parse_bucket_pyramid(data: bytes, key: str = "?") -> dict:
+    """Like :func:`parse_segment_pyramid` plus ``covers`` (segment seqs
+    the bucket row summarizes); entries carry no per-chunk rows."""
+    body = _parse_common(data, _MAGIC_BKT, key)
+    try:
+        (n_cov,) = struct.unpack_from("<I", body, 4)
+        off = 8
+        covers = [int(c) for c in np.frombuffer(body, "<i8", n_cov, off)]
+        off += 8 * n_cov
+        entries, off = _unpack_entries(body, off, False)
+        topk, hll = _unpack_footer(body, off)
+    except (struct.error, ValueError) as e:
+        raise PyramidParseError(f"{key}: truncated: {e}") from None
+    return {"entries": entries, "topk": topk, "hll": hll,
+            "covers": covers}
+
+
+# ---------------------------------------------------------------------------
+# per-shard read-through cache
+
+_NEG_TTL_S = 5.0
+
+
+class ShardPyramidCache:
+    """Read-through cache over one shard's pyramid objects.  Parsed
+    positives are immutable (pyramid keys are never rewritten in place)
+    and cached forever; negatives (not-yet-uploaded, mid-backfill) age
+    out after a short TTL so the read-race window self-heals."""
+
+    def __init__(self, store, dataset: str, shard: int):
+        self.store = store
+        self.dataset = dataset
+        self.shard = shard
+        self._segs: dict[int, dict] = {}
+        self._buckets: dict[int, dict] = {}
+        self._neg: dict = {}
+        # read-cache accounting: the pyramid lane folds deltas of these
+        # into QueryStats.cache_hits/misses (the cold-tier analog of the
+        # leaf batch cache)
+        self.hits = 0
+        self.misses = 0
+
+    def _negative(self, key) -> bool:
+        t = self._neg.get(key)
+        return t is not None and time.monotonic() - t < _NEG_TTL_S
+
+    def refs(self, part_key):
+        return self.store.pyramid_refs(self.dataset, self.shard, part_key)
+
+    def segment(self, seq: int) -> dict | None:
+        p = self._segs.get(seq)
+        if p is not None:
+            self.hits += 1
+            return p
+        if self._negative(("s", seq)):
+            return None
+        self.misses += 1
+        p = self.store.read_segment_pyramid(self.dataset, self.shard, seq)
+        if p is None:
+            self._neg[("s", seq)] = time.monotonic()
+            return None
+        self._segs[seq] = p
+        return p
+
+    def bucket(self, bkt: int, seq: int) -> dict | None:
+        """``seq`` is the bucket pyramid's writing segment seq (from the
+        shard's ``bucket_pyramids`` index) — compaction rewrites bucket
+        objects under new seqs, so the cache keys on it."""
+        p = self._buckets.get((bkt, seq))
+        if p is not None:
+            self.hits += 1
+            return p
+        if self._negative(("b", bkt, seq)):
+            return None
+        self.misses += 1
+        p = self.store.read_bucket_pyramid(self.dataset, self.shard, bkt)
+        if p is None:
+            self._neg[("b", bkt, seq)] = time.monotonic()
+            return None
+        self._buckets[(bkt, seq)] = p
+        return p
+
+    def clear(self) -> None:
+        self._segs.clear()
+        self._buckets.clear()
+        self._neg.clear()
+
+
+def make_pyramid_cache(store, dataset: str, shard: int
+                       ) -> ShardPyramidCache | None:
+    """A pyramid cache for stores that publish the pyramid read API
+    (``ObjectStoreColumnStore``); None for backends without one —
+    callers then bypass to the payload path."""
+    if not hasattr(store, "read_segment_pyramid"):
+        return None
+    return ShardPyramidCache(store, dataset, shard)

@@ -73,6 +73,12 @@ def _stats_json(result: QueryResult, full: bool = False) -> dict:
                 tier: {k: (round(v, 3) if isinstance(v, float) else v)
                        for k, v in bucket.items()}
                 for tier, bucket in s.tiers.items()}
+        if s.pyramid:
+            # cold folds served from stored aggregate levels
+            # (query/engine/pyramid_lane.py)
+            out["pyramid"] = {
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in s.pyramid.items()}
     return out
 
 

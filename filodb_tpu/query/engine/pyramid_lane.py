@@ -1,0 +1,509 @@
+"""Pyramid-served cold-tier evaluation: O(log) range folds over stored
+aggregate levels, zero chunk-payload paging for covered interiors.
+
+The sidecar lane (``sidecar_lane.py``) folds WARM partitions from
+chunk-level summaries.  This module is its cold-tier twin: for
+:class:`~filodb_tpu.query.federation.ColdPartition` leaves backed by an
+object store that publishes pyramid objects (``core/store/pyramid.py``),
+each partition's history becomes an ordered list of summary NODES
+
+    bucket node    one row covering a whole compacted bucket
+    segment node   one row per segment, children = per-chunk rows
+    chunk node     one row from a segment pyramid entry (no payload)
+    decode node    payload fallback: the chunk is demand-paged and its
+                   summary (re)computed — the read-race / legacy path
+
+and every window folds top-down: ``_interior_fold`` over the node rows
+covers the window interior from whichever level spans it, while the (at
+most two) boundary nodes DESCEND one level — bucket → segments → chunks
+→ a single demand-paged edge decode.  A year-long ``query_range`` thus
+folds O(log) stored aggregates and downloads zero chunk payload bytes
+when the grid aligns with chunk seams (asserted against
+``filodb_objectstore_payload_bytes_down_total``).
+
+Exact/bypass algebra is inherited unchanged: anything inexact — missing
+pyramid (mid-backfill race), partial summary coverage, out-of-order
+spans — demotes ONE level, bottoming out at the decode lane via
+``_Bypass``; results are bitwise identical between mode ``1`` (stored
+rows) and mode ``decode`` (every row recomputed from decoded payloads,
+same tree shape) because both run the same strict-left-fold merge
+(``pyramid.merge_rows_seq``) over cid-sorted chunk rows.
+
+``quantile_over_time`` is served from segment/bucket log2 sketches under
+``FILODB_SIDECAR_APPROX=1`` only (declared approximation).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from filodb_tpu.core.schemas import ColumnType
+from filodb_tpu.core.store import pyramid as pyr
+from filodb_tpu.core.store.localstore import _pk_blob
+from filodb_tpu.memory.chunk import (
+    S_COUNT,
+    S_FIRST_TS,
+    S_LAST_TS,
+    STATS_WIDTH,
+    ensure_summary,
+    summarize_values,
+)
+from filodb_tpu.query.engine import sidecar_lane as sl
+from filodb_tpu.utils.tracing import span
+
+_SCALAR_CTYPES = (ColumnType.DOUBLE, ColumnType.LONG, ColumnType.INT)
+
+
+class _Node:
+    """One summary node in a partition's cold-history tree."""
+
+    __slots__ = ("level", "row", "start", "end", "children", "ref",
+                 "chunk", "sketch", "n_chunks", "seq")
+
+    def __init__(self, level, row, children=None, ref=None, chunk=None,
+                 sketch=None, n_chunks=1):
+        self.level = level          # bucket | segment | chunk | decode
+        self.row = row              # [STATS_WIDTH] float64, count > 0
+        self.start = int(row[S_FIRST_TS])
+        self.end = int(row[S_LAST_TS])
+        self.children = children    # next level down (None for leaves)
+        self.ref = ref              # _ChunkRef for leaf payload paging
+        self.chunk = chunk          # already-paged Chunk, if any
+        self.sketch = sketch        # int64 log2 histogram or None
+        self.n_chunks = n_chunks    # chunk-equivalents this node covers
+        self.seq = None             # owning segment seq (segment nodes)
+
+
+def _zero_rows(W: int) -> np.ndarray:
+    out = np.zeros((W, STATS_WIDTH), np.float64)
+    out[:, sl.S_MIN:sl.S_LAST_VAL + 1] = np.nan
+    return out
+
+
+class _NodeBundle:
+    """Duck-typed ``_ChunkBundle`` surface for ``_interior_fold``."""
+
+    __slots__ = ("starts", "ends", "stats")
+
+    def __init__(self, nodes):
+        self.starts = np.array([n.start for n in nodes], np.int64)
+        self.ends = np.array([n.end for n in nodes], np.int64)
+        self.stats = np.vstack([n.row for n in nodes])
+
+
+# ---------------------------------------------------------------------------
+# payload paging (the ONLY place this lane downloads chunk bytes)
+
+def _page_chunk(shard, p, ref, acc):
+    """Demand-page exactly one chunk by its ref (non-overlapping raw
+    spans make a point lookup at start_time unambiguous)."""
+    for lo, hi in ((ref.start_time, ref.start_time),
+                   (ref.start_time, ref.end_time)):
+        for ch in shard.odp_cache.get_or_load(shard, p, lo, hi):
+            if ch.id == ref.chunk_id:
+                acc.setdefault("_decoded_ids", set()).add(ref.chunk_id)
+                return ch
+    raise sl._Bypass
+
+
+def _node_chunk(n: _Node, shard, p, acc):
+    if n.chunk is None:
+        n.chunk = _page_chunk(shard, p, n.ref, acc)
+    return n.chunk
+
+
+def _chunk_row(ch, col: int, decode_mode: bool):
+    """(stats row, uint16 sketch) of one paged chunk — stored summary in
+    mode 1, recomputed from the decoded vectors in decode mode."""
+    if decode_mode:
+        cs = summarize_values(np.asarray(ch.decode_column(0), np.int64),
+                              np.asarray(ch.decode_column(col), np.float64))
+        return cs.stats, cs.sketch
+    summary = ensure_summary(ch)
+    cs = summary[col] if summary is not None and col < len(summary) else None
+    if cs is None:
+        raise sl._Bypass
+    return cs.stats, cs.sketch
+
+
+# ---------------------------------------------------------------------------
+# node-tree construction
+
+def _decode_nodes(refs, col, shard, p, decode_mode, acc) -> list[_Node]:
+    """Payload-fallback leaves: page each chunk and summarize it."""
+    out = []
+    for ref in refs:
+        ch = _page_chunk(shard, p, ref, acc)
+        row, sk = _chunk_row(ch, col, decode_mode)
+        if row[S_COUNT] > 0:
+            out.append(_Node("decode", row, ref=ref, chunk=ch,
+                             sketch=None if sk is None
+                             else sk.astype(np.int64)))
+    return out
+
+
+def _entry_chunk_nodes(entry, idxs, rr, col, shard, p, decode_mode,
+                       acc) -> list[_Node]:
+    """Chunk-level nodes straight from a segment pyramid entry's rows —
+    zero payload bytes in mode 1; decode mode recomputes each row."""
+    out = []
+    for i, ref in zip(idxs, rr):
+        if decode_mode:
+            ch = _page_chunk(shard, p, ref, acc)
+            row, _sk = _chunk_row(ch, col, True)
+        else:
+            ch = None
+            row = entry["rows"][i]
+        if row[S_COUNT] > 0:
+            out.append(_Node("chunk", row, ref=ref, chunk=ch))
+    return out
+
+
+def _seg_node(entry, rr, col, shard, p, decode_mode, acc) -> list[_Node]:
+    """One segment node whose children are the entry's chunk rows.  In
+    decode mode both levels are recomputed through the same
+    ``merge_rows_seq`` fold the writer ran — bitwise parity."""
+    children = _entry_chunk_nodes(entry, range(len(rr)), rr, col, shard,
+                                  p, decode_mode, acc)
+    if decode_mode:
+        row = pyr.merge_rows_seq([c.row for c in children])
+    else:
+        row = entry["row"]
+    if row is None or row[S_COUNT] <= 0:
+        return []
+    sk = entry.get("sketch")
+    return [_Node("segment", row, children=children, sketch=sk,
+                  n_chunks=len(children))]
+
+
+def _run_nodes(blob, col, seq, rr, single_run, cache, seg_set, shard, p,
+               decode_mode, acc) -> list[_Node]:
+    """Nodes for one cid-contiguous run of refs in segment ``seq``,
+    demoting level by level when the pyramid can't cover the run."""
+    if seq in seg_set:
+        sp = cache.segment(seq)
+        if sp is not None:
+            entry = sp["entries"].get((blob, col))
+            if entry is not None:
+                ecids = entry["cids"]
+                rcids = np.array([r.chunk_id for r in rr], np.int64)
+                if single_run and len(ecids) == len(rcids) \
+                        and np.array_equal(ecids, rcids):
+                    return _seg_node(entry, rr, col, shard, p,
+                                     decode_mode, acc)
+                # interleaved/partial run: the merged segment row is
+                # unusable but the per-chunk rows still are
+                idx = {int(c): i for i, c in enumerate(ecids)}
+                out = []
+                for ref in rr:
+                    i = idx.get(ref.chunk_id)
+                    if i is None:
+                        out.extend(_decode_nodes([ref], col, shard, p,
+                                                 decode_mode, acc))
+                    else:
+                        out.extend(_entry_chunk_nodes(
+                            entry, [i], [ref], col, shard, p,
+                            decode_mode, acc))
+                return out
+    pyr.PYR_FALLBACK.inc()
+    return _decode_nodes(rr, col, shard, p, decode_mode, acc)
+
+
+def _wrap_bucket(nodes, blob, col, bucket_info, cache,
+                 decode_mode) -> list[_Node]:
+    """Collapse the contiguous segment-node run covered by the bucket
+    pyramid into one bucket node (children = those segment nodes)."""
+    bp = cache.bucket(int(bucket_info["bucket"]), int(bucket_info["seq"]))
+    if bp is None:
+        return nodes
+    entry = bp["entries"].get((blob, col))
+    if entry is None:
+        return nodes
+    covers = list(bp["covers"])
+    # the covered segment nodes must be contiguous and complete
+    run: list[int] = []
+    for i, n in enumerate(nodes):
+        if n.level == "segment" and n.seq in covers:
+            run.append(i)
+    if not run or run != list(range(run[0], run[-1] + 1)):
+        return nodes
+    segs = [nodes[i] for i in run]
+    if sorted(s.seq for s in segs) != sorted(covers):
+        return nodes
+    child_cids = np.concatenate(
+        [[c.ref.chunk_id for c in s.children] for s in segs]) \
+        if segs else np.zeros(0, np.int64)
+    if len(child_cids) != len(entry["cids"]) \
+            or not np.array_equal(np.sort(np.asarray(child_cids, np.int64)),
+                                  np.sort(entry["cids"])):
+        return nodes
+    if decode_mode:
+        row = pyr.merge_rows_seq([s.row for s in segs])
+    else:
+        row = entry["row"]
+    if row is None or row[S_COUNT] <= 0:
+        return nodes
+    bnode = _Node("bucket", row, children=segs, sketch=entry.get("sketch"),
+                  n_chunks=sum(s.n_chunks for s in segs))
+    return nodes[:run[0]] + [bnode] + nodes[run[-1] + 1:]
+
+
+def _partition_nodes(p, col, shard, decode_mode, acc) -> list[_Node]:
+    cache = shard.pyramids
+    blob = _pk_blob(p.part_key)
+    refs, seg_set, bucket_info = cache.refs(p.part_key)
+    if not refs:
+        return []
+    runs: list[tuple[int, list]] = []
+    for r in refs:
+        if runs and runs[-1][0] == r.seq:
+            runs[-1][1].append(r)
+        else:
+            runs.append((r.seq, [r]))
+    run_count: dict[int, int] = {}
+    for seq, _ in runs:
+        run_count[seq] = run_count.get(seq, 0) + 1
+    nodes: list[_Node] = []
+    for seq, rr in runs:
+        new = _run_nodes(blob, col, seq, rr, run_count[seq] == 1, cache,
+                         seg_set, shard, p, decode_mode, acc)
+        for n in new:
+            if n.level == "segment":
+                n.seq = seq
+        nodes.extend(new)
+    if bucket_info is not None:
+        nodes = _wrap_bucket(nodes, blob, col, bucket_info, cache,
+                             decode_mode)
+    # exactness precondition, same as _part_bundle: valid-sample spans
+    # strictly ordered and non-overlapping across the node list
+    if len(nodes) > 1:
+        starts = np.array([n.start for n in nodes], np.int64)
+        ends = np.array([n.end for n in nodes], np.int64)
+        if np.any(np.diff(starts) <= 0) or np.any(starts[1:] <= ends[:-1]):
+            pyr.PYR_FALLBACK.inc()
+            return _fallback_nodes(p, col, shard, refs, decode_mode, acc)
+    return nodes
+
+
+def _fallback_nodes(p, col, shard, refs, decode_mode, acc) -> list[_Node]:
+    """Whole-partition payload fallback (disordered pyramid spans): every
+    chunk becomes a decode node; a second disorder here bypasses."""
+    nodes = _decode_nodes(refs, col, shard, p, decode_mode, acc)
+    if len(nodes) > 1:
+        starts = np.array([n.start for n in nodes], np.int64)
+        ends = np.array([n.end for n in nodes], np.int64)
+        if np.any(np.diff(starts) <= 0) or np.any(starts[1:] <= ends[:-1]):
+            raise sl._Bypass
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# top-down window fold
+
+def _edge_node_stats(nodes, col, edge_idx, t0s, t1s, shard, p,
+                     decode_mode, acc) -> np.ndarray:
+    W = len(edge_idx)
+    out = _zero_rows(W)
+    for c in np.unique(edge_idx[edge_idx >= 0]):
+        k = np.flatnonzero(edge_idx == c)
+        n = nodes[c]
+        if n.children is not None:
+            # descend one level: the seam windows recurse into the
+            # node's children, bottoming out at single edge decodes
+            out[k] = _fold_nodes(n.children, col, t0s[k], t1s[k], shard,
+                                 p, decode_mode, acc)
+        else:
+            ch = _node_chunk(n, shard, p, acc)
+            fa = sl._chunk_fa(ch, col)
+            out[k] = sl._fold_windows(fa, t0s[k], t1s[k])
+    return out
+
+
+def _fold_nodes(nodes, col, t0s, t1s, shard, p, decode_mode,
+                acc) -> np.ndarray:
+    """Merged stats rows [W, 12] for windows (t0, t1] over a node list —
+    the node-level analog of ``eval_partition_windows`` minus the write
+    buffer (cold history has none)."""
+    W = len(t0s)
+    if not nodes:
+        return _zero_rows(W)
+    bundle = _NodeBundle(nodes)
+    interior, i0, i1 = sl._interior_fold(bundle, t0s, t1s)
+    # per-level accounting for interior-covered nodes (union over
+    # windows via a diff array — windows overlap heavily on dense grids)
+    diff = np.zeros(len(nodes) + 1, np.int64)
+    np.add.at(diff, i0, 1)
+    np.add.at(diff, i1, -1)
+    for idx in np.flatnonzero(np.cumsum(diff[:-1]) > 0):
+        n = nodes[idx]
+        if n.level != "decode":
+            acc["nodes_" + n.level] = acc.get("nodes_" + n.level, 0) + 1
+            acc["sidecar_chunks"] = acc.get("sidecar_chunks", 0) \
+                + n.n_chunks
+    o0 = np.searchsorted(bundle.ends, t0s, side="right")
+    left = np.where(o0 < i0, o0, -1)
+    re_idx = np.searchsorted(bundle.starts, t1s, side="right") - 1
+    N = len(nodes)
+    right = np.where((re_idx >= i1) & (re_idx >= 0) & (re_idx < N)
+                     & (re_idx != left), re_idx, -1)
+    lstats = _edge_node_stats(nodes, col, left, t0s, t1s, shard, p,
+                              decode_mode, acc)
+    rstats = _edge_node_stats(nodes, col, right, t0s, t1s, shard, p,
+                              decode_mode, acc)
+    return sl._merge_vec(sl._merge_vec(lstats, interior), rstats)
+
+
+# ---------------------------------------------------------------------------
+# approximate quantile over node sketches
+
+def _leaf_nodes(n: _Node):
+    if n.children is None:
+        yield n
+    else:
+        for c in n.children:
+            yield from _leaf_nodes(c)
+
+
+def _node_sketch(n: _Node, col, shard, p, acc) -> np.ndarray:
+    """int64 log2 sketch of ALL the node's samples, paging the payload
+    only for chunk-level nodes that carry none."""
+    if n.sketch is not None:
+        return n.sketch
+    ch = _node_chunk(n, shard, p, acc)
+    _row, sk = _chunk_row(ch, col, False)
+    if sk is None:
+        raise sl._Bypass
+    n.sketch = sk.astype(np.int64)
+    return n.sketch
+
+
+def _eval_cold_quantile(sparts, col, q, t0s, t1s, shard, decode_mode,
+                        acc) -> np.ndarray:
+    from filodb_tpu.memory.chunk import SKETCH_BUCKETS, _sketch_values
+    from filodb_tpu.query.engine.aggregations import sketch_quantile
+    P, W = len(sparts), len(t0s)
+    gate = sl._sealed_gate()
+    if gate > 0 and P * W > gate:
+        raise sl._Bypass
+    out = np.full((P, W), np.nan)
+    samples = 0
+    for i, p in enumerate(sparts):
+        nodes = _partition_nodes(p, col, shard, decode_mode, acc)
+        if not nodes:
+            continue
+        bundle = _NodeBundle(nodes)
+        _interior, i0, i1 = sl._interior_fold(bundle, t0s, t1s)
+        for k in range(W):
+            sk = np.zeros(SKETCH_BUCKETS, np.int64)
+            total = 0
+            for c in range(i0[k], i1[k]):
+                sk += _node_sketch(nodes[c], col, shard, p, acc)
+                total += int(nodes[c].row[S_COUNT])
+            for c in list(range(min(i0[k], len(nodes)))) \
+                    + list(range(i1[k], len(nodes))):
+                n = nodes[c]
+                if n.end > t0s[k] and n.start <= t1s[k]:
+                    for leaf in _leaf_nodes(n):
+                        if leaf.end <= t0s[k] or leaf.start > t1s[k]:
+                            continue
+                        ch = _node_chunk(leaf, shard, p, acc)
+                        fa = sl._chunk_fa(ch, col)
+                        m = (fa.tv > t0s[k]) & (fa.tv <= t1s[k])
+                        sk += _sketch_values(fa.vv[m]).astype(np.int64)
+                        total += int(m.sum())
+            if total:
+                out[i, k] = sketch_quantile(q, sk)
+            samples += total
+    acc["samples"] = acc.get("samples", 0.0) + float(samples)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# entry point (called from sidecar_lane._execute for cold leaves)
+
+def execute_cold(plan, ctx, psm, fn, parts, shard, decode_mode: bool,
+                 approx: bool):
+    """Pyramid-served evaluation of one cold-tier leaf.  Raises
+    ``_Bypass`` (caught by ``try_execute``) when the backend publishes
+    no pyramids or the parts aren't cold-tier partitions."""
+    from filodb_tpu.core.store.objectstore import PAYLOAD_BYTES_DOWN
+    from filodb_tpu.query.exec.transformers import steps_array
+    from filodb_tpu.query.federation import ColdPartition
+    from filodb_tpu.query.model import StepMatrix
+
+    if getattr(shard, "pyramids", None) is None:
+        raise sl._Bypass
+    for p in parts:
+        if not isinstance(p, ColdPartition):
+            raise sl._Bypass
+    steps = steps_array(psm.start, psm.step, psm.end)
+    eval_steps = (steps - psm.offset).astype(np.int64)
+    window = int(psm.window if psm.function else 300_000)
+    t1s = np.minimum(eval_steps, int(plan.chunk_end))
+    t0s = np.maximum(eval_steps - window, int(plan.chunk_start) - 1)
+    by_schema: dict[str, list] = {}
+    for p in parts:
+        by_schema.setdefault(p.schema.name, []).append(p)
+    mats = []
+    acc: dict = {}
+    pyr_b0 = pyr.PYR_BYTES_DOWN.value
+    pay_b0 = PAYLOAD_BYTES_DOWN.value
+    hits0, miss0 = shard.pyramids.hits, shard.pyramids.misses
+    t_fold = time.perf_counter()
+    for schema_name, sparts in by_schema.items():
+        schema = sparts[0].schema
+        col = plan._value_col_index(schema)
+        if schema.data.columns[col].ctype not in _SCALAR_CTYPES:
+            raise sl._Bypass
+        counter = schema.data.columns[col].is_counter
+        with span("decode", schema=schema_name, partitions=len(sparts),
+                  pyramid=True):
+            if fn == "quantile_over_time":
+                out = _eval_cold_quantile(sparts, col,
+                                          float(psm.params[0]), t0s, t1s,
+                                          shard, decode_mode, acc)
+            else:
+                st = np.zeros((len(sparts), len(t0s), STATS_WIDTH),
+                              np.float64)
+                for i, p in enumerate(sparts):
+                    nodes = _partition_nodes(p, col, shard, decode_mode,
+                                             acc)
+                    st[i] = _fold_nodes(nodes, col, t0s, t1s, shard, p,
+                                        decode_mode, acc)
+                acc["samples"] = acc.get("samples", 0.0) \
+                    + float(st[:, :, S_COUNT].sum())
+                out = sl.formula(fn, st, eval_steps.astype(np.float64),
+                                 window, counter)
+        keys = [p.part_key.range_vector_key for p in sparts]
+        mats.append(StepMatrix(psm._out_keys(keys), out, steps))
+    data = StepMatrix.concat(mats) if len(mats) > 1 else mats[0]
+    decoded = len(acc.get("_decoded_ids", ()))
+    nb = acc.get("nodes_bucket", 0)
+    ns = acc.get("nodes_segment", 0)
+    nc = acc.get("nodes_chunk", 0)
+    ctx.stats.series_scanned += len(parts)
+    ctx.stats.samples_scanned += int(acc.get("samples", 0.0))
+    ctx.stats.sidecar_chunks += acc.get("sidecar_chunks", 0)
+    ctx.stats.chunks_touched += decoded + acc.get("sidecar_chunks", 0)
+    ctx.stats.decode_s += time.perf_counter() - t_fold
+    # the pyramid summary cache is this lane's read cache — its hit/miss
+    # ratio lands in the same counters the leaf batch cache feeds
+    ctx.stats.cache_hits += shard.pyramids.hits - hits0
+    ctx.stats.cache_misses += shard.pyramids.misses - miss0
+    # flat numeric attribution (merge_counts folds dicts key-wise)
+    pyr_bytes = max(0, pyr.PYR_BYTES_DOWN.value - pyr_b0)
+    pay_bytes = max(0, PAYLOAD_BYTES_DOWN.value - pay_b0)
+    for key, v in (("bucketNodes", nb), ("segmentNodes", ns),
+                   ("chunkNodes", nc), ("decodeNodes", decoded),
+                   ("pyramidBytes", pyr_bytes),
+                   ("payloadBytes", pay_bytes)):
+        ctx.stats.pyramid[key] = ctx.stats.pyramid.get(key, 0) + v
+    pyr.PYR_NODES_BUCKET.inc(nb)
+    pyr.PYR_NODES_SEGMENT.inc(ns)
+    pyr.PYR_NODES_CHUNK.inc(nc)
+    pyr.PYR_NODES_DECODE.inc(decoded)
+    pyr.PYR_SERVED.inc()
+    sl.SIDECAR_SERVED.inc()
+    return data

@@ -94,17 +94,19 @@ def approx_enabled() -> bool:
 
 def _sealed_gate() -> int:
     """Amortization choke point for the sealed-chunk fold. The buffer
-    tier folds in one batched C call regardless of partition count, but
-    each partition whose window overlaps SEALED chunks pays a fixed
-    Python/numpy cost (edge folds + segment merges, ~0.3ms). The decode
-    lane amortizes the same work across all steps with one vectorized
-    batch, so past ``sealed_partitions * windows > gate`` the fold loses
-    regardless of how many samples it skips, and the lane bypasses.
+    tier folds in one batched C call regardless of partition count, and
+    since the flat-batch fold (``_eval_sealed_batch``) the sealed
+    interiors do too — the remaining per-partition Python cost is edge
+    decodes only. The decode lane still amortizes better once
+    ``sealed_partitions * windows`` dwarfs the samples skipped, so the
+    gate survives, but 16x wider than the PR 15 per-partition fold
+    needed (measured: gated_scan_small_chunks in benchmarks/sidecars.py
+    stays ahead of decode through 64k partition-windows).
     0 disables the gate (always serve)."""
     try:
-        return int(os.environ.get("FILODB_SIDECAR_SEALED_GATE", "4096"))
+        return int(os.environ.get("FILODB_SIDECAR_SEALED_GATE", "65536"))
     except ValueError:
-        return 4096
+        return 65536
 
 
 # Below this many sealed partition-windows the fold's fixed overhead is
@@ -602,10 +604,15 @@ def _execute(plan, ctx, psm, fn, decode_mode: bool, approx: bool):
     parts = [p for p in parts if p is not None]
     if not parts:
         raise _Bypass  # let the decode lane produce the canonical empty
-    for p in parts:
-        if type(p) is not TimeSeriesPartition \
-                and type(p) is not NativeBackedPartition:
-            raise _Bypass  # paged shells / duck-typed tier partitions
+    if any(type(p) is not TimeSeriesPartition
+           and type(p) is not NativeBackedPartition for p in parts):
+        # not warm memstore partitions: cold-tier leaves route to the
+        # pyramid lane (stored segment/bucket aggregates, zero payload
+        # paging); anything else — paged shells, duck-typed tier
+        # partitions, backends without pyramids — bypasses inside it
+        from filodb_tpu.query.engine import pyramid_lane
+        return pyramid_lane.execute_cold(plan, ctx, psm, fn, parts,
+                                         shard, decode_mode, approx)
     if getattr(cfg, "demand_paging_enabled", False):
         # the decode lane would pull cold chunks for partitions whose
         # resident data doesn't reach the query start — those windows
@@ -721,13 +728,156 @@ def _eval_group_stats(sparts, col: int, t0s, t1s, decode_mode: bool,
             sealed_overlap[i] = bool(flags[j] & 2)
     if not _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W):
         raise _Bypass  # sealed fold wouldn't amortize — decode lane wins
+    sealed_idx = []
     for i, p in enumerate(sparts):
         if sealed_overlap[i]:
-            st[i] = eval_partition_windows(p, col, t0s, t1s, buf_rows[i],
-                                           decode_mode, stats_acc)
+            sealed_idx.append(i)
         else:
             st[i] = buf_rows[i]
+    if sealed_idx:
+        _eval_sealed_batch(sparts, sealed_idx, col, st, t0s, t1s,
+                           buf_rows, decode_mode, stats_acc)
     return st
+
+
+def _eval_sealed_batch(sparts, sealed_idx, col: int, st, t0s, t1s,
+                       buf_rows, decode_mode: bool, stats_acc: dict):
+    """Batched sealed fold: ONE flat interior fold across every sealed
+    partition in the group, in place of a per-partition
+    ``eval_partition_windows`` call.
+
+    All partitions' kept chunk rows concatenate into one [Ctot, 12]
+    array; per-partition searchsorted becomes one composite-key
+    searchsorted (``pidx * span + (t - lo)`` — blocks are disjoint in
+    key space, so the flat result is the block-local result plus the
+    block offset), and the window sums become global-prefix-sum
+    differences.  Only edge chunks (decoded slices) and chunkless
+    partitions stay on per-partition code.  This is what moved the
+    ``FILODB_SIDECAR_SEALED_GATE`` default from 4096 to 65536: the
+    per-partition fixed cost the gate amortizes is now one numpy
+    dispatch per GROUP, not per partition."""
+    W = len(t0s)
+    bundles, rows_idx = [], []
+    for i in sealed_idx:
+        b = _part_bundle(sparts[i], col, decode_mode)
+        if len(b.starts) == 0:
+            st[i] = buf_rows[i]
+        else:
+            bundles.append(b)
+            rows_idx.append(i)
+    S = len(bundles)
+    if S == 0:
+        return
+    Cs = np.array([len(b.starts) for b in bundles], np.int64)
+    offs = np.zeros(S + 1, np.int64)
+    np.cumsum(Cs, out=offs[1:])
+    Ctot = int(offs[-1])
+    fstats = np.vstack([b.stats for b in bundles])
+    fstarts = np.concatenate([b.starts for b in bundles])
+    fends = np.concatenate([b.ends for b in bundles])
+    # composite keys: disjoint per-partition blocks on a shared time axis
+    lo = min(int(fstarts.min()), int(t0s.min()), int(t1s.min()))
+    hi = max(int(fends.max()), int(t0s.max()), int(t1s.max()))
+    span = np.int64(hi - lo + 2)
+    base = np.arange(S, dtype=np.int64)[:, None] * span
+    ks = (np.repeat(np.arange(S, dtype=np.int64), Cs) * span
+          + (fstarts - lo))
+    ke = (np.repeat(np.arange(S, dtype=np.int64), Cs) * span
+          + (fends - lo))
+    q0 = (base + (t0s[None, :] - lo)).ravel()
+    q1 = (base + (t1s[None, :] - lo)).ravel()
+    i0 = np.searchsorted(ks, q0, side="right").reshape(S, W) - offs[:-1, None]
+    i1 = np.searchsorted(ke, q1, side="right").reshape(S, W) - offs[:-1, None]
+    i1 = np.maximum(i1, i0)
+    A = (offs[:-1, None] + i0)
+    B = (offs[:-1, None] + i1)
+    have = i1 > i0
+    interior = np.zeros((S, W, STATS_WIDTH), np.float64)
+    interior[:, :, S_MIN:S_LAST_VAL + 1] = np.nan
+    pc = _eprefix(fstats[:, S_COUNT])
+    ps = _eprefix(fstats[:, S_SUM])
+    ps2 = _eprefix(fstats[:, S_SUMSQ])
+    pr = _eprefix(fstats[:, S_RESETS])
+    pcorr = _eprefix(fstats[:, S_CORR])
+    pchg = _eprefix(fstats[:, S_CHANGES])
+    interior[:, :, S_COUNT] = pc[B] - pc[A]
+    interior[:, :, S_SUM] = ps[B] - ps[A]
+    interior[:, :, S_SUMSQ] = ps2[B] - ps2[A]
+    # chunk-boundary reset/change carry: pair j = boundary between flat
+    # rows j, j+1 — zeroed across block seams so global prefixes stay
+    # per-partition exact
+    if Ctot > 1:
+        same_block = np.ones(Ctot - 1, bool)
+        same_block[offs[1:-1] - 1] = False
+        pdrop = same_block \
+            & (fstats[1:, S_FIRST_VAL] < fstats[:-1, S_LAST_VAL])
+        br = _eprefix(pdrop.astype(np.float64))
+        bc = _eprefix(np.where(pdrop, fstats[:-1, S_LAST_VAL], 0.0))
+        bg = _eprefix((same_block
+                       & (fstats[1:, S_FIRST_VAL]
+                          != fstats[:-1, S_LAST_VAL])).astype(np.float64))
+    else:
+        br = bc = bg = np.zeros(1, np.float64)
+    blo = np.minimum(A, len(br) - 1)
+    bhi = np.clip(B - 1, blo, len(br) - 1)
+    interior[:, :, S_RESETS] = (pr[B] - pr[A]) + (br[bhi] - br[blo])
+    interior[:, :, S_CORR] = (pcorr[B] - pcorr[A]) + (bc[bhi] - bc[blo])
+    interior[:, :, S_CHANGES] = (pchg[B] - pchg[A]) + (bg[bhi] - bg[blo])
+    lo_row = offs[:-1, None]
+    hi_row = offs[1:, None] - 1
+    fi = np.clip(A, lo_row, hi_row)
+    li = np.clip(B - 1, lo_row, hi_row)
+    for slot in (S_FIRST_TS, S_FIRST_VAL):
+        interior[:, :, slot] = np.where(have, fstats[fi, slot], np.nan)
+    for slot in (S_LAST_TS, S_LAST_VAL):
+        interior[:, :, slot] = np.where(have, fstats[li, slot], np.nan)
+    # min/max over flat runs [A, B): one reduceat per extreme, with a
+    # sentinel row so empty runs (masked by ``have``) index in bounds
+    ridx = np.empty(2 * S * W, np.int64)
+    ridx[0::2] = A.ravel()
+    ridx[1::2] = B.ravel()
+    mn_ext = np.append(fstats[:, S_MIN], np.inf)
+    mx_ext = np.append(fstats[:, S_MAX], -np.inf)
+    mn = np.minimum.reduceat(mn_ext, ridx)[0::2].reshape(S, W)
+    mx = np.maximum.reduceat(mx_ext, ridx)[0::2].reshape(S, W)
+    interior[:, :, S_MIN] = np.where(have, mn, np.nan)
+    interior[:, :, S_MAX] = np.where(have, mx, np.nan)
+    interior[~have, S_COUNT] = 0.0
+    # edges stay per-partition (decoded slices are inherently per-chunk)
+    o0 = np.searchsorted(ke, q0, side="right").reshape(S, W) - offs[:-1, None]
+    o1 = np.searchsorted(ks, q1, side="right").reshape(S, W) - offs[:-1, None]
+    left = np.where(o0 < i0, o0, -1)
+    re_idx = o1 - 1
+    right = np.where((re_idx >= i1) & (re_idx >= 0)
+                     & (re_idx < Cs[:, None]) & (re_idx != left),
+                     re_idx, -1)
+    touched: set = set()
+    zero = np.zeros((W, STATS_WIDTH), np.float64)
+    zero[:, S_MIN:S_LAST_VAL + 1] = np.nan
+    lstats = np.broadcast_to(zero, (S, W, STATS_WIDTH)).copy()
+    rstats = np.broadcast_to(zero, (S, W, STATS_WIDTH)).copy()
+    for j in range(S):
+        if np.any(left[j] >= 0):
+            lstats[j] = _edge_stats(bundles[j], col, left[j], t0s, t1s,
+                                    touched)
+        if np.any(right[j] >= 0):
+            rstats[j] = _edge_stats(bundles[j], col, right[j], t0s, t1s,
+                                    touched)
+    flat = lambda a: a.reshape(S * W, STATS_WIDTH)  # noqa: E731
+    pre = _merge_vec(_merge_vec(flat(lstats), flat(interior)),
+                     flat(rstats))
+    bufs = np.stack([buf_rows[i] for i in rows_idx]) \
+        .reshape(S * W, STATS_WIDTH)
+    merged = _merge_vec(pre, bufs)
+    both = (pre[:, S_COUNT] > 0) & (bufs[:, S_COUNT] > 0)
+    if np.any(bufs[both, S_FIRST_TS] <= pre[both, S_LAST_TS]):
+        raise _Bypass  # out-of-order ingest across the seal boundary
+    for j, i in enumerate(rows_idx):
+        st[i] = merged[j * W:(j + 1) * W]
+    stats_acc["sidecar_chunks"] = stats_acc.get("sidecar_chunks", 0) \
+        + int((i1 - i0).sum())
+    stats_acc["decoded_chunks"] = stats_acc.get("decoded_chunks", 0) \
+        + len(touched)
 
 
 def _eval_group_quantile(sparts, col: int, q: float, t0s, t1s,

@@ -179,6 +179,10 @@ class ColdTierShard:
         self.index = PartKeyIndex()
         self.config = StoreConfig(demand_paging_enabled=False)
         self.odp_cache = DemandPagedChunkCache(max_chunks=odp_max_chunks)
+        # pyramid-lane summary cache; None when the backend publishes no
+        # pyramid objects (the lane then bypasses to demand paging)
+        from filodb_tpu.core.store.pyramid import make_pyramid_cache
+        self.pyramids = make_pyramid_cache(column_store, dataset, shard)
         self.stats = _ColdShardStats()
         # leaf-exec batch cache protocol (see TimeSeriesShard.batch_cache)
         self.batch_cache: dict = {}
@@ -263,10 +267,13 @@ class ColdTierStore:
         return sum(len(s.odp_cache) for s in self._shards.values())
 
     def clear_caches(self) -> None:
-        """Drop ODP + batch caches (benchmarks force cold reads)."""
+        """Drop ODP + batch + pyramid caches (benchmarks force cold
+        reads)."""
         for s in self._shards.values():
             s.odp_cache.clear()
             s.batch_cache.clear()
+            if s.pyramids is not None:
+                s.pyramids.clear()
 
     def tier_stats(self) -> dict:
         """{series, bytes, segments} for the status route; bytes/segments
@@ -294,6 +301,69 @@ class ColdTierStore:
         for s in self.shards_for(dataset):
             out.update(s.label_names())
         return sorted(out)
+
+    # ----------------------------------------------------- approx lane
+    def _merged_sketches(self):
+        """(TopKSketch, HLLSketch) merged over every shard's pyramid
+        footers: bucket roll-ups where present, segment pyramids for the
+        seqs no bucket covers — a summary-only scan, zero payloads."""
+        from filodb_tpu.memory.sketches import HLLSketch, TopKSketch
+        topk = TopKSketch(capacity=256)
+        hll = HLLSketch()
+        for s in self._shards.values():
+            if s.pyramids is None:
+                raise RuntimeError(
+                    "approximate scans need a pyramid-publishing "
+                    "backend (ObjectStoreColumnStore)")
+            idx = getattr(self.column_store, "pyramid_index", None)
+            seqs, buckets = idx(self.dataset, s.shard_num)
+            covered: set[int] = set()
+            for bkt, rec in buckets.items():
+                bp = s.pyramids.bucket(int(bkt), int(rec["seq"]))
+                if bp is None:
+                    continue
+                covered.update(int(q) for q in bp["covers"])
+                topk.merge(bp["topk"])
+                hll.merge(bp["hll"])
+            for seq in seqs:
+                if seq in covered:
+                    continue
+                sp = s.pyramids.segment(seq)
+                if sp is None:
+                    continue
+                topk.merge(sp["topk"])
+                hll.merge(sp["hll"])
+        return topk, hll
+
+    def approx_topk(self, k: int = 10) -> list[dict]:
+        """Sketch-served ``topk(k, max per series)`` over the ENTIRE cold
+        history — O(pyramid objects), no chunk payload bytes. Declared
+        approximation: only served under ``FILODB_SIDECAR_APPROX=1``."""
+        from filodb_tpu.core.store.localstore import _pk_from_blob
+        from filodb_tpu.query.engine.sidecar_lane import approx_enabled
+        if not approx_enabled():
+            raise RuntimeError(
+                "approx_topk requires FILODB_SIDECAR_APPROX=1")
+        for s in self._shards.values():
+            s._maybe_refresh()
+        topk, _hll = self._merged_sketches()
+        out = []
+        for blob, v in topk.top(k):
+            pk = _pk_from_blob(blob)
+            out.append({"labels": pk.label_map, "value": v})
+        return out
+
+    def approx_cardinality(self) -> float:
+        """HyperLogLog series-count estimate from pyramid footers (σ ≈
+        3.25%); same approx declaration as :meth:`approx_topk`."""
+        from filodb_tpu.query.engine.sidecar_lane import approx_enabled
+        if not approx_enabled():
+            raise RuntimeError(
+                "approx_cardinality requires FILODB_SIDECAR_APPROX=1")
+        for s in self._shards.values():
+            s._maybe_refresh()
+        _topk, hll = self._merged_sketches()
+        return hll.estimate()
 
 
 # ---------------------------------------------------------------------------
@@ -351,6 +421,10 @@ class TierExec(NonLeafExecPlan):
             + sub.stats.wire_bytes
         b["decodeMs"] += sub.stats.decode_s * 1000.0
         b["wallMs"] += wall_s * 1000.0
+        # pyramid-lane level attribution rides per-tier too, so
+        # ?stats=all shows WHICH levels served a cold sub-query
+        for k, v in sub.stats.pyramid.items():
+            b[k] = b.get(k, 0) + v
         if not mats:
             return StepMatrix.empty()
         return mats[0]

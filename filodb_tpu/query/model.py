@@ -212,6 +212,11 @@ class QueryStats:
     # bytes, decodeMs, wallMs}} recorded by TierExec at the routing root;
     # empty for non-federated queries
     tiers: dict = field(default_factory=dict)
+    # pyramid-lane attribution (query/engine/pyramid_lane.py): flat
+    # numeric counters {bucketNodes, segmentNodes, chunkNodes,
+    # decodeNodes, pyramidBytes, payloadBytes} for cold-tier folds
+    # served from stored aggregate levels; empty otherwise
+    pyramid: dict = field(default_factory=dict)
 
     def merge_counts(self, other: "QueryStats") -> None:
         """Fold a remote child's stats into this one (count/duration
@@ -232,6 +237,8 @@ class QueryStats:
             mine = self.tiers.setdefault(tier, {})
             for k, v in bucket.items():
                 mine[k] = mine.get(k, 0) + v
+        for k, v in other.pyramid.items():
+            self.pyramid[k] = self.pyramid.get(k, 0) + v
 
 
 @dataclass

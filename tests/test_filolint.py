@@ -466,6 +466,41 @@ class TestParity:
         pr204 = [f for f in out if f.code == "PR204"]
         assert [f.detail for f in pr204] == ["filodb_phantom_total"]
 
+    def test_pyramid_families_exempt_from_nothing(self, tmp_path):
+        # filodb_pyramid_* carries the zero-payload accounting: the lazy
+        # exemption PR203 grants does NOT apply (PR207 still fires)
+        out = self.run(tmp_path, {"filodb_tpu/metrics_mod.py": """
+            from filodb_tpu.utils.metrics import Counter
+
+            good = Counter("filodb_good")
+
+            def lazy():
+                return Counter("filodb_pyramid_ghost")
+
+            def lazy2():
+                return Counter("filodb_lazy")
+
+            def lazy3():
+                return Counter("filodb_phantom")
+            """,
+            "filodb_tpu/model.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Frame:
+                x: int
+
+            class Ghost:
+                pass
+
+            class Plan:
+                pass
+            """})
+        pr207 = [f for f in out if f.code == "PR207"]
+        assert [f.detail for f in pr207] == ["filodb_pyramid_ghost_total"]
+        # and the plain lazy counter stays exempt from PR203
+        assert [f for f in out if f.code == "PR203"] == []
+
     def test_prom_charset(self, tmp_path):
         out = self.run(tmp_path, {"filodb_tpu/metrics_mod.py": """
             from filodb_tpu.utils.metrics import Counter

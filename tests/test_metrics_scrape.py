@@ -209,10 +209,24 @@ OBJECTSTORE_NAMES = [
     "filodb_objectstore_gets_total",
     "filodb_objectstore_bytes_up_total",
     "filodb_objectstore_bytes_down_total",
+    "filodb_objectstore_payload_bytes_down_total",
     "filodb_objectstore_retries_total",
     "filodb_objectstore_compactions_total",
     "filodb_objectstore_corrupt_total",
     "filodb_objectstore_queue_depth",
+]
+
+
+# aggregate pyramids (core/store/pyramid.py, query/engine/pyramid_lane.py)
+# — registered when objectstore imports pyramid at boot; kept in step with
+# the source tree by the filolint PR207 rule (no lazy/GaugeFn exemptions)
+PYRAMID_NAMES = [
+    "filodb_pyramid_objects_written_total",
+    "filodb_pyramid_backfilled_total",
+    "filodb_pyramid_served_total",
+    "filodb_pyramid_fallback_total",
+    "filodb_pyramid_nodes_total",
+    "filodb_pyramid_bytes_down_total",
 ]
 
 
@@ -373,6 +387,11 @@ class TestMetricsScrape:
         # (pre-registered at import so dashboards see stable zeros)
         missing_os = [n for n in OBJECTSTORE_NAMES if n not in names_present]
         assert not missing_os, f"missing objectstore metrics: {missing_os}"
+
+        # aggregate-pyramid families render at zero before any cold fold
+        # (counters register when objectstore imports pyramid at boot)
+        missing_pyr = [n for n in PYRAMID_NAMES if n not in names_present]
+        assert not missing_pyr, f"missing pyramid metrics: {missing_pyr}"
 
         # query-path resilience counters render from import time
         missing_qr = [n for n in QUERY_RESILIENCE_NAMES

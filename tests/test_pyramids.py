@@ -1,0 +1,370 @@
+"""Aggregate-pyramid tests: O(log) cold-tier range folds and the
+sketch-served approximate lane.
+
+Covers the zero-payload guarantee (interior windows fold stored
+segment/bucket summaries — the objectstore payload-bytes counter must
+not move), bucket-level composition after compaction, exact bitwise
+parity between stored-summary and recompute-from-decode provenance
+modes across the eligible-fn sweep, compaction backfill over legacy
+FSG1 segments (including the mid-backfill read-race window: queries
+demote to chunk fallback, never error), the ``FILODB_SIDECAR_APPROX``
+lane (sketch quantiles with factor-of-two bounds, summary-only topk /
+count-distinct), and ``queryStats`` pyramid attribution end to end
+through the Prom JSON renderer.
+"""
+
+import glob
+import json
+import os
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import filodb_tpu.core.store.objectstore as osmod
+from filodb_tpu.coordinator.ingestion import ingest_routed
+from filodb_tpu.coordinator.planner import SingleClusterPlanner
+from filodb_tpu.coordinator.tiered_planner import build_tiered_planner
+from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+from filodb_tpu.core.store import pyramid as pyrmod
+from filodb_tpu.core.store.api import InMemoryMetaStore
+from filodb_tpu.core.store.config import StoreConfig
+from filodb_tpu.core.store.objectstore import ObjectStoreColumnStore
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.query.exec.plan import ExecContext
+from filodb_tpu.testing.data import (
+    counter_series,
+    counter_stream,
+    gauge_stream,
+    machine_metrics_series,
+)
+from filodb_tpu.testing.fake_s3 import FakeS3
+from filodb_tpu.utils.resilience import RetryPolicy
+
+START = 1_600_000_000
+NOW = (START + 6000) * 1000
+MEM_FLOOR = (START + 4000) * 1000  # steps reaching below this go cold
+
+
+def _make_memstore(cs):
+    ms = TimeSeriesMemStore(cs, InMemoryMetaStore())
+    for s in range(2):
+        ms.setup("timeseries", s, StoreConfig(max_chunk_size=120,
+                                              groups_per_shard=2))
+    return ms
+
+
+def _env(tmp_path, flushes=1, compact=False, counter=False):
+    """Writer + independent reader over one FakeS3 root. ``flushes``
+    splits the 600-sample ingest into that many flush rounds (>=2 gives
+    multi-segment buckets so ``compact`` has something to merge)."""
+    s3root = str(tmp_path / "s3")
+    s3 = FakeS3(root=s3root)
+    cs = ObjectStoreColumnStore(s3)
+    ms = _make_memstore(cs)
+    if counter:
+        assert flushes == 1  # counter_stream has no offset resume
+        keys = counter_series(4)
+        streams = [counter_stream(keys, 600, start_ms=START * 1000,
+                                  seed=7)]
+    else:
+        keys = machine_metrics_series(6)
+        per = 600 // flushes
+        streams = [gauge_stream(keys, per,
+                                start_ms=(START + i * per * 10) * 1000,
+                                start_offset=1000 * i)
+                   for i in range(flushes)]
+    for stream in streams:
+        ingest_routed(ms, "timeseries", stream, 2, spread=0)
+        ms.flush_all("timeseries")
+        cs.flush()  # seal per round: multi-segment buckets for compact
+    if compact:
+        for s in range(2):
+            cs.compact("timeseries", s)
+        cs.flush()
+    read_s3 = FakeS3(root=s3root)
+    read_cs = ObjectStoreColumnStore(
+        read_s3, read_retry_policy=RetryPolicy(max_attempts=2,
+                                               base_backoff_s=0.01,
+                                               max_backoff_s=0.05))
+    planner = build_tiered_planner(
+        SingleClusterPlanner("timeseries", 2, spread=0), read_cs,
+        "timeseries", 2, mem_retention_ms=NOW - MEM_FLOOR,
+        raw_retention_ms=None, ds_planner=None, now_ms=lambda: NOW)
+    return ms, cs, planner, read_s3, read_cs, keys
+
+
+def _run(ms, planner, promql, start, step, end):
+    plan = parse_query(promql, TimeStepParams(start, step, end))
+    ep = planner.materialize(plan)
+    ctx = ExecContext(ms, "timeseries")
+    return ep.dispatcher.dispatch(ep, ctx), ctx
+
+
+def _row_order(a, b):
+    pos = {k: i for i, k in enumerate(a.keys)}
+    return np.array([pos[k] for k in b.keys], dtype=np.int64)
+
+
+def _assert_matches_control(ms, planner, q, start, step, end,
+                            rtol=2e-5):
+    r, ctx = _run(ms, planner, q, start, step, end)
+    assert not r.partial
+    ctl, _ = _run(ms, SingleClusterPlanner("timeseries", 2, spread=0),
+                  q, start, step, end)
+    assert r.result.num_series == ctl.result.num_series
+    ctl_vals = ctl.result.values[_row_order(ctl.result, r.result)]
+    np.testing.assert_allclose(r.result.values, ctl_vals, rtol=rtol,
+                               equal_nan=True)
+    return r, ctx
+
+
+# chunk geometry with one 600-sample flush: 5 chunks per series, each
+# 120 samples at 10s cadence -> ends at +1190s, +2390, +3590, +4790,
+# +5990. A grid pinned to chunk ends with the window reaching before
+# the first sample has NO seam decodes: every touched node is interior.
+ALIGNED = (START + 1190, 1200, START + 3590)
+
+
+class TestZeroPayload:
+    def test_interior_scan_pages_zero_chunk_payload_bytes(self, tmp_path):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        payload0 = osmod.PAYLOAD_BYTES_DOWN.value
+        r, ctx = _assert_matches_control(
+            ms, planner, "sum_over_time(heap_usage[4000s])", *ALIGNED)
+        assert osmod.PAYLOAD_BYTES_DOWN.value == payload0
+        p = ctx.stats.pyramid
+        assert p["payloadBytes"] == 0
+        assert p.get("decodeNodes", 0) == 0
+        assert p.get("chunkNodes", 0) + p.get("segmentNodes", 0) > 0
+        assert p["pyramidBytes"] > 0  # served from fetched summaries
+
+    def test_full_segment_window_folds_segment_nodes(self, tmp_path):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        payload0 = osmod.PAYLOAD_BYTES_DOWN.value
+        # window covers every chunk of every series: each partition
+        # collapses to ONE interior segment-level node
+        r, ctx = _assert_matches_control(
+            ms, planner, "sum_over_time(heap_usage[6100s])",
+            START + 5990, 300, START + 5990)
+        assert osmod.PAYLOAD_BYTES_DOWN.value == payload0
+        p = ctx.stats.pyramid
+        assert p["segmentNodes"] == 6  # one per series
+        assert p.get("chunkNodes", 0) == 0
+        assert p.get("decodeNodes", 0) == 0
+
+    def test_bucket_nodes_after_compaction(self, tmp_path):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path, flushes=2,
+                                                  compact=True)
+        payload0 = osmod.PAYLOAD_BYTES_DOWN.value
+        r, ctx = _assert_matches_control(
+            ms, planner, "sum_over_time(heap_usage[6100s])",
+            START + 5990, 300, START + 5990)
+        assert osmod.PAYLOAD_BYTES_DOWN.value == payload0
+        p = ctx.stats.pyramid
+        # compaction rolled each bucket into one segment + bucket
+        # pyramid; the full-history window folds the bucket level
+        assert p["bucketNodes"] == 6
+        assert p.get("segmentNodes", 0) == 0
+        assert p.get("decodeNodes", 0) == 0
+
+    def test_seam_windows_decode_only_edges(self, tmp_path):
+        """A non-aligned grid still serves, paying only edge decodes."""
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        r, ctx = _assert_matches_control(
+            ms, planner, "sum_over_time(heap_usage[40m])",
+            START + 1000, 700, START + 3500)
+        p = ctx.stats.pyramid
+        assert p.get("decodeNodes", 0) > 0   # seam chunks paid
+        assert p.get("chunkNodes", 0) > 0    # interiors still free
+
+
+GAUGE_FNS = [
+    "sum_over_time", "avg_over_time", "min_over_time", "max_over_time",
+    "count_over_time", "stddev_over_time", "stdvar_over_time",
+    "last_over_time", "present_over_time", "changes", "resets", "delta",
+]
+
+
+class TestProvenanceParity:
+    """Stored-summary mode ("1") vs recompute-from-decode mode
+    ("decode") must agree BITWISE: codecs are lossless and both modes
+    run the identical strict-left merge fold."""
+
+    def _sweep(self, ms, planner, q, monkeypatch):
+        span = (START + 900, 300, START + 3500)
+        store = planner.cold_planner.store
+        outs = {}
+        for mode in ("1", "decode"):
+            monkeypatch.setenv("FILODB_SIDECARS", mode)
+            store.clear_caches()
+            r, ctx = _run(ms, planner, q, *span)
+            assert not r.partial
+            assert ctx.stats.pyramid, (q, mode)  # lane actually served
+            outs[mode] = r
+        monkeypatch.setenv("FILODB_SIDECARS", "0")
+        store.clear_caches()
+        ctl, _ = _run(ms, planner, q, *span)
+        monkeypatch.delenv("FILODB_SIDECARS")
+        a, b = outs["1"].result, outs["decode"].result
+        order = _row_order(b, a)
+        assert a.values.tobytes() == b.values[order].tobytes(), q
+        ctl_vals = ctl.result.values[_row_order(ctl.result, a)]
+        np.testing.assert_allclose(a.values, ctl_vals, rtol=2e-5,
+                                   equal_nan=True)
+
+    def test_gauge_fn_sweep_bitwise(self, tmp_path, monkeypatch):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        for fn in GAUGE_FNS:
+            self._sweep(ms, planner, f"{fn}(heap_usage[25m])",
+                        monkeypatch)
+
+    def test_counter_rate_increase_bitwise(self, tmp_path, monkeypatch):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path, counter=True)
+        for fn in ("rate", "increase", "irate"):
+            if fn == "irate":
+                continue  # not sidecar-eligible; covered by decode lane
+            self._sweep(ms, planner,
+                        f"{fn}(http_requests_total[25m])", monkeypatch)
+
+
+class TestLegacyBackfill:
+    def test_fsg1_segments_serve_via_fallback_then_backfill(
+            self, tmp_path):
+        # write the whole history as legacy FSG1 (no pyramids)
+        with mock.patch.object(osmod, "_MAGIC", b"FSG1"):
+            ms, cs, planner, s3, read_cs, keys = _env(tmp_path,
+                                                      flushes=2)
+        assert not glob.glob(os.path.join(str(tmp_path), "s3", "**",
+                                          "*.pyr"), recursive=True)
+        # pre-backfill reads demote to chunk fallback — correct, no error
+        fb0 = pyrmod.PYR_FALLBACK.value
+        r, ctx = _assert_matches_control(
+            ms, planner, "max_over_time(heap_usage[4000s])", *ALIGNED)
+        assert pyrmod.PYR_FALLBACK.value > fb0
+        assert ctx.stats.pyramid.get("decodeNodes", 0) > 0
+
+        # compaction (FSG2 writer again) backfills pyramid coverage
+        bf0 = pyrmod.PYR_BACKFILLED.value
+        removed = sum(cs.compact("timeseries", s) for s in range(2))
+        cs.flush()
+        assert removed > 0
+        assert pyrmod.PYR_BACKFILLED.value > bf0
+        assert glob.glob(os.path.join(str(tmp_path), "s3", "**",
+                                      "*.pyr"), recursive=True)
+
+        # a fresh reader over the compacted bucket folds zero payloads
+        read_cs2 = ObjectStoreColumnStore(FakeS3(
+            root=str(tmp_path / "s3")))
+        planner2 = build_tiered_planner(
+            SingleClusterPlanner("timeseries", 2, spread=0), read_cs2,
+            "timeseries", 2, mem_retention_ms=NOW - MEM_FLOOR,
+            raw_retention_ms=None, ds_planner=None, now_ms=lambda: NOW)
+        payload0 = osmod.PAYLOAD_BYTES_DOWN.value
+        r2, ctx2 = _assert_matches_control(
+            ms, planner2, "max_over_time(heap_usage[6100s])",
+            START + 5990, 300, START + 5990)
+        assert osmod.PAYLOAD_BYTES_DOWN.value == payload0
+        assert ctx2.stats.pyramid["bucketNodes"] == 6
+
+    def test_read_race_missing_pyramid_objects_never_error(
+            self, tmp_path):
+        """Manifest advertises pyramids a concurrent compaction already
+        deleted (the mid-backfill window): the reader demotes to chunk
+        fallback and stays exact."""
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        pyrs = glob.glob(os.path.join(str(tmp_path), "s3", "**",
+                                      "*.pyr"), recursive=True)
+        assert pyrs
+        for f in pyrs:
+            os.remove(f)
+        fb0 = pyrmod.PYR_FALLBACK.value
+        r, ctx = _assert_matches_control(
+            ms, planner, "sum_over_time(heap_usage[4000s])", *ALIGNED)
+        assert pyrmod.PYR_FALLBACK.value > fb0
+        assert not r.partial and not r.warnings
+
+
+class TestApproxLane:
+    def test_quantile_served_from_sketches_within_bounds(
+            self, tmp_path, monkeypatch):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        q = "quantile_over_time(0.9,heap_usage[4000s])"
+        # exact control first (approx off: decode path)
+        ctl, _ = _run(ms, SingleClusterPlanner("timeseries", 2,
+                                               spread=0), q, *ALIGNED)
+        monkeypatch.setenv("FILODB_SIDECAR_APPROX", "1")
+        planner.cold_planner.store.clear_caches()
+        r, ctx = _run(ms, planner, q, *ALIGNED)
+        assert not r.partial
+        assert ctx.stats.pyramid  # pyramid lane served the fold
+        ctl_vals = ctl.result.values[_row_order(ctl.result, r.result)]
+        # log2-sketch quantiles are bounded by the bucket width: the
+        # estimate sits within a factor of two of the true quantile
+        ratio = r.result.values / ctl_vals
+        assert np.isfinite(ratio).all()
+        assert (ratio >= 0.45).all() and (ratio <= 2.2).all()
+
+    def test_quantile_exact_without_declared_approx(self, tmp_path):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        assert os.environ.get("FILODB_SIDECAR_APPROX", "0") != "1"
+        q = "quantile_over_time(0.9,heap_usage[4000s])"
+        # undeclared: the pyramid lane refuses and the decode path
+        # answers exactly
+        r, ctx = _assert_matches_control(ms, planner, q, *ALIGNED,
+                                         rtol=1e-9)
+        assert not ctx.stats.pyramid
+
+    def test_topk_and_cardinality_summary_only(self, tmp_path,
+                                               monkeypatch):
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path, flushes=2,
+                                                  compact=True)
+        store = planner.cold_planner.store
+        with pytest.raises(RuntimeError, match="FILODB_SIDECAR_APPROX"):
+            store.approx_topk(3)
+        with pytest.raises(RuntimeError, match="FILODB_SIDECAR_APPROX"):
+            store.approx_cardinality()
+        monkeypatch.setenv("FILODB_SIDECAR_APPROX", "1")
+        payload0 = osmod.PAYLOAD_BYTES_DOWN.value
+        top = store.approx_topk(10)
+        card = store.approx_cardinality()
+        assert osmod.PAYLOAD_BYTES_DOWN.value == payload0
+        # topk values are EXACT per-series maxima (S_MAX merges are
+        # lossless; the sketch only caps how many keys it tracks)
+        ctl, _ = _run(ms, SingleClusterPlanner("timeseries", 2,
+                                               spread=0),
+                      "max_over_time(heap_usage[6100s])",
+                      START + 5990, 300, START + 5990)
+        truth = {k.label_map["instance"]: float(ctl.result.values[i, -1])
+                 for i, k in enumerate(ctl.result.keys)}
+        assert len(top) == 6
+        got = {e["labels"]["instance"]: e["value"] for e in top}
+        assert got == pytest.approx(truth)
+        vals = [e["value"] for e in top]
+        assert vals == sorted(vals, reverse=True)
+        # HLL count-distinct within its error bound (σ≈3.25%, small-n
+        # range uses linear counting: near exact at 6 series)
+        assert abs(card - 6) / 6 < 0.10
+
+
+class TestStatsAttribution:
+    def test_tier_buckets_and_promjson_pyramid_keys(self, tmp_path):
+        from filodb_tpu.http.promjson import matrix_json_str
+        from filodb_tpu.query.federation import OBJECTSTORE
+        ms, cs, planner, s3, read_cs, keys = _env(tmp_path)
+        r, ctx = _run(ms, planner, "sum_over_time(heap_usage[4000s])",
+                      *ALIGNED)
+        p = ctx.stats.pyramid
+        for k in ("segmentNodes", "chunkNodes", "decodeNodes",
+                  "pyramidBytes", "payloadBytes"):
+            assert k in p, k
+        # per-tier attribution: the cold bucket carries the same keys
+        tier = ctx.stats.tiers[OBJECTSTORE]
+        assert tier["pyramidBytes"] == p["pyramidBytes"]
+        assert tier["payloadBytes"] == p["payloadBytes"]
+        # stats=all renders them; the default stats block does not
+        r.stats = ctx.stats
+        full = json.loads(matrix_json_str(r, full_stats=True))
+        assert full["queryStats"]["pyramid"]["payloadBytes"] == 0
+        brief = json.loads(matrix_json_str(r, full_stats=False))
+        assert "pyramid" not in brief["queryStats"]
