@@ -1,0 +1,226 @@
+"""Static heuristics vs trace-driven adaptive routing on a mixed soak.
+
+The static sidecar gate reasons from chunk *geometry* (interior samples
+the fold would skip) and cannot see cache state: over a store whose
+decoded-chunk memos are warm, the decode lane is nearly free while the
+sealed fold still pays O(chunks) per series — the geometry estimate
+picks the fold and loses. The adaptive planner settles actual wall
+times per (site, partition-window signature) and routes to whichever
+arm measured cheaper, so a mixed workload where different scenarios
+want different arms is exactly where it should beat any one fixed
+heuristic.
+
+Three scenario classes soak together, mixed round-robin:
+
+* ``alert_probe_cold_large`` — cold large sealed chunks, single-step
+  probe: the fold's design center; both static and adaptive should
+  serve sidecar. Parity expected.
+* ``dashboard_wide_fanout_cold`` — a cold dashboard scan whose
+  partition-window count sits ABOVE the static amortization gate
+  (``1200 series x 60 steps > 65536``), so geometry refuses the fold —
+  but the store is cold and the decode lane pays the full window while
+  the batched fold amortizes across the whole group. Static mis-routes
+  every repeat; adaptive learns the fold after calibration. This class
+  sets the mixed-soak tail.
+* ``adhoc_small_chunks`` — warm small-chunk scans under the
+  amortization gate: static already bypasses; parity expected.
+
+Phases per run:
+
+1. **static soak** — ``FILODB_ADAPTIVE=0``, default valves.
+2. **oracle replay** — both arms forced per scenario via the sealed
+   gate valve (``FILODB_SIDECAR_SEALED_GATE`` 0 = always-fold
+   override, 1 = geometry-refuses so decode) with routing still pinned
+   static; the model observes every settled wall time, so this doubles
+   as calibration. The per-query minimum over the two forced arms is
+   the **oracle** — the best any router could have picked.
+3. **adaptive soak** — ``FILODB_ADAPTIVE=1``, default valves, the
+   now-warm model routes.
+
+Latencies land in a flight-recorder ring; the headline is soak p99
+static vs adaptive. The machine-checked **oracle gate**: per (scenario,
+query) site the adaptive best must be within 2x of the oracle best —
+a regression guard that fails the benchmark result (``gate_ok``)
+rather than eyeballing a table.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+START = 1_600_000_000
+
+SCENARIOS = [
+    {"name": "alert_probe_cold_large", "series": 96, "chunk": 2048,
+     "samples": 8192, "window": "1300m", "steps": 1, "cold": True,
+     "repeats": 6,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+    {"name": "dashboard_wide_fanout_cold", "series": 1200, "chunk": 512,
+     "samples": 3072, "window": "300m", "steps": 60, "cold": True,
+     "repeats": 6,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+    {"name": "adhoc_small_chunks", "series": 256, "chunk": 64,
+     "samples": 720, "window": "40m", "steps": 6, "cold": False,
+     "repeats": 6,
+     "queries": ["sum(avg_over_time(heap_usage[{w}]))"]},
+]
+ORACLE_REPEATS = 3   # forced-arm replays per (scenario, query, arm)
+GATE_FACTOR = 2.0    # adaptive must stay within this factor of oracle
+
+
+def _build(sc):
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import gauge_stream, machine_metrics_series
+
+    ms = TimeSeriesMemStore()
+    shard = ms.setup("bench", 0, StoreConfig(max_chunk_size=sc["chunk"]))
+    stream = gauge_stream(machine_metrics_series(sc["series"]),
+                          sc["samples"], start_ms=START * 1000, seed=11)
+    for batch in stream:
+        shard.ingest(batch)
+    return ms
+
+
+def _go_cold(ms):
+    for shard in ms.shards_for("bench"):
+        shard.batch_cache.clear()
+        for pid in shard.lookup_partitions([], 0, 2 ** 62):
+            p = shard.partition(pid)
+            if p is None:
+                continue
+            for ch in p.chunks:
+                ch.__dict__.pop("_decoded", None)
+
+
+def _params(sc):
+    end = START + (sc["samples"] - 1) * 10
+    qs = end - (sc["steps"] - 1) * 60
+    return qs, end
+
+
+def _run_query(svc, ms, sc, q):
+    qs, end = _params(sc)
+    if sc["cold"]:
+        _go_cold(ms)
+    else:
+        for shard in ms.shards_for("bench"):
+            shard.batch_cache.clear()
+    t0 = time.perf_counter()
+    svc.query_range(q, qs, 60, end)
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def _soak(stores, services, ring, mode):
+    """One mixed pass: scenarios interleave round-robin so no class
+    runs back-to-back (cache effects stay realistic)."""
+    lat = {}
+    for rep in range(max(sc["repeats"] for sc in SCENARIOS)):
+        for sc in SCENARIOS:
+            if rep >= sc["repeats"]:
+                continue
+            for q in sc["queries"]:
+                query = q.format(w=sc["window"])
+                ms = _run_query(services[sc["name"]], stores[sc["name"]],
+                                sc, query)
+                lat.setdefault((sc["name"], query), []).append(ms)
+                ring.record({"mode": mode, "scenario": sc["name"],
+                             "query": query, "ms": ms})
+    return lat
+
+
+def _p(values, q):
+    xs = sorted(values)
+    return xs[min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))]
+
+
+def bench_adaptive():
+    from filodb_tpu.coordinator.query_service import QueryService
+    from filodb_tpu.query import cost_model as cm
+    from filodb_tpu.utils.tracing import FlightRecorder
+
+    stores = {sc["name"]: _build(sc) for sc in SCENARIOS}
+    services = {sc["name"]: QueryService(stores[sc["name"]], "bench", 1,
+                                         spread=0)
+                for sc in SCENARIOS}
+    ring = FlightRecorder(capacity=4096)
+    saved = {k: os.environ.get(k)
+             for k in ("FILODB_ADAPTIVE", "FILODB_SIDECAR_SEALED_GATE")}
+    try:
+        # warm compile caches once per (scenario, query)
+        for sc in SCENARIOS:
+            for q in sc["queries"]:
+                qs, end = _params(sc)
+                services[sc["name"]].query_range(q.format(w=sc["window"]),
+                                                 qs, 60, end)
+
+        # -- phase 1: static soak ------------------------------------------
+        cm.reset_models()
+        os.environ["FILODB_ADAPTIVE"] = "0"
+        os.environ.pop("FILODB_SIDECAR_SEALED_GATE", None)
+        static_lat = _soak(stores, services, ring, "static")
+
+        # -- phase 2: oracle replay (both arms forced; also calibrates) ----
+        cm.reset_models()
+        cm.model_for("bench").configure(min_samples=2)
+        oracle = {}
+        for arm, gate in (("sidecar", "0"), ("decode", "1")):
+            os.environ["FILODB_SIDECAR_SEALED_GATE"] = gate
+            for sc in SCENARIOS:
+                for q in sc["queries"]:
+                    query = q.format(w=sc["window"])
+                    best = min(_run_query(services[sc["name"]],
+                                          stores[sc["name"]], sc, query)
+                               for _ in range(ORACLE_REPEATS))
+                    oracle.setdefault((sc["name"], query), {})[arm] = best
+
+        # -- phase 3: adaptive soak on the warm model ----------------------
+        os.environ["FILODB_ADAPTIVE"] = "1"
+        os.environ.pop("FILODB_SIDECAR_SEALED_GATE", None)
+        adaptive_lat = _soak(stores, services, ring, "adaptive")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    rows, gate_ok = [], True
+    for key in sorted(oracle):
+        name, query = key
+        oracle_best = min(oracle[key].values())
+        adaptive_best = min(adaptive_lat[key])
+        static_best = min(static_lat[key])
+        site_ok = adaptive_best <= GATE_FACTOR * oracle_best + 1.0
+        gate_ok = gate_ok and site_ok
+        rows.append({
+            "scenario": name, "query": query,
+            "static_ms": round(static_best, 2),
+            "adaptive_ms": round(adaptive_best, 2),
+            "oracle_sidecar_ms": round(oracle[key]["sidecar"], 2),
+            "oracle_decode_ms": round(oracle[key]["decode"], 2),
+            "oracle_ms": round(oracle_best, 2),
+            "vs_oracle": round(adaptive_best / max(oracle_best, 1e-9), 2),
+            "gate_ok": site_ok,
+        })
+
+    entries = ring.snapshot()
+    static_all = [e["ms"] for e in entries if e["mode"] == "static"]
+    adaptive_all = [e["ms"] for e in entries if e["mode"] == "adaptive"]
+    headline = {
+        "static_p50_ms": round(_p(static_all, 0.5), 2),
+        "static_p99_ms": round(_p(static_all, 0.99), 2),
+        "adaptive_p50_ms": round(_p(adaptive_all, 0.5), 2),
+        "adaptive_p99_ms": round(_p(adaptive_all, 0.99), 2),
+    }
+    headline["beats_static_p99"] = (headline["adaptive_p99_ms"]
+                                    <= headline["static_p99_ms"])
+    return {"metric": "static_vs_adaptive_soak", "unit": "ms/query",
+            "gate_factor": GATE_FACTOR, "gate_ok": gate_ok,
+            "headline": headline, "rows": rows}
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(bench_adaptive(), indent=2))

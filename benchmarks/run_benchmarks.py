@@ -522,6 +522,12 @@ def _bench_pyramid_topk_1m():
     return bench_pyramid_topk_1m()
 
 
+def _bench_adaptive():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from adaptive import bench_adaptive
+    return bench_adaptive()
+
+
 def _bench_mesh_scaling(devices=None):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mesh_scaling import DEFAULT_DEVICES, run_sweep
@@ -553,6 +559,7 @@ ALL = {
     "federation": _bench_federation,
     "federation_yearscan": _bench_federation_yearscan,
     "pyramid_topk_1m": _bench_pyramid_topk_1m,
+    "adaptive": _bench_adaptive,
     "mesh_scaling": _bench_mesh_scaling,
 }
 

@@ -29,6 +29,10 @@ Passes (each a ``run(ctx) -> list[Finding]`` module):
 - :mod:`~filodb_tpu.analysis.hotpath` — host syncs and Python-side
   wall-clock/randomness inside jitted ``query/engine`` kernels
   (HP301/2).
+- :mod:`~filodb_tpu.analysis.decisionparity` — adaptive-planner settle
+  parity: every ``cost_model.decide()``/``classify()`` site must settle
+  its decision (``record_actual``/``defer``) or return it to a caller
+  that does, or the learned estimates silently drift (DC601).
 
 Findings diff against a checked-in baseline (``conf/
 filolint_baseline.json``) so the CI gate (``tests/test_filolint.py``)

@@ -84,13 +84,13 @@ class AnalysisContext:
 def run_all(root: str, passes=None) -> list[Finding]:
     """Run every pass over the tree at ``root``; inline-suppressed
     findings are dropped here so passes never special-case comments."""
-    from filodb_tpu.analysis import (chokepoint, hotpath, lifecycle,
-                                     lockdiscipline, parity)
+    from filodb_tpu.analysis import (chokepoint, decisionparity, hotpath,
+                                     lifecycle, lockdiscipline, parity)
 
     ctx = AnalysisContext.build(root)
     findings: list[Finding] = []
     for mod in (passes or (lockdiscipline, lifecycle, chokepoint,
-                           parity, hotpath)):
+                           parity, hotpath, decisionparity)):
         findings.extend(mod.run(ctx))
     by_path = {m.path: m.lines for m in ctx.modules}
     out = []

@@ -308,6 +308,45 @@ def cmd_slowlog(args):
                   + (f" [{tags}]" if tags else ""))
 
 
+def cmd_coststats(args):
+    """Adaptive-planner cost model dump: per-(site, signature, arm) online
+    estimates with warm state, per-site calibration error, and recent
+    predicted-vs-actual pairs
+    (``/promql/{dataset}/api/v1/debug/costmodel``)."""
+    import urllib.request
+    qs = f"?limit={args.limit}" if args.limit else ""
+    url = (f"http://{args.host}/promql/{args.dataset}"
+           f"/api/v1/debug/costmodel{qs}")
+    with urllib.request.urlopen(url) as r:
+        snap = json.load(r)["data"]
+    if args.json:
+        print(json.dumps(snap, indent=2))
+        return
+    print(f"dataset={snap['dataset']} adaptive="
+          f"{'on' if snap['enabled'] else 'off'} "
+          f"signatures={snap['signatures']}/{snap['max_signatures']} "
+          f"min_samples={snap['min_samples']}")
+    calib = snap.get("calibration_error") or {}
+    if calib:
+        print("calibration error (EWMA |pred-actual|/actual):")
+        for site, err in sorted(calib.items()):
+            print(f"    {site:<10} {err:.3f}")
+    rows = snap.get("estimates") or []
+    if not rows:
+        print("(no observations yet)")
+        return
+    print(f"{'site':<10} {'signature':<32} {'arm':<10} {'n':>5} "
+          f"{'est_s':>10} {'p50_s':>10} {'p90_s':>10} warm")
+    for row in rows:
+        p50 = row["p50_s"]
+        p90 = row["p90_s"]
+        print(f"{row['site']:<10} {row['signature']:<32.32} "
+              f"{row['arm']:<10} {row['n']:>5} {row['estimate_s']:>10.6f} "
+              f"{p50 if p50 is None else format(p50, '10.6f')} "
+              f"{p90 if p90 is None else format(p90, '10.6f')} "
+              f"{'yes' if row['warm'] else 'no'}")
+
+
 def cmd_indexnames(args):
     cs, meta, ms = _open_stores(args)
     from filodb_tpu.core.store.config import StoreConfig
@@ -578,6 +617,11 @@ def main(argv=None):
                    help="newest N entries (0 = everything retained)")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
+    p = sub.add_parser("coststats")
+    p.add_argument("--limit", type=int, default=0,
+                   help="top N estimate rows (0 = everything retained)")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
     sub.add_parser("indexnames")
     p = sub.add_parser("labelvalues")
     p.add_argument("label")
@@ -611,6 +655,7 @@ def main(argv=None):
             "shardmap": cmd_shardmap, "replicacheck": cmd_replicacheck,
             "rules": cmd_rules,
             "slowlog": cmd_slowlog,
+            "coststats": cmd_coststats,
             "indexnames": cmd_indexnames, "labelvalues": cmd_labelvalues,
             "importcsv": cmd_importcsv, "promql": cmd_promql,
             "decodechunks": cmd_decode_chunk, "topkcard": cmd_topkcard,

@@ -78,6 +78,18 @@ DEFAULTS = {
         # quota-dropped ingest), never its neighbors
         "tenants": {},
     },
+    # trace-driven adaptive planner (filodb_tpu.query.cost_model.CostModel):
+    # online per-(dataset, plan-signature) cost estimates routing the
+    # either/or planning decisions (sidecar vs decode, pyramid fallback,
+    # pushdown, lane, paging, admission class, cache admission). Below
+    # min_samples every site reproduces the static heuristic exactly;
+    # FILODB_ADAPTIVE=0 disables routing entirely (observation continues).
+    "cost_model": {
+        "min_samples": 8,             # arm warm-up before routing departs
+        "max_signatures": 4096,       # LRU bound on (site, signature) keys
+        "reservoir": 64,              # percentile reservoir per arm
+        "cheap_threshold_s": 0.05,    # admit-class CHEAP/EXPENSIVE split
+    },
     # distributed query tracing + slow-query flight recorder
     # (filodb_tpu.utils.tracing.TracingConfig): head-sampling rate for
     # full span trees (deterministic on query_id), tail capture of any
@@ -231,6 +243,7 @@ class ServerConfig:
     resilience: dict = field(default_factory=dict)  # ResilienceConfig overrides
     result_cache: dict = field(default_factory=dict)  # ResultCacheConfig block
     governor: dict = field(default_factory=dict)  # GovernorConfig overrides
+    cost_model: dict = field(default_factory=dict)  # adaptive planner config
     store: dict = field(default_factory=dict)  # durable-store backend block
     migration: dict = field(default_factory=dict)  # live-migration knobs
     replication: dict = field(default_factory=dict)  # shard-replica knobs
@@ -282,6 +295,7 @@ class ServerConfig:
             engines=engines, resilience=cfg.get("resilience", {}),
             result_cache=cfg.get("result_cache", {}),
             governor=cfg.get("governor", {}),
+            cost_model=cfg.get("cost_model", {}),
             store=cfg.get("store", {}),
             migration=cfg.get("migration", {}),
             replication=cfg.get("replication", {}),

@@ -188,14 +188,21 @@ class SingleClusterPlanner(QueryPlanner):
     # whenever the shape allows (tests/benchmarks), "off" never pushes
     agg_pushdown: str = "auto"
 
-    def _agg_pushdown_leaves(self, plan: lp.Aggregate,
-                             inner: ExecPlan) -> "list[ExecPlan] | None":
+    def _agg_pushdown_leaves(self, plan: lp.Aggregate, inner: ExecPlan,
+                             q=None) -> "list[ExecPlan] | None":
         """Selector leaves to push the map stage into, or None to bypass.
 
         Shape gate: the map stage rides the leaf transformer chains, so the
         inner plan must be a plain scatter-gather of selector leaves (any
         intermediate transformer or non-leaf child would see
-        already-aggregated rows)."""
+        already-aggregated rows).
+
+        Under "auto" the locality heuristic (push only when a child leaves
+        the process) is the *static* arm of a learned decision: once the
+        cost model has settled wall times for both arms of this signature
+        class, the predicted-cheaper arm wins ("pushdown" decision site,
+        settled with the query's wall time via the deferred-settle hook on
+        the query context)."""
         if self.agg_pushdown == "off" or plan.op not in tf.AGG_PUSHDOWN_OPS:
             return None
         if isinstance(inner, SelectRawPartitionsExec):
@@ -206,16 +213,26 @@ class SingleClusterPlanner(QueryPlanner):
             leaves = inner.children_plans
         else:
             return None
-        if self.agg_pushdown != "always" and all(
-                isinstance(c.dispatcher, InProcessPlanDispatcher)
-                for c in leaves):
-            return None  # all-local: keep the single big device reduce
+        if self.agg_pushdown == "always":
+            return leaves
+        from filodb_tpu.query import cost_model as cm
+        all_local = all(isinstance(c.dispatcher, InProcessPlanDispatcher)
+                        for c in leaves)
+        static_arm = "local" if all_local else "pushdown"
+        model = cm.model_for(self.dataset)
+        sig = (f"agg:{plan.op}:leaves{cm.bucket(len(leaves))}:"
+               f"{'local' if all_local else 'remote'}")
+        d = model.decide("pushdown", sig, ("pushdown", "local"), static_arm)
+        if q is not None:
+            model.defer(q, d)
+        if d.arm == "local":
+            return None  # keep the single big device reduce
         return leaves
 
     def _mat_Aggregate(self, plan: lp.Aggregate, q) -> ExecPlan:
         inner = self._walk(plan.vector, q)
         params = tuple(p for p in plan.params)
-        leaves = self._agg_pushdown_leaves(plan, inner)
+        leaves = self._agg_pushdown_leaves(plan, inner, q)
         if leaves is not None:
             PUSHDOWN_APPLIED.inc()
             for c in leaves:

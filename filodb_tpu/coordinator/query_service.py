@@ -409,8 +409,15 @@ class QueryService:
             cost = RULES
         else:
             hint = getattr(self.planner, "cost_hint", None)
-            cost = (hint(plan) if hint is not None else None) \
-                or _admission_cost(plan)
+            forced = hint(plan) if hint is not None else None
+            cost = forced or _admission_cost(plan)
+            if forced is None:
+                # learned classing: predicted wall time for this plan's
+                # signature class replaces the start==end shape heuristic
+                # once warm (cold model returns the static class)
+                from filodb_tpu.coordinator import adaptive_planner
+                cost = adaptive_planner.admission_class(
+                    self.dataset, plan, qcontext, cost)
         t_admit = time.perf_counter()
         with governor().admit(deadline=deadline, cost=cost,
                               tenant=plan_tenant(plan)):
@@ -446,6 +453,9 @@ class QueryService:
                     data = apply_result_budget(data, shim)
                     stats.wall_time_s = time.perf_counter() - t0
                     stats.result_series = data.num_series
+                    from filodb_tpu.coordinator import adaptive_planner
+                    adaptive_planner.settle_query(
+                        self.dataset, qcontext, stats.wall_time_s, cost)
                     return self._attach_recovery_warnings(
                         QueryResult(data, stats, qcontext.query_id,
                                     partial=shim.partial,
@@ -476,6 +486,9 @@ class QueryService:
                     result.warnings = list(ctx.warnings)
         result.stats.wall_time_s = time.perf_counter() - t0
         result.stats.result_series = result.result.num_series
+        from filodb_tpu.coordinator import adaptive_planner
+        adaptive_planner.settle_query(
+            self.dataset, qcontext, result.stats.wall_time_s, cost)
         if result.partial:
             partial_results.inc()
         return self._attach_recovery_warnings(result)

@@ -161,6 +161,21 @@ class MetaStore:
         cps = self.read_checkpoints(dataset, shard)
         return min(cps.values()) if cps else -1
 
+    # ---- cost-model snapshots (query/cost_model.py) ----------------------
+    # Learned per-(dataset, plan-signature) cost estimates persist next to
+    # the ingestion checkpoints so restarts keep their calibration instead
+    # of re-learning from cold. Same durability contract as migration
+    # manifests: durable backends override with real persistence; the
+    # in-process default keeps blobs in a dict.
+
+    def write_cost_model(self, dataset: str, data: bytes) -> None:
+        if not hasattr(self, "_cost_models"):
+            self._cost_models = {}
+        self._cost_models[dataset] = data
+
+    def read_cost_model(self, dataset: str) -> bytes | None:
+        return getattr(self, "_cost_models", {}).get(dataset)
+
 
 class NullColumnStore(ColumnStore):
     """Discards chunks; for tests/benchmarks (reference ``NullColumnStore``)."""

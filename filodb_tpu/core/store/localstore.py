@@ -296,5 +296,24 @@ class LocalDiskMetaStore(MetaStore):
         c = self._db.conn(dataset, shard)
         return dict(c.execute("SELECT grp, offset FROM checkpoints"))
 
+    # cost-model snapshots: atomic-replace file beside the dataset's shard
+    # dbs, so learned estimates survive a restart (query/cost_model.py)
+    def write_cost_model(self, dataset, data):
+        d = os.path.join(self._db.root, dataset)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "costmodel.json")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_cost_model(self, dataset):
+        path = os.path.join(self._db.root, dataset, "costmodel.json")
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
     def close(self):
         self._db.close()

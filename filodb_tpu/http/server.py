@@ -501,6 +501,20 @@ class HttpDispatcher:
                 entries = entries[:limit]
             return self._json(200, {"status": "success",
                                     "data": {"slow_queries": entries}})
+        if rest == ["debug", "costmodel"]:
+            # adaptive-planner introspection: per-site estimates with
+            # warm state, calibration error, and recent predicted-vs-
+            # actual pairs (served by `filo-cli coststats`)
+            from filodb_tpu.query import cost_model
+            model = cost_model.model_for(svc.dataset)
+            snap = model.snapshot()
+            try:
+                limit = int(qs.get("limit", ["0"])[0])
+            except ValueError:
+                limit = 0
+            if limit > 0:
+                snap["estimates"] = snap["estimates"][:limit]
+            return self._json(200, {"status": "success", "data": snap})
         return self._json(404, promjson.error_json("unknown endpoint"))
 
     def _remote_read(self, parts: list[str], body: bytes):

@@ -120,6 +120,7 @@ class AdaptiveQueryEngine:
         self._single_checked = False
         self._cost: dict[tuple, _LaneCost] = {}
         self._calls = 0
+        self._dataset = ""  # bound on first execute; keys the shared model
         self.sync_floor_s: float | None = None
         self.routed = {"device": 0, "single": 0, "host": 0}
         self.shadowed = {"device": 0, "single": 0, "host": 0}
@@ -226,8 +227,14 @@ class AdaptiveQueryEngine:
         return min(known, key=known.get)
 
     def _record(self, lane: str, n_queries: int, secs: float) -> None:
-        self._cost_of(lane, _bucket(n_queries)).record(
-            secs / max(n_queries, 1))
+        per_q = secs / max(n_queries, 1)
+        b = _bucket(n_queries)
+        self._cost_of(lane, b).record(per_q)
+        # mirror into the shared cost model ("lane" decision site): same
+        # EWMA semantics, but there the estimates persist through the
+        # metastore and surface in coststats/calibration metrics
+        from filodb_tpu.query import cost_model as cm
+        cm.model_for(self._dataset).observe("lane", f"b{b}", lane, per_q)
 
     # -- shadow probing --
 
@@ -286,15 +293,38 @@ class AdaptiveQueryEngine:
 
     # -- execution --
 
+    def _shared_decision(self, lane: str, n_queries: int):
+        """PR 14's local router stays authoritative while the shared model
+        is cold (its pick is the decision's *static* arm); once the shared
+        model has min_samples on every lane — mirrored serves, shadow
+        probes, or estimates restored from the metastore — its
+        predicted-cheapest lane wins. Identical update rules mean the two
+        agree whenever both are warm, so behavior only changes when
+        persistence knows something the fresh process doesn't."""
+        lanes = self._lanes()
+        if len(lanes) == 1:
+            return lane, None, None
+        from filodb_tpu.query import cost_model as cm
+        model = cm.model_for(self._dataset)
+        d = model.decide("lane", f"b{_bucket(n_queries)}", tuple(lanes),
+                         static_arm=lane)
+        # settle: the caller records the serve through record_actual
+        # (observe=False; _record already mirrored the sample)
+        return d.arm, d, model
+
     def execute(self, memstore, dataset: str, plan, stats=None):
-        lane = self._route(1)
+        self._dataset = dataset
+        lane, d, model = self._shared_decision(self._route(1), 1)
         eng = self._engine_for(lane)
         t0 = time.perf_counter()
         out = eng.execute(memstore, dataset, plan, stats)
         if out is not None:
             # the lane's true cost includes the result sync
             out.materialize()
-            self._record(lane, 1, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._record(lane, 1, dt)
+            if d is not None:
+                model.record_actual(d, dt, observe=False)
             self.routed[lane] += 1
             _M_ROUTED[lane].inc()
             self._maybe_shadow(lane, [plan], memstore, dataset)
@@ -302,7 +332,9 @@ class AdaptiveQueryEngine:
 
     def execute_many(self, plans: list, memstore, dataset: str,
                      stats_list: list | None = None) -> list:
-        lane = self._route(len(plans))
+        self._dataset = dataset
+        lane, d, model = self._shared_decision(self._route(len(plans)),
+                                               len(plans))
         eng = self._engine_for(lane)
         t0 = time.perf_counter()
         outs = eng.execute_many(plans, memstore, dataset, stats_list)
@@ -310,7 +342,11 @@ class AdaptiveQueryEngine:
         if done:
             for o in done:
                 o.materialize()
-            self._record(lane, len(done), time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self._record(lane, len(done), dt)
+            if d is not None:
+                model.record_actual(d, dt / max(len(done), 1),
+                                    observe=False)
             self.routed[lane] += 1
             _M_ROUTED[lane].inc()
             self._maybe_shadow(lane, plans, memstore, dataset)

@@ -380,12 +380,28 @@ def _node_sketch(n: _Node, col, shard, p, acc) -> np.ndarray:
 
 
 def _eval_cold_quantile(sparts, col, q, t0s, t1s, shard, decode_mode,
-                        acc) -> np.ndarray:
+                        acc, ctx=None) -> np.ndarray:
     from filodb_tpu.memory.chunk import SKETCH_BUCKETS, _sketch_values
     from filodb_tpu.query.engine.aggregations import sketch_quantile
     P, W = len(sparts), len(t0s)
     gate = sl._sealed_gate()
-    if gate > 0 and P * W > gate:
+    static_serve = not (gate > 0 and P * W > gate)
+    serve = static_serve
+    if ctx is not None:
+        # learned pyramid-vs-decode for the cold sketch-merge path; the
+        # amortization gate stays the static arm, <=0 the serve override
+        from filodb_tpu.query import cost_model as cm
+        model = cm.model_for(ctx.dataset)
+        d = model.decide(
+            "pyramid",
+            f"quantile:pw{cm.bucket(P * W)}",
+            ("pyramid", "decode"),
+            static_arm="pyramid" if static_serve else "decode",
+            override="pyramid" if gate <= 0 else None,
+        )
+        model.defer(ctx, d)
+        serve = d.arm == "pyramid"
+    if not serve:
         raise sl._Bypass
     out = np.full((P, W), np.nan)
     samples = 0
@@ -438,6 +454,18 @@ def execute_cold(plan, ctx, psm, fn, parts, shard, decode_mode: bool,
     for p in parts:
         if not isinstance(p, ColdPartition):
             raise sl._Bypass
+    # pyramid-vs-decode as a learned decision: composing stored roll-ups
+    # is the static arm (it pages zero payload), but once settled wall
+    # times show payload decode is cheaper for this partition-count class
+    # (e.g. tiny scans on a warm ODP cache) the model may route around
+    # the pyramid compose entirely
+    from filodb_tpu.query import cost_model as cm
+    _model = cm.model_for(ctx.dataset)
+    _d = _model.decide("pyramid", f"cold:parts{cm.bucket(len(parts))}",
+                       ("pyramid", "decode"), static_arm="pyramid")
+    _model.defer(ctx, _d)
+    if _d.arm == "decode":
+        raise sl._Bypass
     steps = steps_array(psm.start, psm.step, psm.end)
     eval_steps = (steps - psm.offset).astype(np.int64)
     window = int(psm.window if psm.function else 300_000)
@@ -463,7 +491,7 @@ def execute_cold(plan, ctx, psm, fn, parts, shard, decode_mode: bool,
             if fn == "quantile_over_time":
                 out = _eval_cold_quantile(sparts, col,
                                           float(psm.params[0]), t0s, t1s,
-                                          shard, decode_mode, acc)
+                                          shard, decode_mode, acc, ctx)
             else:
                 st = np.zeros((len(sparts), len(t0s), STATS_WIDTH),
                               np.float64)

@@ -149,6 +149,33 @@ def _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W: int) -> bool:
     return skipped >= _SEALED_MIN_SKIPPED_SAMPLES
 
 
+def _sealed_arm(sparts, sealed_overlap, t0s, t1s, W: int, ctx) -> bool:
+    """Sidecar-vs-decode as a learned decision ("sidecar" site): the
+    geometry heuristic (:func:`_sealed_fold_pays`) stays the static arm,
+    and once the cost model has settled wall times for BOTH arms of this
+    partition-window signature class the predicted-cheaper arm wins.
+    ``FILODB_SIDECAR_SEALED_GATE<=0`` remains a hard always-serve valve
+    (override). The decision defers onto ``ctx`` and settles with the
+    leaf's evaluation wall time back in the exec leaf."""
+    n_sealed = int(sealed_overlap.sum())
+    if n_sealed == 0:
+        return True  # nothing sealed: the fold is trivially the buffer read
+    static_serve = _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W)
+    if ctx is None:
+        return static_serve
+    from filodb_tpu.query import cost_model as cm
+    model = cm.model_for(ctx.dataset)
+    d = model.decide(
+        "sidecar",
+        f"fold:pw{cm.bucket(n_sealed * W)}",
+        ("sidecar", "decode"),
+        static_arm="sidecar" if static_serve else "decode",
+        override="sidecar" if _sealed_gate() <= 0 else None,
+    )
+    model.defer(ctx, d)
+    return d.arm == "sidecar"
+
+
 def covers_fn(fn: str) -> bool:
     """Would the lane serve this range function (mesh prepare-stage
     precheck)? quantile only under declared approximation."""
@@ -578,6 +605,12 @@ def try_execute(plan, ctx):
         return _execute(plan, ctx, psm, fn, m == "decode", approx)
     except _Bypass:
         SIDECAR_BYPASSED.inc()
+        # the decode lane serves this leaf now: any pending lane decision
+        # whose chosen arm didn't run settles under "decode" instead, with
+        # its prediction dropped from calibration
+        from filodb_tpu.query.cost_model import CostModel
+        CostModel.relabel_deferred(ctx, "sidecar", "decode")
+        CostModel.relabel_deferred(ctx, "pyramid", "decode")
         return None
 
 
@@ -646,10 +679,10 @@ def _execute(plan, ctx, psm, fn, decode_mode: bool, approx: bool):
             if fn == "quantile_over_time":
                 out = _eval_group_quantile(
                     sparts, col, float(psm.params[0]), t0s, t1s,
-                    decode_mode, stats_acc)
+                    decode_mode, stats_acc, ctx)
             else:
                 st = _eval_group_stats(sparts, col, t0s, t1s,
-                                       decode_mode, stats_acc)
+                                       decode_mode, stats_acc, ctx)
                 stats_acc["samples"] = stats_acc.get("samples", 0.0) \
                     + float(st[:, :, S_COUNT].sum())
                 out = formula(fn, st, eval_steps.astype(np.float64),
@@ -689,7 +722,7 @@ def _buf_rows_python(p, col: int, t0s, t1s) -> np.ndarray:
 
 
 def _eval_group_stats(sparts, col: int, t0s, t1s, decode_mode: bool,
-                      stats_acc: dict) -> np.ndarray:
+                      stats_acc: dict, ctx=None) -> np.ndarray:
     """Merged stats tensor [P, W, 12] for one schema group."""
     from filodb_tpu.core.memstore.native_shard import NativeBackedPartition
     P, W = len(sparts), len(t0s)
@@ -726,7 +759,7 @@ def _eval_group_stats(sparts, col: int, t0s, t1s, decode_mode: bool,
         for j, i in enumerate(idxs):
             buf_rows[i] = rows[j]
             sealed_overlap[i] = bool(flags[j] & 2)
-    if not _sealed_fold_pays(sparts, sealed_overlap, t0s, t1s, W):
+    if not _sealed_arm(sparts, sealed_overlap, t0s, t1s, W, ctx):
         raise _Bypass  # sealed fold wouldn't amortize — decode lane wins
     sealed_idx = []
     for i, p in enumerate(sparts):
@@ -881,7 +914,8 @@ def _eval_sealed_batch(sparts, sealed_idx, col: int, st, t0s, t1s,
 
 
 def _eval_group_quantile(sparts, col: int, q: float, t0s, t1s,
-                         decode_mode: bool, stats_acc: dict) -> np.ndarray:
+                         decode_mode: bool, stats_acc: dict,
+                         ctx=None) -> np.ndarray:
     """Approximate quantile_over_time from mergeable sketches (declared
     approximation: FILODB_SIDECAR_APPROX=1). Interior chunks contribute
     their stored sketches; edge/buffer slices are sketched from values."""
@@ -889,7 +923,23 @@ def _eval_group_quantile(sparts, col: int, q: float, t0s, t1s,
     from filodb_tpu.query.engine.aggregations import sketch_quantile
     P, W = len(sparts), len(t0s)
     gate = _sealed_gate()
-    if gate > 0 and P * W > gate:
+    static_serve = not (gate > 0 and P * W > gate)
+    serve = static_serve
+    if ctx is not None:
+        # learned sidecar-vs-decode for the sketch-merge path, same
+        # decision site as the stats fold (valve override preserved)
+        from filodb_tpu.query import cost_model as cm
+        model = cm.model_for(ctx.dataset)
+        d = model.decide(
+            "sidecar",
+            f"quantile:pw{cm.bucket(P * W)}",
+            ("sidecar", "decode"),
+            static_arm="sidecar" if static_serve else "decode",
+            override="sidecar" if gate <= 0 else None,
+        )
+        model.defer(ctx, d)
+        serve = d.arm == "sidecar"
+    if not serve:
         raise _Bypass  # per-window sketch merge wouldn't amortize
     out = np.full((P, W), np.nan)
     samples = 0

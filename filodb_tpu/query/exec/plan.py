@@ -197,8 +197,13 @@ class SelectRawPartitionsExec(ExecPlan):
         # inside the SAME span, so distributed trace trees keep exactly
         # one scan per shard
         with span("scan", shard=self.shard):
+            t_scan = time.perf_counter()
             data = sidecar_lane.try_execute(self, ctx)
             outs = None if data is not None else self._scan_batches(ctx)
+            # settle any lane decisions (sidecar/pyramid/paging) the scan
+            # deferred onto the context with the arm's observed wall time
+            from filodb_tpu.query.cost_model import CostModel
+            CostModel.settle_deferred(ctx, time.perf_counter() - t_scan)
         if data is not None:
             with span("reduce"):
                 t0 = time.perf_counter()

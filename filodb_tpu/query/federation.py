@@ -156,8 +156,25 @@ class ColdPartition:
 
     def read_samples(self, start, end, col=None, extra_chunks=None):
         from filodb_tpu.core.memstore.partition import TimeSeriesPartition
+        from filodb_tpu.query import cost_model as cm
+
+        # paging granularity as a learned decision ("paging" site):
+        # "exact" pages precisely the queried window (static arm);
+        # "wide" doubles it so adjacent dashboard panels and step-scrolled
+        # repeats hit the ODP range memo instead of paying another store
+        # round trip. Decided and settled inline — the arm's cost is the
+        # load itself.
+        span = max(1, int(end) - int(start))
+        model = cm.model_for(self._shard.dataset)
+        d = model.decide("paging", f"page:span{cm.bucket(span // 60_000)}",
+                         ("exact", "wide"), static_arm="exact")
+        load_start, load_end = start, end
+        if d.arm == "wide":
+            load_start, load_end = start - span // 2, end + span // 2
+        t0 = time.perf_counter()
         chunks = self._shard.odp_cache.get_or_load(self._shard, self,
-                                                   start, end)
+                                                   load_start, load_end)
+        model.record_actual(d, time.perf_counter() - t0)
         self.chunks_read = len(chunks)
         tmp = TimeSeriesPartition(self.part_id, self.part_key, self.schema)
         tmp.chunks = list(chunks)

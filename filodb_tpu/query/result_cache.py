@@ -65,6 +65,10 @@ cache_partial_hits = get_counter("filodb_result_cache_partial_hits")
 cache_evictions = get_counter("filodb_result_cache_evictions")
 cache_bytes = Gauge("filodb_result_cache_bytes")
 
+# Predicted recompute wall time below which an extent admits at low
+# priority (it's cheaper to recompute than the cache space it occupies).
+_CHEAP_RECOMPUTE_S = 0.002
+
 
 @dataclasses.dataclass
 class ResultCacheConfig:
@@ -234,6 +238,10 @@ class ResultCache:
             OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        # low-priority admissions: extents that are cheap to recompute
+        # (pyramid-served, or predicted-cheap by the cost model) evict
+        # before any payload-decoding entry under byte pressure
+        self._cheap: set = set()
 
     @staticmethod
     def from_config(cfg) -> "ResultCache | None":
@@ -271,7 +279,8 @@ class ResultCache:
             self._lru.move_to_end(key)
             return entry[1]
 
-    def _put(self, key: tuple, stamp: int | None, m: StepMatrix) -> None:
+    def _put(self, key: tuple, stamp: int | None, m: StepMatrix,
+             cheap: bool = False) -> None:
         nb = _matrix_nbytes(m)
         if nb > self.config.max_bytes:
             return  # larger than the whole budget: don't thrash
@@ -279,10 +288,24 @@ class ResultCache:
             old = self._lru.pop(key, None)
             if old is not None:
                 self._bytes -= _matrix_nbytes(old[1])
+            self._cheap.discard(key)
             self._lru[key] = (stamp, m)
             self._bytes += nb
+            if cheap:
+                self._cheap.add(key)
             while self._bytes > self.config.max_bytes and self._lru:
-                _, (_, ev) = self._lru.popitem(last=False)
+                # cheap-to-recompute entries go first (oldest cheap entry),
+                # then plain LRU order — a payload-decoding extent outlives
+                # every pyramid-served one under byte pressure
+                ev_key = None
+                if self._cheap:
+                    ev_key = next((k for k in self._lru if k in self._cheap),
+                                  None)
+                if ev_key is None:
+                    ev_key, (_, ev) = self._lru.popitem(last=False)
+                else:
+                    _, ev = self._lru.pop(ev_key)
+                self._cheap.discard(ev_key)
                 self._bytes -= _matrix_nbytes(ev)
                 cache_evictions.inc()
             cache_bytes.set(self._bytes)
@@ -290,6 +313,7 @@ class ResultCache:
     def clear(self) -> None:
         with self._lock:
             self._lru.clear()
+            self._cheap.clear()
             self._bytes = 0
             cache_bytes.set(0)
 
@@ -375,7 +399,21 @@ class ResultCache:
                         cache_misses.inc(misses)
                         cache_hits.inc(hits)
                         return svc._execute_uncached(plan, qcontext)
-                    self._put(key, stamp, r.result)
+                    # admission priority by recompute cost, not byte size:
+                    # the "cache" decision site learns each signature's
+                    # recompute wall time; predicted-cheap extents — and
+                    # pyramid-served ones, whose windows re-fold from
+                    # stored roll-ups without paging payload — admit at
+                    # low priority and evict first
+                    from filodb_tpu.query import cost_model as cm
+                    model = cm.model_for(svc.dataset)
+                    d = model.classify(
+                        "cache", sig, _CHEAP_RECOMPUTE_S,
+                        below_arm="cheap", above_arm="keep",
+                        static_arm="keep")
+                    model.record_actual(d, r.stats.wall_time_s)
+                    cheap = d.arm == "cheap" or bool(r.stats.pyramid)
+                    self._put(key, stamp, r.result, cheap=cheap)
                     m = r.result
                     # fold the full expanded counters (incl. per-tier
                     # federation buckets), not just the scan totals

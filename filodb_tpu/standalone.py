@@ -412,6 +412,13 @@ class FiloServer:
                     result_cache=cfg.result_cache)
                 self.cluster.on_heartbeat.append(
                     lambda n=name: poll_remote_statuses(self.cluster, n))
+            # adaptive planner: load persisted per-dataset cost estimates
+            # and register the live retry-after provider before any query
+            # admission happens — restarts keep learned routing
+            from filodb_tpu.coordinator import adaptive_planner
+            for name in cfg.datasets:
+                adaptive_planner.install(name, self.meta_store,
+                                         cfg.cost_model)
             self.cluster.start_failure_detector()
             # standing queries: one RuleManager per dataset with groups,
             # writing outputs through the shard WAL (first-class series)
@@ -819,6 +826,15 @@ class FiloServer:
         if self.store_server is not None:
             self.store_server.shutdown()
         self.column_store.close()
+        if getattr(self, "is_coordinator", False):
+            # learned cost estimates survive restarts via the metastore
+            from filodb_tpu.coordinator import adaptive_planner
+            for name in getattr(self.config, "datasets", {}) or {}:
+                try:
+                    adaptive_planner.persist(name, self.meta_store)
+                except Exception:
+                    log.debug("cost-model persist failed for %s", name,
+                              exc_info=True)
         self.meta_store.close()
 
 

@@ -159,6 +159,37 @@ def configure(**kw) -> GovernorConfig:
     return _config
 
 
+# Optional live Retry-After source (coordinator/adaptive_planner.py): maps
+# a shed reason to an advisory delay learned from settled per-class
+# latency percentiles. Returning None (or raising nothing useful) falls
+# back to the static ``retry_after_s`` constant, so a cold model keeps
+# today's behavior bit-for-bit.
+_retry_after_provider = None
+
+
+def set_retry_after_provider(fn) -> None:
+    global _retry_after_provider
+    _retry_after_provider = fn
+
+
+def _advised_retry_after(reason: str, static_s: float) -> float:
+    fn = _retry_after_provider
+    if fn is None:
+        return static_s
+    try:
+        v = fn(reason)
+    except Exception:
+        return static_s
+    if v is None:
+        return static_s
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return static_s
+    # clamp: advisory backoff should never be absurd even if the model is
+    return min(max(v, 0.05), 60.0)
+
+
 # ---------------------------------------------------------------------------
 # query budget
 
@@ -362,7 +393,8 @@ class ResourceGovernor:
     def _reject(self, reason: str, detail: str) -> None:
         _rejected[reason].inc()
         raise QueryRejected(f"query shed ({reason}): {detail}",
-                            retry_after_s=self.cfg.retry_after_s,
+                            retry_after_s=_advised_retry_after(
+                                reason, self.cfg.retry_after_s),
                             reason=reason)
 
     @contextmanager
@@ -585,7 +617,8 @@ def governor() -> ResourceGovernor:
 
 def reset() -> None:
     """Fresh governor + default config (tests)."""
-    global _governor
+    global _governor, _retry_after_provider
     with _governor_lock:
         _config.__dict__.update(GovernorConfig().__dict__)
         _governor = ResourceGovernor(_config)
+        _retry_after_provider = None

@@ -35,3 +35,19 @@ _xb._backend_factories.pop("axon", None)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _cold_cost_models():
+    """Every test starts with a cold cost model: learned-routing state is
+    process-global (query/cost_model.py), and a model warmed by one test
+    must never flip a decision site's arm in another — static behavior is
+    the contract while cold. Tests that exercise warm routing seed their
+    own observations after this reset."""
+    from filodb_tpu.query import cost_model
+    cost_model.reset_models()
+    yield
+    cost_model.reset_models()

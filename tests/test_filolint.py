@@ -25,6 +25,7 @@ from filodb_tpu.analysis import (
 from filodb_tpu.analysis import (
     chokepoint,
     cli,
+    decisionparity,
     hotpath,
     lifecycle,
     lockdiscipline,
@@ -906,6 +907,98 @@ class TestChokepoint:
                     return resp
             """})
         assert out == []
+
+
+# --------------------------------------------------------------------------
+# DC601 adaptive-decision settle parity
+
+class TestDecisionParity:
+    def test_unsettled_decide_flagged(self, tmp_path):
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def route(model, sig):
+                d = model.decide("sidecar", sig, ("a", "b"), "a")
+                return "x"
+            """})
+        assert codes(out) == ["DC601"]
+
+    def test_unsettled_classify_flagged(self, tmp_path):
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def classed(model, sig):
+                d = model.classify("admit", sig, 0.05, "cheap",
+                                   "expensive", "cheap")
+                return d.arm == "cheap"
+            """})
+        # returning d.arm counts as a return hand-off of d — so settle
+        # the bare comparison case by NOT binding d in the return
+        assert codes(out) == []
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def classed(model, sig):
+                d = model.classify("admit", sig, 0.05, "cheap",
+                                   "expensive", "cheap")
+                arm = d.arm
+                return "ok"
+            """})
+        assert codes(out) == ["DC601"]
+
+    def test_record_actual_settles(self, tmp_path):
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def route(model, sig, elapsed):
+                d = model.decide("paging", sig, ("exact", "wide"), "exact")
+                model.record_actual(d, elapsed)
+                return d.arm
+            """})
+        assert out == []
+
+    def test_defer_settles(self, tmp_path):
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def route(model, ctx, sig):
+                d = model.decide("sidecar", sig, ("a", "b"), "a")
+                model.defer(ctx, d)
+                return d.arm == "a"
+            """})
+        assert out == []
+
+    def test_return_hand_off_settles(self, tmp_path):
+        # the lane-router shape: the decision rides out in a tuple and
+        # the caller owns the settle
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def shared_decision(model, lanes, lane, sig):
+                d = model.decide("lane", sig, tuple(lanes), lane)
+                return d.arm, d, model
+            """})
+        assert out == []
+
+    def test_closure_checked_independently(self, tmp_path):
+        # a settle in the enclosing function does not excuse a decide
+        # trapped inside a closure that never settles
+        out = run_pass(tmp_path, decisionparity, {"filodb_tpu/m.py": """
+            def outer(model, sig, elapsed):
+                def inner():
+                    d = model.decide("sidecar", sig, ("a", "b"), "a")
+                    return "x"
+                other = model.decide("paging", sig, ("a", "b"), "a")
+                model.record_actual(other, elapsed)
+                return inner
+            """})
+        assert codes(out) == ["DC601"]
+        assert out[0].symbol == "outer.inner"
+
+    def test_cost_model_module_exempt(self, tmp_path):
+        out = run_pass(tmp_path, decisionparity, {
+            "filodb_tpu/query/cost_model.py": """
+            def helper(self, sig):
+                d = self.decide("sidecar", sig, ("a", "b"), "a")
+                return "x"
+            """})
+        assert out == []
+
+    def test_inline_suppression(self, tmp_path):
+        root = write_tree(tmp_path, {"filodb_tpu/m.py": """
+            def route(model, sig):
+                d = model.decide("sidecar", sig, ("a", "b"), "a")  # filolint: disable=DC601
+                return "x"
+            """})
+        assert run_all(root, passes=[decisionparity]) == []
 
 
 # --------------------------------------------------------------------------

@@ -300,6 +300,18 @@ MESH_NAMES = [
 ]
 
 
+# trace-driven adaptive planner (query/cost_model.py) — decision sources,
+# settle counts, calibration error, signature-table occupancy; registered
+# at cost_model import (QueryService admission path at boot)
+COSTMODEL_NAMES = [
+    "filodb_costmodel_decisions_total",
+    "filodb_costmodel_settled_total",
+    "filodb_costmodel_calibration_error",
+    "filodb_costmodel_signatures",
+    "filodb_costmodel_evictions_total",
+]
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -440,6 +452,12 @@ class TestMetricsScrape:
         # the first mesh-eligible query
         missing_mesh = [n for n in MESH_NAMES if n not in names_present]
         assert not missing_mesh, f"missing mesh metrics: {missing_mesh}"
+
+        # adaptive-planner cost model: decision/settle counters and
+        # calibration gauges pre-register at cost_model import (pulled in
+        # by the query-service admission path at boot)
+        missing_cm = [n for n in COSTMODEL_NAMES if n not in names_present]
+        assert not missing_cm, f"missing costmodel metrics: {missing_cm}"
 
         # shard-replication + hedged-read families render at zero before
         # any replica set is configured
