@@ -207,12 +207,15 @@ def build_service(engine: str | None = None):
                         engine=engine), keys
 
 
-def build_big_service(engine: str):
+def build_big_store():
     """Big-scan store, loaded via the bulk chunk path: per-sample Python
     ingest of ~12M records would dominate the bench's wall clock, and this
     section measures QUERY cost (the headline section exercises the real
-    ingest path)."""
-    from filodb_tpu.coordinator.query_service import QueryService
+    ingest path).
+
+    Everything here is seeded/deterministic, so N mesh worker processes
+    started with ``--seed bench:build_big_store`` rebuild bit-identical
+    per-shard data — benchmarks/multiproc_mesh.py depends on that."""
     from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
     from filodb_tpu.core.partkey import PartKey
     from filodb_tpu.core.store.config import StoreConfig
@@ -240,6 +243,13 @@ def build_big_service(engine: str):
             part.chunks.append(encode_chunk(
                 part.schema, ts[c0:c1], [vals[c0:c1]], len(part.chunks)))
         shard.stats.rows_ingested.inc(BIG_SAMPLES)  # data_version stamp
+    return ms
+
+
+def build_big_service(engine: str):
+    from filodb_tpu.coordinator.query_service import QueryService
+
+    ms = build_big_store()
     return QueryService(ms, "timeseries", NUM_SHARDS, spread=1,
                         engine=engine)
 

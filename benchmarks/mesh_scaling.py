@@ -57,7 +57,10 @@ def child(n_devices: int) -> dict:
     """Runs inside a process whose backend exposes ``n_devices`` devices."""
     import bench
 
-    bench._force_cpu()
+    # the parent already ran the accelerator probe once for the whole
+    # sweep; this either short-circuits on FILODB_BENCH_CPU or hits the
+    # fresh TTL outcome cache — never a per-width re-probe
+    bench._ensure_backend()
     import jax
 
     assert len(jax.devices()) >= n_devices, (
@@ -88,14 +91,24 @@ def child(n_devices: int) -> dict:
 
 
 def run_sweep(devices=DEFAULT_DEVICES) -> dict:
-    """Spawn one child per mesh width and aggregate the curve."""
+    """Spawn one child per mesh width and aggregate the curve.
+
+    The accelerator probe runs AT MOST ONCE per sweep: the parent probes
+    here (writing bench's TTL outcome cache), and each child then either
+    skips probing entirely (CPU outcome → ``FILODB_BENCH_CPU=1``) or
+    reads the just-written cache — BENCH_r05 burned ~16 minutes when
+    every width re-probed a dead tunnel."""
+    import bench
+
+    platform, _ = bench._ensure_backend()
     curve = []
     for n in devices:
         env = dict(os.environ)
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                             f" --xla_force_host_platform_device_count={n}")
-        env["JAX_PLATFORMS"] = "cpu"
-        env["FILODB_BENCH_CPU"] = "1"
+        if platform == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["FILODB_BENCH_CPU"] = "1"
         env.pop("FILODB_MESH_SPLIT", None)
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child", str(n)],

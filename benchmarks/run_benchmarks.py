@@ -528,6 +528,12 @@ def _bench_adaptive():
     return bench_adaptive()
 
 
+def _bench_multiproc_mesh():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from multiproc_mesh import run_sweep
+    return run_sweep()
+
+
 def _bench_mesh_scaling(devices=None):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from mesh_scaling import DEFAULT_DEVICES, run_sweep
@@ -561,6 +567,7 @@ ALL = {
     "pyramid_topk_1m": _bench_pyramid_topk_1m,
     "adaptive": _bench_adaptive,
     "mesh_scaling": _bench_mesh_scaling,
+    "multiproc_mesh": _bench_multiproc_mesh,
 }
 
 

@@ -7,11 +7,14 @@ and that nothing previously stopped a new call site from bypassing.
   self-calls included) performs blocking work must reference a
   ``deadline`` somewhere in that closure. A dispatcher that blocks on
   the network without consulting ``ctx.deadline`` turns one slow peer
-  into an unbounded client hang.
+  into an unbounded client hang. The multi-process mesh transport's
+  ``exec_descriptors`` is a second network entry point on the same
+  class family and is held to the same proof.
 - **CP502 governor-admission bypass** — outside the plan-tree internals
   (``filodb_tpu/query/``, ``filodb_tpu/parallel/``, which sit *below*
   the admission gate), any ``<x>.dispatcher.dispatch(...)`` call,
-  mesh-engine ``execute*`` call, or raw ``<x>.do_execute(...)`` call
+  mesh-engine or mesh-cluster ``execute*`` call, or raw
+  ``<x>.do_execute(...)`` call
   must be lexically inside a ``with ...admit(...)`` scope. Entry paths
   that skip governor admission starve the overload protections the
   soak tests exercise. ``query/federation.py`` is carved OUT of the
@@ -142,21 +145,30 @@ def _closure_scan(cdef: ast.ClassDef, method: str, memo: dict,
     return memo[method]
 
 
+# dispatcher entry points that take network-bound work on behalf of a
+# query: the classic plan-tree dispatch plus the multi-process mesh
+# transport's descriptor fan-out
+DISPATCH_ENTRY_METHODS = ("dispatch", "exec_descriptors")
+
+
 def _check_cp501(ps: "_PassState", ctx: AnalysisContext) -> None:
     for mi, cdef in _dispatcher_classes(ctx):
-        if "dispatch" not in _methods(cdef):
-            continue
-        blocking, deadline = _closure_scan(cdef, "dispatch", {}, set())
-        if blocking and not deadline:
-            line, desc = blocking[0]
-            ps.finding(
-                "CP501", mi.path, line, f"{cdef.name}.dispatch",
-                detail=desc,
-                message=(f"dispatch blocks on {desc} but never "
-                         f"references a deadline anywhere in its call "
-                         f"closure: one slow peer hangs the caller "
-                         f"unboundedly (thread the ctx.deadline budget "
-                         f"into the blocking call)"))
+        methods = _methods(cdef)
+        for entry in DISPATCH_ENTRY_METHODS:
+            if entry not in methods:
+                continue
+            blocking, deadline = _closure_scan(cdef, entry, {}, set())
+            if blocking and not deadline:
+                line, desc = blocking[0]
+                ps.finding(
+                    "CP501", mi.path, line, f"{cdef.name}.{entry}",
+                    detail=desc,
+                    message=(f"{entry} blocks on {desc} but never "
+                             f"references a deadline anywhere in its "
+                             f"call closure: one slow peer hangs the "
+                             f"caller unboundedly (thread the "
+                             f"ctx.deadline budget into the blocking "
+                             f"call)"))
 
 
 # --------------------------------------------------------------------------
@@ -180,6 +192,10 @@ def _is_gated_call(call: ast.Call) -> str | None:
             and fn.value.attr == "dispatcher":
         return f"{_src(fn)}()"
     if fn.attr.startswith("execute") and "mesh_engine" in _src(fn.value):
+        return f"{_src(fn)}()"
+    # the multi-process mesh runtime fans a query out to worker
+    # processes: same admission contract as the in-process engine
+    if fn.attr.startswith("execute") and "mesh_cluster" in _src(fn.value):
         return f"{_src(fn)}()"
     # raw plan-node execution: calling do_execute bypasses BOTH the
     # admission gate and ExecPlan.execute's span/limit bookkeeping

@@ -127,6 +127,46 @@ def cmd_tiers(args):
     return 0
 
 
+def cmd_meshstat(args):
+    """Multi-process mesh runtime one-pager: per-worker mesh slice,
+    reachability/breaker state, device count, descriptor-cache occupancy,
+    and the last root-side collective latency
+    (``/api/v1/status/mesh``)."""
+    import urllib.request
+    with urllib.request.urlopen(
+            f"http://{args.host}/api/v1/status/mesh") as r:
+        d = json.load(r)["data"]
+    if args.json:
+        print(json.dumps(d, indent=2))
+        return 0
+    for ds, doc in d.items():
+        if not doc.get("multiproc"):
+            eng = doc.get("engine")
+            extra = (f" engine: hits={eng['hits']} misses={eng['misses']} "
+                     f"programs={eng['programs']}" if eng else "")
+            print(f"dataset={ds} multiproc=off{extra}")
+            continue
+        coll = doc.get("last_collective_s")
+        print(f"dataset={ds} multiproc=on enabled={doc['enabled']} "
+              f"shards={doc['num_shards']} "
+              f"last_collective_s="
+              f"{'-' if coll is None else f'{coll:.4f}'}")
+        print(f"{'WORKER':<22} {'SHARDS':>9} {'UP':>3} {'BREAKER':>9} "
+              f"{'DEVS':>5} {'DESCCACHE':>9} {'QUERIES':>8} "
+              f"{'LAST_EXEC_S':>11}")
+        for w in doc.get("workers", []):
+            lo, hi = w.get("shards", [0, 0])
+            last = w.get("last_exec_s")
+            print(f"{w['peer']:<22} {f'{lo}:{hi}':>9} "
+                  f"{('y' if w.get('reachable') else 'n'):>3} "
+                  f"{w.get('breaker', '?'):>9} "
+                  f"{str(w.get('devices', '-')):>5} "
+                  f"{str(w.get('descriptor_cache', '-')):>9} "
+                  f"{str(w.get('queries', '-')):>8} "
+                  f"{('-' if last is None else f'{last:.4f}'):>11}")
+    return 0
+
+
 def cmd_lag(args):
     """Ingest freshness one-pager: per-shard lag vs wall clock, replay-log
     offset/checkpoint lag, write-behind queue state, and rules watermark
@@ -607,6 +647,9 @@ def main(argv=None):
     p = sub.add_parser("tiers")
     p.add_argument("--json", action="store_true",
                    help="raw JSON instead of the formatted table")
+    p = sub.add_parser("meshstat")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON instead of the formatted table")
     sub.add_parser("shardmap")
     p = sub.add_parser("replicacheck")
     p.add_argument("--max-lag", type=int, default=0,
@@ -651,7 +694,7 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
     return {"init": cmd_init, "list": cmd_list, "status": cmd_status,
-            "lag": cmd_lag, "tiers": cmd_tiers,
+            "lag": cmd_lag, "tiers": cmd_tiers, "meshstat": cmd_meshstat,
             "shardmap": cmd_shardmap, "replicacheck": cmd_replicacheck,
             "rules": cmd_rules,
             "slowlog": cmd_slowlog,

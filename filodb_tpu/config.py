@@ -128,6 +128,21 @@ DEFAULTS = {
         "lag_threshold": 0,           # max replay-offset lag at flip
         "catchup_timeout_s": 30.0,    # abort CATCHUP after this long
     },
+    # multi-process mesh runtime (parallel/multiproc.py +
+    # coordinator/mesh_cluster.py): N worker processes each own a
+    # contiguous slice of one dataset's shard space and execute lowered
+    # mesh descriptors over per-process 1-device mesh slices; the
+    # coordinator reduces at window boundaries and falls back to the
+    # single-process engines when a slice is unavailable.
+    "mesh_workers": {
+        "enabled": False,
+        "workers": 2,                 # processes to spawn (N×1 harness)
+        "base_port": 0,               # 0 = ephemeral per worker
+        "dataset": None,              # None = first configured dataset
+        "timeout_s": 30.0,            # per-worker dispatch timeout cap
+        "ready_timeout_s": 120.0,     # boot wait before serving degraded
+        "seed": None,                 # module:callable harness data source
+    },
     # continuous shard replication / HA serving
     # (coordinator/replication.py)
     "replication": {
@@ -246,6 +261,7 @@ class ServerConfig:
     cost_model: dict = field(default_factory=dict)  # adaptive planner config
     store: dict = field(default_factory=dict)  # durable-store backend block
     migration: dict = field(default_factory=dict)  # live-migration knobs
+    mesh_workers: dict = field(default_factory=dict)  # multi-process mesh
     replication: dict = field(default_factory=dict)  # shard-replica knobs
     rules: dict = field(default_factory=dict)  # standing-query rule groups
     tracing: dict = field(default_factory=dict)  # TracingConfig overrides
@@ -298,6 +314,7 @@ class ServerConfig:
             cost_model=cfg.get("cost_model", {}),
             store=cfg.get("store", {}),
             migration=cfg.get("migration", {}),
+            mesh_workers=cfg.get("mesh_workers", {}),
             replication=cfg.get("replication", {}),
             rules=cfg.get("rules", {}),
             tracing=cfg.get("tracing", {}),

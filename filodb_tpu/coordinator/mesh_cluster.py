@@ -1,0 +1,431 @@
+"""Multi-process mesh runtime: root side of cluster-scale SPMD queries.
+
+PR 14's mesh engine proves the SPMD formulation on a single-process
+``(shard, time)`` mesh; this module is the cluster half the TPU-pod story
+needs (ROADMAP item 4). Instead of shipping exec-plan subtrees and
+gathering partial aggregates (``coordinator/remote.py``), the root lowers
+the plan ONCE and ships a :class:`LoweredDescriptor` — the plan signature,
+step grid, window, and mesh-axis assignment — to every mesh worker
+process. Each worker owns a contiguous slice of the shard space, runs the
+agg-stripped descriptor through its own ``MeshQueryEngine`` over a
+1-device-per-process mesh slice (device-resident batch/bounds/eval caches
+per process, PR 14 dkey semantics preserved), and returns per-series
+``[P_local, K]`` window evaluations. The root's reduce is then the same
+``make_mesh_group_reduce`` segment-sum the single-process engine runs —
+over the concatenation of worker blocks in shard order — so the grouped
+result is byte-identical to the single-process mesh engine (worker rows
+arrive in global part order; the baseline's padding rows contribute an
+exact ``+0.0`` at the segment tail).
+
+Degradation mirrors PR 1/4 semantics exactly: every worker call runs
+under its peer circuit breaker with deadline-derived timeouts; transport
+failure, an open breaker, or a stale worker slice makes
+:meth:`MeshClusterRuntime.execute_plan` return ``None`` and the caller
+(``QueryService``) falls through — inside the same admission scope — to
+the existing single-process mesh / partial-aggregation pushdown paths. A
+worker-side admission shed, by contrast, propagates as ``QueryRejected``
+(503 + Retry-After): overload is a healthy-peer verdict, not data loss.
+
+``FILODB_MULTIPROC=0`` disables routing entirely (cold-model parity: the
+single-process engine serves every query bit-for-bit as before).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from filodb_tpu.coordinator.remote import RemotePlanDispatcher
+from filodb_tpu.utils.metrics import Gauge, Histogram, get_counter
+from filodb_tpu.utils.resilience import (
+    CircuitOpenError,
+    FaultInjector,
+    breaker_for,
+    default_retry_policy,
+    record_peer_latency,
+)
+from filodb_tpu.utils.tracing import span
+
+log = logging.getLogger(__name__)
+
+# multi-process dispatch observability (tests/test_metrics_scrape.py pins
+# these families; module-level so a scrape sees them before the first
+# routed query — this module is imported at server boot via the wire
+# registry / query service).
+_M_PROC_DISPATCH = {o: get_counter("filodb_mesh_proc_dispatch",
+                                   {"outcome": o},
+                                   help="multi-process mesh dispatches by "
+                                   "outcome")
+                    for o in ("ok", "fallback", "rejected")}
+_M_PROC_FALLBACK = {r: get_counter("filodb_mesh_proc_fallback",
+                                   {"reason": r},
+                                   help="multi-process dispatches that fell "
+                                   "back to the single-process engines")
+                    for r in ("disabled", "unsupported", "histogram",
+                              "worker", "stale")}
+_M_PROC_WORKERS = Gauge("filodb_mesh_proc_workers",
+                        help="mesh worker processes last seen reachable")
+_M_PROC_COLLECTIVE = Histogram(
+    "filodb_mesh_proc_collective_seconds",
+    help="root-side cross-process reduce latency (gather + group reduce)")
+
+
+@dataclass(frozen=True)
+class LoweredDescriptor:
+    """A lowered mesh query shipped root → worker over the plan wire.
+
+    Carries everything a worker needs to run its mesh slice without
+    re-planning: the recognized plan signature (selector filters, range
+    function, window, offset, grouping), the step grid (``start``/
+    ``step``/``end``), and the global mesh-axis assignment
+    (``shard_axis`` worker slices × ``time_axis`` devices — the CPU
+    harness and today's TPU posture both run ``time_axis=1`` per
+    process). Wire-registered (``coordinator/wire.py`` explicit tuple),
+    so PR201/202 parity covers it.
+
+    Workers execute the AGG-STRIPPED form (``to_lowered(strip_agg=
+    True)``): per-series window evaluation is the shard-local half of the
+    SPMD program; grouping/reduction and post-transforms stay on the root
+    so the cross-process combine remains a single associative reduce.
+    """
+
+    dataset: str
+    filters: tuple
+    start: int
+    step: int
+    end: int
+    window: int
+    fn: str
+    offset: int
+    agg: str | None
+    by: tuple
+    without: tuple
+    keep_metric: bool
+    post: tuple = ()
+    shard_axis: int = 1
+    time_axis: int = 1
+
+    @classmethod
+    def from_lowered(cls, low, dataset: str,
+                     shard_axis: int = 1) -> "LoweredDescriptor":
+        return cls(dataset=dataset, filters=tuple(low.filters),
+                   start=low.start, step=low.step, end=low.end,
+                   window=low.window, fn=low.fn, offset=low.offset,
+                   agg=low.agg, by=tuple(low.by),
+                   without=tuple(low.without),
+                   keep_metric=low.keep_metric, post=tuple(low.post),
+                   shard_axis=shard_axis, time_axis=1)
+
+    def to_lowered(self, strip_agg: bool = False):
+        from filodb_tpu.parallel.mesh_engine import _Lowered
+
+        if strip_agg:
+            # worker half: raw per-series [P_local, K] rows, full keys
+            # (the root re-derives group keys), no post-transforms
+            return _Lowered(self.filters, self.start, self.step, self.end,
+                            self.window, self.fn, self.offset, None, (),
+                            (), True, ())
+        return _Lowered(self.filters, self.start, self.step, self.end,
+                        self.window, self.fn, self.offset, self.agg,
+                        tuple(self.by), tuple(self.without),
+                        self.keep_metric, tuple(self.post))
+
+    @property
+    def signature(self):
+        """Worker-side descriptor-cache key (grid excluded, like
+        ``_Lowered.signature``)."""
+        return (self.dataset, self.filters, self.window, self.fn,
+                self.offset, self.step)
+
+
+class MeshWorkerClient(RemotePlanDispatcher):
+    """Root → mesh-worker transport: descriptor execution and status on
+    the pooled, authed plan wire. Subclassing the remote dispatcher keeps
+    one framed protocol (auth, hello/compression, socket pool) and makes
+    this class wire-registered through the dispatcher subclass walk."""
+
+    def exec_descriptors(self, descs: list, deadline=None):
+        """Execute descriptors on the worker's mesh slice. Returns the
+        worker's result dict; raises ``QueryRejected`` when the worker's
+        admission gate sheds the query (overload propagates, PR 1/4
+        semantics) and transport errors / ``CircuitOpenError`` when the
+        worker is unavailable (the runtime maps those to fallback)."""
+        breaker = breaker_for(self.peer)
+
+        def attempt():
+            timeout = deadline.timeout(cap=self.timeout,
+                                       what=f"mesh exec on {self.peer}") \
+                if deadline is not None else self.timeout
+            FaultInjector.fire("meshproc.exec", host=self.host,
+                               port=self.port)
+            # ship the remaining budget so the worker's admission wait is
+            # bounded by the query deadline, not a local default
+            return self._roundtrip(("mesh_exec", list(descs), timeout),
+                                   timeout)
+
+        t0 = time.perf_counter()
+        with span("mesh-proc-exec", peer=self.peer), \
+                breaker.calling(transport_errors=self.TRANSPORT_ERRORS):
+            resp = default_retry_policy().call(
+                attempt, retry_on=self.TRANSPORT_ERRORS, deadline=deadline)
+        record_peer_latency(self.peer, time.perf_counter() - t0)
+        if resp[0] == "ok":
+            return resp[1]
+        if resp[0] == "rejected":
+            from filodb_tpu.utils.governor import QueryRejected
+            retry_after = resp[2] if len(resp) > 2 else 1.0
+            raise QueryRejected(
+                f"mesh worker {self.peer} shed the query: {resp[1]}",
+                retry_after_s=retry_after)
+        raise RuntimeError(f"mesh exec failed on {self.peer}: {resp[1]}")
+
+    def status(self, timeout_s: float = 2.0) -> dict:
+        """Worker status snapshot on a short timeout (control plane —
+        never under the query path's retry/breaker machinery)."""
+        resp = self._roundtrip(("mesh_status",), timeout_s)
+        if resp[0] == "ok":
+            return resp[1]
+        raise RuntimeError(f"mesh status failed on {self.peer}: {resp[1]}")
+
+
+class MeshClusterRuntime:
+    """Routes lowered mesh queries across N worker processes and reduces
+    their slices — the cluster analog of ``MeshQueryEngine.execute``.
+
+    ``workers`` is a list of ``(host, port, (shard_lo, shard_hi))``
+    entries whose half-open shard ranges must tile ``[0, num_shards)`` in
+    order: concatenating worker result blocks in worker order then equals
+    the single-process engine's global part order, which is what makes
+    the root reduce byte-identical to the single-process path.
+    """
+
+    def __init__(self, memstore, dataset: str, num_shards: int,
+                 workers: list, timeout: float = 30.0):
+        lo_seen = 0
+        self.workers = []
+        for host, port, (lo, hi) in workers:
+            if lo != lo_seen:
+                raise ValueError(
+                    f"worker shard slices must tile [0, {num_shards}) "
+                    f"contiguously; got [{lo}, {hi}) after {lo_seen}")
+            lo_seen = hi
+            self.workers.append((MeshWorkerClient(host, port,
+                                                  timeout=timeout),
+                                 (lo, hi)))
+        if lo_seen != num_shards:
+            raise ValueError(f"worker slices cover [0, {lo_seen}), "
+                             f"need [0, {num_shards})")
+        self.memstore = memstore
+        self.dataset = dataset
+        self.num_shards = num_shards
+        self.timeout = timeout
+        self.last_collective_s: float | None = None
+        self._lock = threading.Lock()
+        self._lowerer = None
+        self._root_mesh = None
+        self._reduce_fns: dict = {}
+        _M_PROC_WORKERS.set(len(self.workers))
+
+    # ---- routing gate ----------------------------------------------------
+
+    def enabled(self) -> bool:
+        return bool(self.workers) \
+            and os.environ.get("FILODB_MULTIPROC", "1") != "0"
+
+    # ---- lowering (shared with the single-process engine) ----------------
+
+    def _lowering_engine(self):
+        """A bare mesh engine used ONLY for plan recognition — never
+        touches devices. ``sidecars=True`` mirrors the serving engines'
+        decline of tick-shaped grids, so multiproc routing and the
+        single-process path agree on which plans are mesh-shaped."""
+        if self._lowerer is None:
+            from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
+            self._lowerer = MeshQueryEngine(sidecars=True)
+        return self._lowerer
+
+    # ---- execution -------------------------------------------------------
+
+    def execute_plan(self, plan, deadline=None, stats=None):
+        """Run a plan across the worker processes; ``None`` = fall back
+        to the single-process engines (callers stay inside their
+        admission scope, so the fallback is never a second admit)."""
+        if not self.enabled():
+            _M_PROC_FALLBACK["disabled"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        low = self._lowering_engine()._lower(plan)
+        if low is None:
+            _M_PROC_FALLBACK["unsupported"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        return self.execute_lowered(low, deadline=deadline, stats=stats)
+
+    def execute_lowered(self, low, deadline=None, stats=None):
+        """Scatter one lowered query to every worker slice and reduce.
+        ``None`` = worker unavailability / shape decline (fallback);
+        ``QueryRejected`` propagates (a shed worker is overload)."""
+        if not self.enabled():
+            _M_PROC_FALLBACK["disabled"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        desc = LoweredDescriptor.from_lowered(low, self.dataset,
+                                              shard_axis=len(self.workers))
+        # snapshot root offsets BEFORE dispatch: a worker that has tailed
+        # at least this far saw everything the root would scan now
+        root_off = {s.shard_num: s.latest_offset
+                    for s in self.memstore.shards_for(self.dataset)} \
+            if self.memstore is not None else {}
+        from filodb_tpu.utils.governor import QueryRejected
+        threads = []
+        outs: list = [None] * len(self.workers)
+        errs: list = [None] * len(self.workers)
+
+        def run(i, cli):
+            try:
+                outs[i] = cli.exec_descriptors([desc], deadline)
+            except Exception as e:  # classified below, on the caller
+                errs[i] = e
+
+        for i, (cli, _) in enumerate(self.workers):
+            t = threading.Thread(target=run, args=(i, cli), daemon=True,
+                                 name=f"meshproc-{cli.peer}")
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        for e in errs:
+            if isinstance(e, QueryRejected):
+                _M_PROC_DISPATCH["rejected"].inc()
+                raise e
+        bad = [e for e in errs if e is not None]
+        if bad:
+            for e in bad:
+                if not isinstance(e, (CircuitOpenError, RuntimeError,
+                                      *MeshWorkerClient.TRANSPORT_ERRORS)):
+                    raise e  # deadline exhaustion, programming errors
+            log.warning("mesh worker slice unavailable, falling back: %s",
+                        bad[0])
+            _M_PROC_FALLBACK["worker"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        _M_PROC_WORKERS.set(len(self.workers))
+        for (_, (lo, hi)), part in zip(self.workers, outs):
+            offs = part.get("offsets", {})
+            for s in range(lo, hi):
+                if offs.get(s, -1) < root_off.get(s, 0):
+                    _M_PROC_FALLBACK["stale"].inc()
+                    _M_PROC_DISPATCH["fallback"].inc()
+                    return None
+        mats = [part["results"][0] for part in outs]
+        if any(m is None for m in mats):
+            _M_PROC_FALLBACK["unsupported"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        if any(m.les is not None for m in mats):
+            # histogram batches flatten buckets on the single-process
+            # engine; the cross-process combine doesn't carry them yet
+            _M_PROC_FALLBACK["histogram"].inc()
+            _M_PROC_DISPATCH["fallback"].inc()
+            return None
+        if stats is not None:
+            for part in outs:
+                stats.series_scanned += int(part.get("series", 0))
+                stats.samples_scanned += int(part.get("samples", 0))
+        t0 = time.perf_counter()
+        result = self._reduce(low, mats)
+        dt = time.perf_counter() - t0
+        self.last_collective_s = dt
+        _M_PROC_COLLECTIVE.observe(dt)
+        _M_PROC_DISPATCH["ok"].inc()
+        return result
+
+    def _reduce(self, low, mats):
+        """Root-side window-boundary reduce over the gathered worker
+        blocks: exactly the single-process engine's group segment-sum,
+        run on a 1-device root mesh (the 1-wide shard axis imposes no
+        padding, so real rows keep their global order and bit patterns).
+        """
+        from filodb_tpu.parallel.mesh_engine import MeshQueryEngine
+        from filodb_tpu.query.exec.transformers import steps_array
+        from filodb_tpu.query.model import StepMatrix
+
+        steps_ms = steps_array(low.start, low.step, low.end)
+        K = len(steps_ms)
+        keys: list = []
+        blocks: list = []
+        for m in mats:
+            keys.extend(m.keys)
+            v = np.asarray(m.values, dtype=np.float64)
+            blocks.append(v if v.size else v.reshape(0, K))
+        if not keys:
+            return MeshQueryEngine._apply_post(StepMatrix.empty(steps_ms),
+                                               low)
+        vals = np.concatenate(blocks, axis=0) if len(blocks) > 1 \
+            else blocks[0]
+        if low.agg is None:
+            rkeys = list(keys) if low.keep_metric \
+                else [k.drop_metric() for k in keys]
+            m = StepMatrix(rkeys, vals, steps_ms)
+            return MeshQueryEngine._apply_post(m, low)
+        gkeys = [MeshQueryEngine._group_key(k, low) for k in keys]
+        uniq: dict = {}
+        gids = np.empty(len(gkeys), np.int32)
+        for i, gk in enumerate(gkeys):
+            gids[i] = uniq.setdefault(gk, len(uniq))
+        G = len(uniq)
+        out = np.asarray(self._reduce_fn(low.agg, G)(vals, gids))
+        m = StepMatrix(list(uniq.keys()), out[:G], steps_ms)
+        return MeshQueryEngine._apply_post(m, low)
+
+    def _reduce_fn(self, agg: str, G: int):
+        """Compiled cross-process group reduce, bucketed by group count
+        like the engine's program cache."""
+        from filodb_tpu.parallel.dist_query import make_mesh_group_reduce
+        from filodb_tpu.parallel.mesh_engine import make_query_mesh
+        from filodb_tpu.query.engine.device_batch import _pow2
+
+        Gp = _pow2(max(G, 1))
+        with self._lock:
+            if self._root_mesh is None:
+                self._root_mesh = make_query_mesh(n_devices=1)
+            fn = self._reduce_fns.get((agg, Gp))
+            if fn is None:
+                fn = self._reduce_fns[(agg, Gp)] = \
+                    make_mesh_group_reduce(self._root_mesh, Gp, agg)
+        return fn
+
+    # ---- observability ---------------------------------------------------
+
+    def status(self) -> dict:
+        """Per-worker mesh slice, device count, descriptor-cache
+        occupancy, and last collective latency (``filo-cli meshstat`` /
+        ``/api/v1/status/mesh``)."""
+        workers = []
+        reachable = 0
+        for cli, (lo, hi) in self.workers:
+            entry = {"peer": cli.peer, "shards": [lo, hi],
+                     "breaker": breaker_for(cli.peer).state}
+            try:
+                entry.update(cli.status())
+                entry["reachable"] = True
+                reachable += 1
+            except MeshWorkerClient.TRANSPORT_ERRORS as e:
+                entry["reachable"] = False
+                entry["error"] = str(e)
+            workers.append(entry)
+        _M_PROC_WORKERS.set(reachable)
+        return {"dataset": self.dataset, "num_shards": self.num_shards,
+                "enabled": self.enabled(), "workers": workers,
+                "last_collective_s": self.last_collective_s}
+
+    def shutdown(self) -> None:
+        """Drop pooled worker connections (the supervisor owns process
+        lifecycle)."""
+        for cli, _ in self.workers:
+            cli._drop_conn()

@@ -11,6 +11,7 @@ import itertools
 import time
 from dataclasses import dataclass, field
 
+from filodb_tpu.coordinator import mesh_cluster as _mesh_cluster  # noqa: F401
 from filodb_tpu.coordinator.planner import SingleClusterPlanner
 from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
 from filodb_tpu.promql.parser import TimeStepParams, parse_query
@@ -120,6 +121,12 @@ class QueryService:
     # callers know the answer may lag the live shard (never wrong, at most
     # behind the in-flight tail). Wired by cluster/standalone.
     shard_status_fn: object = None
+    # multi-process mesh runtime (coordinator/mesh_cluster.py): when set,
+    # mesh-shaped plans scatter to worker processes first; ``None`` from
+    # the runtime (slice unavailable / shape declined / FILODB_MULTIPROC=0)
+    # falls through to the single-process engines inside the same
+    # admission scope. Wired by standalone when mesh_workers.enabled.
+    mesh_cluster: object = None
     planner: SingleClusterPlanner = field(init=False)
 
     # monotonic construction serial: response-cache keys must survive a
@@ -422,6 +429,27 @@ class QueryService:
         with governor().admit(deadline=deadline, cost=cost,
                               tenant=plan_tenant(plan)):
             admission_wait_s = time.perf_counter() - t_admit
+            if self.mesh_cluster is not None and self._mesh_eligible() \
+                    and self._planner_mem_only(plan):
+                # multi-process mesh first: lowered descriptors scatter to
+                # the worker processes and the root runs the window-
+                # boundary reduce. None = slice unavailable / shape
+                # declined / disabled — fall through to the single-process
+                # engines below WITHOUT re-admitting (one admission per
+                # query, whatever path serves it). A worker-side shed
+                # raises QueryRejected out of the scope (PR 1/4: overload
+                # propagates, unavailability degrades).
+                from filodb_tpu.query.model import QueryStats
+                from filodb_tpu.utils.tracing import span
+                stats = QueryStats()
+                stats.admission_wait_s += admission_wait_s
+                with query_latency.time(), span("mesh-proc-execute"):
+                    data = self.mesh_cluster.execute_plan(plan, deadline,
+                                                          stats)
+                if data is not None:
+                    return self._finish_device_result(data, stats,
+                                                      qcontext, pp, cost,
+                                                      t0)
             if self.mesh_engine is not None and self._mesh_eligible() \
                     and self._planner_mem_only(plan) \
                     and self.mesh_engine.supports(plan):
@@ -438,28 +466,9 @@ class QueryService:
                     from filodb_tpu.parallel.mesh_engine import _M_FALLBACK
                     _M_FALLBACK["declined"].inc()
                 if data is not None:  # None = shape the kernels don't cover
-                    # materialize first so deferred compaction applies, then
-                    # the same resource guard as the exec path (real count)
-                    data.materialize()
-                    from filodb_tpu.query.exec.plan import (
-                        ExecPlan,
-                        apply_result_budget,
-                    )
-                    ExecPlan._enforce_limits(data, qcontext)
-                    # result-bytes budget on the materialized matrix (the
-                    # mesh has no incremental scan hooks, so the boundary
-                    # check is where it degrades gracefully)
-                    shim = _BudgetCtx(pp.budget)
-                    data = apply_result_budget(data, shim)
-                    stats.wall_time_s = time.perf_counter() - t0
-                    stats.result_series = data.num_series
-                    from filodb_tpu.coordinator import adaptive_planner
-                    adaptive_planner.settle_query(
-                        self.dataset, qcontext, stats.wall_time_s, cost)
-                    return self._attach_recovery_warnings(
-                        QueryResult(data, stats, qcontext.query_id,
-                                    partial=shim.partial,
-                                    warnings=shim.warnings))
+                    return self._finish_device_result(data, stats,
+                                                      qcontext, pp, cost,
+                                                      t0)
             from filodb_tpu.utils.tracing import span
             with span("plan-materialize"):
                 exec_plan = self.planner.materialize(plan, qcontext)
@@ -492,6 +501,32 @@ class QueryService:
         if result.partial:
             partial_results.inc()
         return self._attach_recovery_warnings(result)
+
+    def _finish_device_result(self, data, stats, qcontext, pp, cost,
+                              t0) -> QueryResult:
+        """Finishing tail shared by the device engines (single-process
+        mesh and multi-process mesh): materialize first so deferred
+        compaction applies, then the same resource guards as the exec
+        path (real counts), then settle the adaptive cost model."""
+        data.materialize()
+        from filodb_tpu.query.exec.plan import (
+            ExecPlan,
+            apply_result_budget,
+        )
+        ExecPlan._enforce_limits(data, qcontext)
+        # result-bytes budget on the materialized matrix (the mesh has no
+        # incremental scan hooks, so the boundary check is where it
+        # degrades gracefully)
+        shim = _BudgetCtx(pp.budget)
+        data = apply_result_budget(data, shim)
+        stats.wall_time_s = time.perf_counter() - t0
+        stats.result_series = data.num_series
+        from filodb_tpu.coordinator import adaptive_planner
+        adaptive_planner.settle_query(
+            self.dataset, qcontext, stats.wall_time_s, cost)
+        return self._attach_recovery_warnings(
+            QueryResult(data, stats, qcontext.query_id,
+                        partial=shim.partial, warnings=shim.warnings))
 
     def _recovery_warnings(self) -> list[str]:
         """One warning per queryable-but-catching-up shard (recovery replay,

@@ -32,6 +32,7 @@ def _build_registry() -> dict[str, type]:
     # subclasses — the registry must not depend on process import order
     from filodb_tpu.coordinator import cluster  # noqa: F401
     from filodb_tpu.coordinator import remote  # noqa: F401
+    from filodb_tpu.coordinator.mesh_cluster import LoweredDescriptor
     from filodb_tpu.core.filters import ColumnFilter, Filter
     from filodb_tpu.core.partkey import PartKey
     from filodb_tpu.memory.chunk import Chunk, ColumnSummary
@@ -66,7 +67,7 @@ def _build_registry() -> dict[str, type]:
         reg[base.__name__] = base
         walk(base)
     for cls in (ColumnFilter, PartKey, Chunk, ColumnSummary, HistogramColumn,
-                MigrationManifest, PlannerParams,
+                LoweredDescriptor, MigrationManifest, PlannerParams,
                 QueryBudget, QueryContext, QueryResult, QueryStats,
                 RangeVectorKey, ScalarResult, StepMatrix, TraceContext):
         reg[cls.__name__] = cls

@@ -234,6 +234,8 @@ class HttpDispatcher:
             return self._status_ingest(qs)
         if parts == ["api", "v1", "status", "tiers"]:
             return self._status_tiers(qs)
+        if parts == ["api", "v1", "status", "mesh"]:
+            return self._status_mesh(qs)
         return self._json(404, promjson.error_json("not found", "not_found"))
 
     def _rule_managers(self) -> dict:
@@ -315,6 +317,27 @@ class HttpDispatcher:
         from filodb_tpu.query import federation
         data = {name: federation.tier_status(name, svc)
                 for name, svc in self._status_datasets(qs).items()}
+        return self._json(200, {"status": "success", "data": data})
+
+    def _status_mesh(self, qs: dict):
+        """Multi-process mesh runtime status: per-worker mesh slice,
+        device count, descriptor-cache occupancy, last collective
+        latency (``filo-cli meshstat``). Datasets without a runtime
+        report ``multiproc: false`` with single-process engine info."""
+        data = {}
+        for name, svc in self._status_datasets(qs).items():
+            rt = getattr(svc, "mesh_cluster", None)
+            if rt is not None:
+                entry = dict(rt.status())
+                entry["multiproc"] = True
+            else:
+                entry = {"multiproc": False}
+            eng = getattr(svc, "mesh_engine", None)
+            if eng is not None:
+                entry["engine"] = {"hits": eng.hits, "misses": eng.misses,
+                                   "batch_cache": len(eng._batch_cache),
+                                   "programs": len(eng._fns)}
+            data[name] = entry
         return self._json(200, {"status": "success", "data": data})
 
     def _status_ingest(self, qs: dict):

@@ -1,0 +1,416 @@
+"""Mesh worker processes: the per-process half of the multi-process mesh.
+
+Each worker owns a contiguous slice of a dataset's shard space and runs
+the agg-stripped :class:`~filodb_tpu.coordinator.mesh_cluster.
+LoweredDescriptor` through its own ``MeshQueryEngine`` over a
+1-device-per-process mesh slice. Device-resident caches (decoded+placed
+batches, window bounds, per-series evaluations — PR 14's dkey semantics)
+live per process, so a warm worker's per-query cost is one window
+evaluation over its local rows; the cross-process combine happens on the
+root (``coordinator/mesh_cluster.py``).
+
+Two data-ownership modes:
+
+- ``--config server.json``: the worker tails the shared WAL read-only for
+  its owned shards (``Node.start_shard`` — the same recover-then-tail
+  path cluster members use), against its own in-process column store.
+- ``--seed module:callable``: CI/benchmark harness — the callable returns
+  a fully-ingested memstore (deterministic: ``ingestion_shard`` hashing
+  is content-derived, so every process derives the same placement) and a
+  shard-slice view restricts scans to the owned range.
+
+``jax.distributed.initialize`` is wrapped by :func:`init_distributed` for
+real multi-host hardware; the CI harness runs N spawned subprocesses × 1
+CPU device each through the same descriptor/execute code path (see
+``doc/mesh_engine.md`` for the recipe and the real-hardware re-anchor
+procedure).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import logging
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int, local_device_ids=None) -> None:
+    """Join a real multi-host JAX runtime (TPU pod / multi-host GPU):
+    after this, ``jax.devices()`` spans every process and one global
+    ``Mesh(("shard", "time"))`` can cover the pod. The CPU harness never
+    calls this — its N×1 topology needs no cross-process device runtime,
+    only the descriptor wire — so the call stays gated behind explicit
+    hardware configuration (``FILODB_MESH_DISTRIBUTED=1``)."""
+    if os.environ.get("FILODB_MESH_DISTRIBUTED") != "1":
+        raise RuntimeError(
+            "set FILODB_MESH_DISTRIBUTED=1 to initialize the multi-host "
+            "device runtime (CPU harness runs without it)")
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+
+
+class _ShardSliceStore:
+    """Read view of a memstore restricted to an owned shard range — what
+    makes a seeded (fully-ingested) store behave like locally-owned
+    slice data without copying anything."""
+
+    def __init__(self, inner, dataset: str, lo: int, hi: int):
+        self._inner = inner
+        self._dataset = dataset
+        self._lo = lo
+        self._hi = hi
+
+    def shards_for(self, dataset: str):
+        shards = self._inner.shards_for(dataset)
+        if dataset != self._dataset:
+            return shards
+        return [s for s in shards if self._lo <= s.shard_num < self._hi]
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class MeshWorker:
+    """One mesh worker process: framed control server (same auth/hello
+    protocol as the plan executor) + a 1-device mesh engine over the
+    locally-owned shard slice."""
+
+    def __init__(self, memstore, dataset: str, shard_range: tuple,
+                 host: str = "127.0.0.1", port: int = 0,
+                 secret: str | None = None):
+        from filodb_tpu.coordinator.remote import (
+            cluster_secret,
+            make_authed_handler,
+        )
+
+        self.memstore = memstore
+        self.dataset = dataset
+        self.shard_range = shard_range
+        self.secret = secret if secret is not None else cluster_secret()
+        self._engine = None
+        self._engine_lock = threading.Lock()
+        self.queries = 0
+        self.last_exec_s: float | None = None
+        Handler = make_authed_handler(lambda: self.secret, self._handle,
+                                      "mesh worker")
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+
+        self.server = Server((host, port), Handler, bind_and_activate=True)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self.address = (host, self.port)
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True,
+                                        name=f"mesh-worker-{self.port}")
+
+    def engine(self):
+        """1-device mesh slice engine, built lazily (device init must not
+        gate the control plane coming up)."""
+        with self._engine_lock:
+            if self._engine is None:
+                from filodb_tpu.parallel.mesh_engine import (
+                    MeshQueryEngine,
+                    make_query_mesh,
+                )
+                self._engine = MeshQueryEngine(
+                    mesh=make_query_mesh(n_devices=1))
+            return self._engine
+
+    # ---- protocol --------------------------------------------------------
+
+    def _handle(self, msg):
+        kind = msg[0]
+        if kind == "ping":
+            return ("pong",)
+        if kind == "mesh_status":
+            try:
+                return ("ok", self._status())
+            except Exception as e:
+                log.exception("mesh status failed")
+                return ("err", repr(e))
+        if kind == "mesh_exec":
+            descs = msg[1]
+            budget_s = msg[2] if len(msg) > 2 else None
+            try:
+                from filodb_tpu.coordinator.query_service import plan_tenant
+                from filodb_tpu.utils.governor import (
+                    EXPENSIVE,
+                    QueryRejected,
+                    governor,
+                )
+                from filodb_tpu.utils.resilience import Deadline
+
+                dl = Deadline.after(budget_s) if budget_s else None
+                try:
+                    # same admission gate as shipped exec plans: root
+                    # fan-out from many coordinators can't stampede a
+                    # worker, and a shed is a typed verdict the root
+                    # propagates as 503 + Retry-After
+                    with governor().admit(deadline=dl, cost=EXPENSIVE,
+                                          tenant=plan_tenant(descs[0])):
+                        return ("ok", self._exec(descs))
+                except QueryRejected as e:
+                    return ("rejected", str(e), e.retry_after_s)
+            except Exception as e:
+                log.exception("mesh exec failed")
+                return ("err", repr(e))
+        return ("err", f"unknown message {kind!r}")
+
+    def _exec(self, descs) -> dict:
+        from filodb_tpu.query.model import QueryStats
+
+        eng = self.engine()
+        stats = QueryStats()
+        t0 = time.perf_counter()
+        results = []
+        for desc in descs:
+            low = desc.to_lowered(strip_agg=True)
+            out = eng.execute_lowered_many([low], self.memstore,
+                                           self.dataset, stats)[0]
+            if out is not None:
+                out.materialize()
+            results.append(out)
+        self.queries += len(descs)
+        self.last_exec_s = time.perf_counter() - t0
+        offsets = {s.shard_num: s.latest_offset
+                   for s in self.memstore.shards_for(self.dataset)}
+        return {"results": results, "offsets": offsets,
+                "series": stats.series_scanned,
+                "samples": stats.samples_scanned}
+
+    def _status(self) -> dict:
+        lo, hi = self.shard_range
+        info = {"dataset": self.dataset, "shards": [lo, hi],
+                "queries": self.queries, "last_exec_s": self.last_exec_s,
+                "pid": os.getpid(),
+                "offsets": {s.shard_num: s.latest_offset
+                            for s in self.memstore.shards_for(self.dataset)}}
+        eng = self._engine  # engine caches only once a query warmed them
+        if eng is not None:
+            info["devices"] = int(np_size(eng.mesh.devices)) \
+                if eng.mesh is not None else 0
+            info["descriptor_cache"] = len(eng._batch_cache)
+            info["caches"] = {"batch": len(eng._batch_cache),
+                              "programs": len(eng._fns),
+                              "bounds": len(eng._bounds_cache),
+                              "eval": len(eng._eval_cache),
+                              "prep": len(eng._prep_cache)}
+        else:
+            info["devices"] = 0
+            info["descriptor_cache"] = 0
+            info["caches"] = {}
+        return info
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> "MeshWorker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def np_size(devices) -> int:
+    import numpy as np
+
+    return int(np.asarray(devices).size)
+
+
+def _load_seed(spec: str):
+    """``module:callable`` → the callable's return value (a fully
+    ingested memstore)."""
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise ValueError(f"--seed must be module:callable, got {spec!r}")
+    obj = importlib.import_module(mod_name)
+    for part in fn_name.split("."):
+        obj = getattr(obj, part)
+    return obj()
+
+
+def _tail_shards(cfg, dataset: str, lo: int, hi: int):
+    """Recover-then-tail the owned shard range from the shared WAL
+    (read-only — the gateway/coordinator owns the append side)."""
+    from filodb_tpu.coordinator.cluster import Node
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.kafka.log import SegmentedFileLog
+
+    ms = TimeSeriesMemStore()
+    node = Node(name=f"mesh-worker-{lo}-{hi}", memstore=ms)
+    ing = cfg.datasets[dataset]
+    root = cfg.wal_dir or os.path.join(cfg.data_dir, "wal")
+    for shard in range(lo, hi):
+        wal = SegmentedFileLog(os.path.join(root, dataset,
+                                            f"shard-{shard}"),
+                               read_only=True)
+        node.start_shard(dataset, shard, ing, wal)
+    return ms, node
+
+
+def worker_main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m filodb_tpu.parallel.multiproc",
+        description="filodb mesh worker process (one mesh slice)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--dataset", required=True)
+    ap.add_argument("--shards", required=True,
+                    help="owned shard slice, lo:hi (half-open)")
+    ap.add_argument("--num-shards", type=int, default=0,
+                    help="global shard count (validation only)")
+    ap.add_argument("--config", default=None,
+                    help="server config JSON: tail the shared WAL")
+    ap.add_argument("--seed", default=None,
+                    help="module:callable returning an ingested memstore "
+                    "(CI/benchmark harness)")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    lo_s, _, hi_s = args.shards.partition(":")
+    lo, hi = int(lo_s), int(hi_s)
+    if args.num_shards and not (0 <= lo < hi <= args.num_shards):
+        raise SystemExit(f"shard slice {lo}:{hi} outside "
+                         f"[0, {args.num_shards})")
+    node = None
+    if args.seed:
+        store = _ShardSliceStore(_load_seed(args.seed), args.dataset, lo,
+                                 hi)
+    elif args.config:
+        from filodb_tpu.config import ServerConfig
+
+        cfg = ServerConfig.load(args.config)
+        store, node = _tail_shards(cfg, args.dataset, lo, hi)
+    else:
+        raise SystemExit("one of --seed / --config is required")
+    worker = MeshWorker(store, args.dataset, (lo, hi), host=args.host,
+                        port=args.port).start()
+    log.info("mesh worker serving %s[%d:%d) on %s:%d", args.dataset, lo,
+             hi, args.host, worker.port)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        worker.stop()
+        if node is not None:
+            for shard in range(lo, hi):
+                node.stop_shard(args.dataset, shard)
+    return 0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class MeshWorkerSupervisor:
+    """Spawns and supervises the N worker subprocesses of a multi-process
+    mesh (the coordinator-address/N-process harness of the tentpole; on
+    real hardware the pod scheduler owns process placement and this class
+    only covers the local-launch path)."""
+
+    def __init__(self, dataset: str, num_shards: int, workers: int,
+                 base_port: int = 0, host: str = "127.0.0.1",
+                 config_path: str | None = None, seed: str | None = None,
+                 env: dict | None = None, python: str | None = None):
+        if workers < 1:
+            raise ValueError("need at least one mesh worker")
+        self.dataset = dataset
+        self.num_shards = num_shards
+        self.host = host
+        self.config_path = config_path
+        self.seed = seed
+        self.env = dict(env or {})
+        self.python = python or sys.executable
+        # contiguous near-equal slices tiling [0, num_shards)
+        bounds = [round(i * num_shards / workers)
+                  for i in range(workers + 1)]
+        ports = [base_port + i if base_port else _free_port()
+                 for i in range(workers)]
+        self.slices = [(host, ports[i], (bounds[i], bounds[i + 1]))
+                       for i in range(workers)]
+        self.procs: list[subprocess.Popen] = []
+
+    def spawn(self) -> "MeshWorkerSupervisor":
+        env = {**os.environ,
+               "JAX_PLATFORMS": "cpu",
+               # one host device per process — the N×1 harness topology
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+               **self.env}
+        for host, port, (lo, hi) in self.slices:
+            cmd = [self.python, "-m", "filodb_tpu.parallel.multiproc",
+                   "--host", host, "--port", str(port),
+                   "--dataset", self.dataset,
+                   "--shards", f"{lo}:{hi}",
+                   "--num-shards", str(self.num_shards)]
+            if self.seed:
+                cmd += ["--seed", self.seed]
+            elif self.config_path:
+                cmd += ["--config", self.config_path]
+            else:
+                raise ValueError("supervisor needs seed or config_path")
+            self.procs.append(subprocess.Popen(cmd, env=env))
+        return self
+
+    def addresses(self) -> list:
+        return list(self.slices)
+
+    def alive(self) -> list:
+        return [p.poll() is None for p in self.procs]
+
+    def wait_ready(self, timeout_s: float = 120.0) -> None:
+        """Block until every worker answers a ping (device init + seed
+        ingest happen before the socket accepts work in practice, but
+        ping-ready is the contract; the runtime's staleness gate covers
+        catch-up)."""
+        from filodb_tpu.coordinator.mesh_cluster import MeshWorkerClient
+
+        deadline = time.monotonic() + timeout_s
+        for (host, port, _), proc in zip(self.slices, self.procs):
+            cli = MeshWorkerClient(host, port, timeout=2.0)
+            while not cli.ping():
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"mesh worker {host}:{port} exited with "
+                        f"{proc.returncode} before becoming ready")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"mesh worker {host}:{port} not ready after "
+                        f"{timeout_s}s")
+                time.sleep(0.1)
+
+    def stop(self, grace_s: float = 5.0) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + grace_s
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(worker_main())

@@ -10,6 +10,7 @@ the Influx gateway.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import signal
@@ -86,6 +87,8 @@ class FiloServer:
         self.gateway: GatewayServer | None = None
         self.executor: PlanExecutorServer | None = None
         self.selfmon = None
+        self.mesh_supervisor = None  # multi-process mesh worker processes
+        self.mesh_runtime = None     # root-side descriptor router
         self._setup_meta_dataset()
 
     def _setup_meta_dataset(self) -> None:
@@ -135,6 +138,57 @@ class FiloServer:
                     self._wal_path(dataset, shard),
                     fsync=self.config.wal_fsync, read_only=tailer)
         return self.logs[key]
+
+    def _start_mesh_workers(self, cfg, services: dict) -> None:
+        """Boot the multi-process mesh runtime (coordinator role only):
+        spawn N worker processes each owning a contiguous shard slice,
+        then attach the descriptor router to the dataset's query service.
+        Workers that never come up cost nothing at query time — the
+        runtime's per-worker breakers route every query to the
+        single-process engines until the slice answers."""
+        mw = dict(cfg.mesh_workers or {})
+        if not mw.get("enabled") or not services:
+            return
+        ds = mw.get("dataset") or next(iter(cfg.datasets))
+        if ds not in services:
+            log.warning("mesh_workers.dataset %r not served here; "
+                        "multi-process mesh disabled", ds)
+            return
+        ing = cfg.datasets[ds]
+        seed = mw.get("seed") or None
+        config_path = None
+        if not seed:
+            # minimal worker config: shared WAL location + the dataset's
+            # shard/store shape (workers recover-then-tail read-only)
+            import dataclasses as _dc
+            config_path = os.path.join(cfg.data_dir,
+                                       "mesh_worker_config.json")
+            os.makedirs(cfg.data_dir, exist_ok=True)
+            with open(config_path, "w") as f:
+                json.dump({"data_dir": cfg.data_dir,
+                           "wal_dir": cfg.wal_dir,
+                           "datasets": {ds: {
+                               "num_shards": ing.num_shards,
+                               "store": _dc.asdict(ing.store)}}}, f)
+        from filodb_tpu.coordinator.mesh_cluster import MeshClusterRuntime
+        from filodb_tpu.parallel.multiproc import MeshWorkerSupervisor
+        sup = MeshWorkerSupervisor(
+            dataset=ds, num_shards=ing.num_shards,
+            workers=int(mw.get("workers", 2)),
+            base_port=int(mw.get("base_port", 0)),
+            config_path=config_path, seed=seed).spawn()
+        try:
+            sup.wait_ready(timeout_s=float(mw.get("ready_timeout_s",
+                                                  120.0)))
+        except (TimeoutError, RuntimeError) as e:
+            # degraded boot: serve single-process until workers answer
+            log.warning("mesh workers not ready (%s); serving via "
+                        "single-process engines until they are", e)
+        self.mesh_supervisor = sup
+        self.mesh_runtime = MeshClusterRuntime(
+            self.memstore, ds, ing.num_shards, sup.addresses(),
+            timeout=float(mw.get("timeout_s", 30.0)))
+        services[ds].mesh_cluster = self.mesh_runtime
 
     @staticmethod
     def _build_notifier(notify_cfg: dict):
@@ -420,6 +474,7 @@ class FiloServer:
                 adaptive_planner.install(name, self.meta_store,
                                          cfg.cost_model)
             self.cluster.start_failure_detector()
+            self._start_mesh_workers(cfg, services)
             # standing queries: one RuleManager per dataset with groups,
             # writing outputs through the shard WAL (first-class series)
             rules_cfg = dict(cfg.rules or {})
@@ -813,6 +868,10 @@ class FiloServer:
             self.gateway.stop()
         if self.executor:
             self.executor.stop()
+        if getattr(self, "mesh_runtime", None) is not None:
+            self.mesh_runtime.shutdown()
+        if getattr(self, "mesh_supervisor", None) is not None:
+            self.mesh_supervisor.stop()
         self.cluster.stop()
         for l in self.logs.values():
             l.close()

@@ -1,0 +1,39 @@
+"""Deterministic seed store for the multi-process mesh harness.
+
+Mesh worker processes started with ``--seed
+filodb_tpu.testing.mesh_store:build_store`` rebuild EXACTLY this store:
+every input is seeded and shard placement (``ingestion_shard``) hashes
+record content, so N independent processes derive identical per-shard
+data — which is what lets the N×1 CPU harness assert byte-identity
+against a single-process engine over the same builder's output.
+"""
+
+from __future__ import annotations
+
+DATASET = "timeseries"
+NUM_SHARDS = 4
+N_SERIES = 48
+N_SAMPLES = 180
+START_MS = 1_600_000_000_000
+INTERVAL_MS = 10_000
+
+
+def build_store():
+    """A fully-ingested memstore: ``N_SERIES`` counters routed over
+    ``NUM_SHARDS`` shards, with resets so rate correction is exercised
+    across the process boundary."""
+    from filodb_tpu.coordinator.ingestion import ingest_routed
+    from filodb_tpu.core.memstore.memstore import TimeSeriesMemStore
+    from filodb_tpu.core.store.config import StoreConfig
+    from filodb_tpu.testing.data import counter_series, counter_stream
+
+    ms = TimeSeriesMemStore()
+    for s in range(NUM_SHARDS):
+        ms.setup(DATASET, s, StoreConfig(max_chunk_size=100,
+                                         groups_per_shard=4))
+    keys = counter_series(N_SERIES)
+    stream = counter_stream(keys, N_SAMPLES, start_ms=START_MS,
+                            interval_ms=INTERVAL_MS, seed=7,
+                            reset_every=60)
+    ingest_routed(ms, DATASET, stream, NUM_SHARDS)
+    return ms

@@ -300,6 +300,20 @@ MESH_NAMES = [
 ]
 
 
+# multi-process mesh runtime (coordinator/mesh_cluster.py) — descriptor
+# dispatch outcomes, fallback reasons, live worker gauge, and root-side
+# collective latency; registered at mesh_cluster import (pulled in by
+# query_service at boot so families render before any worker spawns)
+MESH_PROC_NAMES = [
+    "filodb_mesh_proc_dispatch_total",
+    "filodb_mesh_proc_fallback_total",
+    "filodb_mesh_proc_workers",
+    "filodb_mesh_proc_collective_seconds_bucket",
+    "filodb_mesh_proc_collective_seconds_count",
+    "filodb_mesh_proc_collective_seconds_sum",
+]
+
+
 # trace-driven adaptive planner (query/cost_model.py) — decision sources,
 # settle counts, calibration error, signature-table occupancy; registered
 # at cost_model import (QueryService admission path at boot)
@@ -452,6 +466,13 @@ class TestMetricsScrape:
         # the first mesh-eligible query
         missing_mesh = [n for n in MESH_NAMES if n not in names_present]
         assert not missing_mesh, f"missing mesh metrics: {missing_mesh}"
+
+        # multi-process mesh runtime: dispatch/fallback counters, worker
+        # gauge, and collective-latency histogram render at zero from the
+        # mesh_cluster import at boot — no worker pool needs to exist
+        missing_mp = [n for n in MESH_PROC_NAMES
+                      if n not in names_present]
+        assert not missing_mp, f"missing mesh-proc metrics: {missing_mp}"
 
         # adaptive-planner cost model: decision/settle counters and
         # calibration gauges pre-register at cost_model import (pulled in

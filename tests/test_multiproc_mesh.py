@@ -1,0 +1,251 @@
+"""Multi-process mesh runtime: descriptor shipping, N-process × 1-CPU-
+device byte-identity against the single-process engines, cold-model
+parity with ``FILODB_MULTIPROC=0``, and worker-loss degradation.
+
+Real process isolation, real TCP — the CI face of the cluster-scale
+SPMD path (doc/mesh_engine.md §multi-process). Workers are seeded with
+``filodb_tpu.testing.mesh_store:build_store`` (content-hashed shard
+routing ⇒ every process derives identical per-shard data), so the root
+process's in-memory store doubles as the ground truth.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from filodb_tpu.coordinator.mesh_cluster import (
+    _M_PROC_DISPATCH,
+    _M_PROC_FALLBACK,
+    LoweredDescriptor,
+    MeshClusterRuntime,
+)
+from filodb_tpu.coordinator.query_service import QueryService
+from filodb_tpu.coordinator.wire import decode, encode
+from filodb_tpu.parallel.mesh_engine import MeshQueryEngine, make_query_mesh
+from filodb_tpu.parallel.multiproc import MeshWorkerSupervisor
+from filodb_tpu.promql.parser import TimeStepParams, parse_query
+from filodb_tpu.testing import mesh_store
+
+START = mesh_store.START_MS // 1000
+PARAMS = TimeStepParams(START + 600, 60, START + 1500)
+SEED = "filodb_tpu.testing.mesh_store:build_store"
+
+QUERIES = [
+    'sum(rate(http_requests_total[10m]))',
+    'sum by (job) (rate(http_requests_total[5m]))',
+    'sum(rate(http_requests_total{job="job-1"}[10m])) by (instance)',
+    'avg(rate(http_requests_total[10m]))',
+]
+
+
+def _plan(query, params=PARAMS):
+    return parse_query(query, params)
+
+
+def _baseline_engine():
+    # the same 1-device mesh shape each worker runs, so padded baseline
+    # rows contribute exact +0.0 and bitwise comparison is meaningful
+    return MeshQueryEngine(mesh=make_query_mesh(n_devices=1))
+
+
+def assert_bitwise(a, b):
+    assert [str(k) for k in a.keys] == [str(k) for k in b.keys]
+    np.testing.assert_array_equal(a.steps_ms, b.steps_ms)
+    assert np.asarray(a.values).tobytes() == np.asarray(b.values).tobytes()
+
+
+# --------------------------------------------------------------------------
+# descriptor wire round-trip (no processes)
+
+
+class TestDescriptorWire:
+    def _descriptor(self):
+        eng = _baseline_engine()
+        low = eng._lower(_plan(QUERIES[1]))
+        assert low is not None
+        return LoweredDescriptor.from_lowered(low, "timeseries"), low
+
+    def test_registered_on_the_wire(self):
+        from filodb_tpu.coordinator.wire import registry
+        assert "LoweredDescriptor" in registry()
+        assert "MeshWorkerClient" in registry()
+
+    def test_roundtrip_is_identity(self):
+        desc, _ = self._descriptor()
+        back = decode(encode(desc))
+        assert back == desc
+        assert back.signature == desc.signature
+
+    def test_to_lowered_reproduces_plan(self):
+        desc, low = self._descriptor()
+        back = decode(encode(desc)).to_lowered()
+        assert back == low
+
+    def test_strip_agg_for_worker_execution(self):
+        # workers run the agg-stripped form: raw per-series windows with
+        # the metric label kept, reduction happens at the root
+        desc, _ = self._descriptor()
+        w = decode(encode(desc)).to_lowered(strip_agg=True)
+        assert w.agg is None and w.by == () and w.without == ()
+        assert w.keep_metric and w.post == ()
+
+
+# --------------------------------------------------------------------------
+# spawned cluster: byte-identity, service routing, cold-model parity
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    store = mesh_store.build_store()
+    sup = MeshWorkerSupervisor(dataset=mesh_store.DATASET,
+                               num_shards=mesh_store.NUM_SHARDS,
+                               workers=2, seed=SEED)
+    sup.spawn()
+    try:
+        sup.wait_ready(timeout_s=120.0)
+        rt = MeshClusterRuntime(store, mesh_store.DATASET,
+                                mesh_store.NUM_SHARDS, sup.slices)
+        yield store, sup, rt
+        rt.shutdown()
+    finally:
+        sup.stop()
+
+
+class TestMultiprocByteIdentity:
+    @pytest.mark.parametrize("query", QUERIES)
+    def test_matches_single_process_mesh(self, cluster, query):
+        store, _, rt = cluster
+        got = rt.execute_plan(_plan(query))
+        assert got is not None, f"multiproc fell back: {query}"
+        want = _baseline_engine().execute(store, mesh_store.DATASET,
+                                          _plan(query))
+        assert_bitwise(got, want)
+
+    def test_matches_exec_path(self, cluster):
+        # same tolerance contract the single-process mesh engine holds
+        # against the scatter-gather exec path (test_mesh_engine idiom)
+        store, _, rt = cluster
+        exec_svc = QueryService(store, mesh_store.DATASET,
+                                mesh_store.NUM_SHARDS, spread=1)
+        for query in QUERIES[:2]:
+            got = rt.execute_plan(_plan(query))
+            re = exec_svc.query_range(query, START + 600, 60, START + 1500)
+            e = re.result
+            assert sorted(map(str, e.keys)) == sorted(map(str, got.keys))
+            oe = np.argsort([str(k) for k in e.keys])
+            og = np.argsort([str(k) for k in got.keys])
+            np.testing.assert_allclose(
+                np.asarray(got.values)[og], np.asarray(e.values)[oe],
+                rtol=1e-6, atol=1e-9, equal_nan=True)
+
+    def test_worker_status_reports_slices(self, cluster):
+        _, sup, rt = cluster
+        st = rt.status()
+        assert len(st["workers"]) == 2
+        ranges = sorted(tuple(w["shards"]) for w in st["workers"])
+        assert ranges == [(0, 2), (2, 4)]
+        for w in st["workers"]:
+            assert w["reachable"]
+            assert w["devices"] == 1
+
+    def test_service_routes_through_multiproc(self, cluster):
+        store, _, rt = cluster
+        svc = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        ref = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        svc.mesh_cluster = rt
+        before = _M_PROC_DISPATCH["ok"].value
+        for query in QUERIES:
+            a = svc.query_range(query, START + 600, 60, START + 1500)
+            # bitwise against the 1-device engine shape the workers run
+            want = _baseline_engine().execute(store, mesh_store.DATASET,
+                                              _plan(query))
+            assert np.asarray(a.result.values).tobytes() == \
+                np.asarray(want.values).tobytes()
+            assert not a.partial
+            # the service's own (8-virtual-device) engine agrees to f64
+            # rounding: reduction tree shape differs across mesh widths
+            b = ref.query_range(query, START + 600, 60, START + 1500)
+            np.testing.assert_allclose(
+                np.asarray(a.result.values), np.asarray(b.result.values),
+                rtol=1e-12, atol=1e-12, equal_nan=True)
+        assert _M_PROC_DISPATCH["ok"].value >= before + len(QUERIES)
+
+    def test_disabled_env_cold_parity(self, cluster, monkeypatch):
+        # FILODB_MULTIPROC=0 must reproduce the single-process engine
+        # bit-for-bit: the runtime declines, the fallback counter bumps,
+        # and the service result is the engine's own answer
+        store, _, rt = cluster
+        monkeypatch.setenv("FILODB_MULTIPROC", "0")
+        before = _M_PROC_FALLBACK["disabled"].value
+        assert rt.execute_plan(_plan(QUERIES[0])) is None
+        assert _M_PROC_FALLBACK["disabled"].value == before + 1
+        svc = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        svc.mesh_cluster = rt
+        ref = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        got = svc.query_range(QUERIES[0], START + 600, 60, START + 1500)
+        want = ref.query_range(QUERIES[0], START + 600, 60, START + 1500)
+        assert np.asarray(got.result.values).tobytes() == \
+            np.asarray(want.result.values).tobytes()
+
+
+# --------------------------------------------------------------------------
+# chaos: worker loss degrades to the single-process path, never wrong
+
+
+def test_worker_loss_degrades_to_fallback():
+    store = mesh_store.build_store()
+    sup = MeshWorkerSupervisor(dataset=mesh_store.DATASET,
+                               num_shards=mesh_store.NUM_SHARDS,
+                               workers=2, seed=SEED)
+    sup.spawn()
+    try:
+        sup.wait_ready(timeout_s=120.0)
+        rt = MeshClusterRuntime(store, mesh_store.DATASET,
+                                mesh_store.NUM_SHARDS, sup.slices,
+                                timeout=5.0)
+        plan = _plan(QUERIES[0])
+        healthy = rt.execute_plan(plan)
+        assert healthy is not None
+
+        sup.procs[0].kill()
+        sup.procs[0].wait(timeout=10)
+        before = _M_PROC_FALLBACK["worker"].value
+        assert rt.execute_plan(plan) is None
+        assert _M_PROC_FALLBACK["worker"].value == before + 1
+
+        # the service path serves the same answer through the fallback:
+        # bitwise vs a service that never had the runtime, and within f64
+        # rounding of the healthy multiproc result (the fallback engine's
+        # wider mesh changes the reduction tree, never the answer)
+        svc = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        svc.mesh_cluster = rt
+        ref = QueryService(store, mesh_store.DATASET, mesh_store.NUM_SHARDS,
+                           spread=1, engine="mesh")
+        got = svc.query_range(QUERIES[0], START + 600, 60, START + 1500)
+        want = ref.query_range(QUERIES[0], START + 600, 60, START + 1500)
+        assert np.asarray(got.result.values).tobytes() == \
+            np.asarray(want.result.values).tobytes()
+        np.testing.assert_allclose(
+            np.asarray(got.result.values), np.asarray(healthy.values),
+            rtol=1e-12, atol=1e-12, equal_nan=True)
+    finally:
+        sup.stop()
+
+
+def test_supervisor_slices_tile_the_shard_space():
+    sup = MeshWorkerSupervisor(dataset="timeseries", num_shards=10,
+                               workers=3, seed=SEED)
+    spans = [r for _, _, r in sup.slices]
+    assert spans[0][0] == 0 and spans[-1][1] == 10
+    for (a, b), (c, d) in zip(spans, spans[1:]):
+        assert b == c and a < b
+    with pytest.raises(ValueError):
+        MeshClusterRuntime(None, "timeseries", 10,
+                           [("h", 1, (0, 4)), ("h", 2, (5, 10))])
